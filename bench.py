@@ -1,0 +1,96 @@
+"""Single-chip training benchmark — prints ONE JSON line.
+
+Metric: Llama-style decoder training throughput (tokens/sec/chip) on the
+local accelerator, with MFU derived from PaLM-style FLOPs accounting.
+vs_baseline = MFU / 0.40, the north-star MFU from BASELINE.md (the reference
+repo publishes no absolute numbers; 40% MFU for Llama-3-8B-class training is
+its stated target for this stack).
+
+Config is a width-2048 GQA decoder (head_dim 128 so the pallas flash
+attention kernel engages), bf16 activations, remat='dots', adamw.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak TFLOP/s by TPU generation (public spec sheets).
+PEAK_TFLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def detect_peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for name, peak in PEAK_TFLOPS.items():
+        if name in kind:
+            return peak
+    return 197e12  # conservative default
+
+
+def main():
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+    from container_engine_accelerators_tpu.training import (
+        create_train_state, make_optimizer, make_train_step)
+    from container_engine_accelerators_tpu.training.data import synthetic_batches
+    from container_engine_accelerators_tpu.training.train import shard_batch
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=2048, remat_policy="dots",
+        dtype=jnp.bfloat16)
+    batch_size, seq_len = 4, 2048
+    warmup_steps, bench_steps = 2, 8
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
+                     devices=jax.devices())
+
+    opt = make_optimizer(warmup_steps=10, decay_steps=1000)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    step_fn = make_train_step(cfg, mesh, opt)
+
+    batches = synthetic_batches(cfg.vocab_size, batch_size, seq_len,
+                                num_batches=warmup_steps + bench_steps)
+    batches = [shard_batch(b, mesh) for b in batches]
+
+    # Synchronize by fetching the loss to host each step: on the axon
+    # tunnel platform block_until_ready returns before execution finishes
+    # (donated buffers report ready), so device_get is the only reliable
+    # fence.
+    for b in batches[:warmup_steps]:
+        state, metrics = step_fn(state, b)
+        float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for b in batches[warmup_steps:]:
+        state, metrics = step_fn(state, b)
+        float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch_size * seq_len * bench_steps
+    tok_per_sec_per_chip = tokens / dt / n_dev
+    flops_per_token = cfg.train_flops_per_token(seq_len)
+    mfu = tok_per_sec_per_chip * flops_per_token / detect_peak_flops()
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_per_chip, 1),
+        "unit": f"tokens/s/chip (MFU={mfu:.3f})",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""container_engine_accelerators_tpu — TPU-native rebuild of GKE's accelerator
+node-infrastructure stack (reference: GoogleCloudPlatform/container-engine-accelerators).
+
+Layers (mirroring SURVEY.md §1, re-targeted at TPU):
+
+- L0 node provisioning      -> libtpu-installer/ DaemonSets (repo root)
+- L1 device plugin          -> deviceplugin/   (kubelet gRPC v1beta1, google.com/tpu)
+- L2 node auxiliaries       -> healthcheck/, metrics/, cli/partition_tpu, nri/
+- L3 collective enablement  -> ops/collectives.py + ici-collective/, dcn-multislice/
+- L4 topology scheduling    -> scheduler/
+- L5 demos/validation       -> demo/, example/, test/tpu/  (repo root)
+
+The compute path the reference only gestures at through demo manifests
+(reference demo/tpu-training/*.yaml) is first-class here: models/, ops/,
+parallel/, training/ implement a JAX/XLA/pallas training stack (flagship:
+Llama-3 family) sharded over `jax.sharding.Mesh` (dp/fsdp/sp/tp axes).
+
+Subpackages are imported lazily — `import container_engine_accelerators_tpu`
+pulls in neither jax nor grpc.
+"""
+
+__version__ = "0.1.0"
+
+# Resource name advertised to the kubelet (analog of `nvidia.com/gpu`,
+# reference pkg/gpu/nvidia/manager.go:67).
+TPU_RESOURCE_NAME = "google.com/tpu"

@@ -1,0 +1,24 @@
+"""Model zoo: the JAX training workloads the reference only ships as demo
+manifests (reference demo/tpu-training/resnet-tpu.yaml, inception-v3-tpu.yaml).
+
+Flagship: Llama-3 family decoder (models/llama.py), sharded dp/fsdp/sp/tp.
+Also: MNIST MLP (models/mnist.py) — the PR1 smoke-test workload.
+"""
+
+from container_engine_accelerators_tpu.models.llama import (
+    LlamaConfig,
+    llama3_8b,
+    llama3_1b,
+    llama_tiny,
+    init_params,
+    forward,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "llama3_8b",
+    "llama3_1b",
+    "llama_tiny",
+    "init_params",
+    "forward",
+]

@@ -1,0 +1,204 @@
+"""Llama-3-family decoder, pure functional JAX, built TPU-first.
+
+Design notes (why this is not a torch translation):
+- Layer params are *stacked* on a leading [n_layers] axis and the decoder
+  body is a `lax.scan` over them — one layer gets traced/compiled once, so
+  an 8B 32-layer compile costs the same as a 1-layer compile.
+- All matmuls are einsum/dot_general on [*, d_model] x [d_model, *] shapes
+  so XLA tiles them onto the MXU; activations default to bfloat16 with
+  float32 softmax/norm statistics.
+- Rematerialisation is `jax.checkpoint` around the scanned layer body with
+  a configurable policy ('none' | 'dots' | 'full').
+- Sharding is applied from outside via NamedSharding on params plus
+  `with_sharding_constraint` hints on activations (parallel/sharding.py);
+  the model itself is mesh-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.ops import (
+    apply_rope,
+    multi_head_attention,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # activations
+    param_dtype: Any = jnp.float32     # master weights
+    remat_policy: str = "dots"         # 'none' | 'dots' | 'full'
+    use_flash: bool | None = None      # None = auto by platform
+    # Ring attention over the 'sp' mesh axis (parallel/ring_attention.py);
+    # enabled by the training layer when the mesh has sp > 1.
+    sequence_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def train_flops_per_token(self, seq_len: int) -> float:
+        """Training (fwd+bwd) FLOPs per token, PaLM-style accounting:
+        6 * matmul_params + causal attention term 6 * L * d_model * S.
+        The embedding table is excluded — a gather does ~zero FLOPs; only
+        the lm_head projection counts among the vocab-sized matmuls."""
+        hd = self.head_dim
+        attn = self.n_layers * self.d_model * hd * (
+            2 * self.n_heads + 2 * self.n_kv_heads)
+        mlp = self.n_layers * 3 * self.d_model * self.d_ff
+        matmul_params = attn + mlp + self.vocab_size * self.d_model
+        return 6.0 * matmul_params + 6.0 * self.n_layers * self.d_model * seq_len
+
+    def num_params(self) -> int:
+        hd = self.head_dim
+        per_layer = (2 * self.d_model                      # norms
+                     + self.d_model * hd * self.n_heads     # wq
+                     + 2 * self.d_model * hd * self.n_kv_heads  # wk, wv
+                     + hd * self.n_heads * self.d_model     # wo
+                     + 3 * self.d_model * self.d_ff)        # gate, up, down
+        return (self.vocab_size * self.d_model * 2          # embed + lm_head
+                + self.n_layers * per_layer + self.d_model)
+
+
+def llama3_8b(**overrides) -> LlamaConfig:
+    return LlamaConfig(**overrides)
+
+
+def llama3_1b(**overrides) -> LlamaConfig:
+    kw = dict(vocab_size=128256, d_model=2048, n_layers=16, n_heads=32,
+              n_kv_heads=8, d_ff=8192)
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def llama_tiny(**overrides) -> LlamaConfig:
+    kw = dict(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+              n_kv_heads=2, d_ff=256, max_seq_len=256, remat_policy="none")
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Initialise the parameter pytree. Layer params stacked on axis 0."""
+    hd = cfg.head_dim
+    pd = cfg.param_dtype
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(pd)
+
+    def one_layer(k):
+        ks = jax.random.split(k, 7)
+        d = cfg.d_model
+        return {
+            "attn_norm": jnp.ones((d,), dtype=pd),
+            "wq": dense(ks[0], (d, cfg.n_heads * hd), d),
+            "wk": dense(ks[1], (d, cfg.n_kv_heads * hd), d),
+            "wv": dense(ks[2], (d, cfg.n_kv_heads * hd), d),
+            "wo": dense(ks[3], (cfg.n_heads * hd, d), cfg.n_heads * hd),
+            "mlp_norm": jnp.ones((d,), dtype=pd),
+            "w_gate": dense(ks[4], (d, cfg.d_ff), d),
+            "w_up": dense(ks[5], (d, cfg.d_ff), d),
+            "w_down": dense(ks[6], (cfg.d_ff, d), cfg.d_ff),
+        }
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    layers = jax.vmap(one_layer)(layer_keys)
+    return {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, cfg.d_model),
+                                    dtype=jnp.float32) * 0.02).astype(pd),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.d_model,), dtype=pd),
+        "lm_head": dense(k_head, (cfg.d_model, cfg.vocab_size), cfg.d_model),
+    }
+
+
+def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = constrain(q, "qkv")
+    k = constrain(k, "qkv")
+    v = constrain(v, "qkv")
+    if cfg.sequence_parallel:
+        from container_engine_accelerators_tpu.parallel import ring_attention as ra
+        attn = ra.ring_attention(q, k, v, axis_name="sp", mesh=mesh)
+    else:
+        attn = multi_head_attention(q, k, v, causal=True,
+                                    use_flash=cfg.use_flash)
+    attn = attn.reshape(b, s, cfg.n_heads * hd)
+    return x + constrain(attn @ lp["wo"].astype(dt), "resid")
+
+
+def _mlp(x, lp, cfg: LlamaConfig, constrain):
+    dt = cfg.dtype
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    ff = constrain(gate * up, "ff")
+    return x + constrain(ff @ lp["w_down"].astype(dt), "resid")
+
+
+_REMAT_POLICIES = {
+    "none": None,
+    "dots": "dots_with_no_batch_dims_saveable",
+    "full": "nothing_saveable",
+}
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            constrain=None, mesh=None) -> jnp.ndarray:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
+
+    `constrain(x, kind)` is an optional activation-sharding hook (see
+    parallel/sharding.py); identity when absent so the model stays
+    mesh-agnostic. `mesh` is only needed when cfg.sequence_parallel (ring
+    attention wraps itself in shard_map over the 'sp' axis).
+    """
+    if constrain is None:
+        constrain = lambda x, kind: x
+    b, s = tokens.shape
+    cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, "resid")
+
+    def layer_body(x, lp):
+        x = _attention(x, lp, cfg, cos, sin, constrain, mesh)
+        x = _mlp(x, lp, cfg, constrain)
+        return x, None
+
+    if cfg.remat_policy != "none":
+        policy_name = _REMAT_POLICIES[cfg.remat_policy]
+        policy = (getattr(jax.checkpoint_policies, policy_name)
+                  if policy_name else None)
+        layer_body = jax.checkpoint(layer_body, policy=policy)
+
+    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return constrain(logits, "logits")

@@ -1,0 +1,79 @@
+"""MNIST-scale MLP — the PR1 smoke-test workload (analog of the reference's
+demo/tpu-training entry jobs, reference demo/tpu-training/resnet-tpu.yaml:38-73).
+
+Runs anywhere (CPU pods first, then a single TPU chip) to prove the
+Allocate -> container -> JAX path end to end; see demo/tpu-training/.
+Data is synthetic (no egress): class-conditional Gaussian blobs in 784-d.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+N_CLASSES = 10
+INPUT_DIM = 784
+
+
+def init_params(key: jax.Array, hidden: int = 256) -> dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (INPUT_DIM, hidden)) * INPUT_DIM ** -0.5,
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, N_CLASSES)) * hidden ** -0.5,
+        "b2": jnp.zeros((N_CLASSES,)),
+    }
+
+
+def forward(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def synthetic_mnist(batch_size: int, num_batches: int | None = None,
+                    seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    # Class centers come from a fixed seed so train/eval streams with
+    # different `seed` values still draw from the same distribution.
+    centers = np.random.default_rng(1234).normal(
+        size=(N_CLASSES, INPUT_DIM)).astype(np.float32)
+    i = 0
+    while num_batches is None or i < num_batches:
+        y = rng.integers(0, N_CLASSES, size=batch_size)
+        x = centers[y] + 0.5 * rng.normal(
+            size=(batch_size, INPUT_DIM)).astype(np.float32)
+        yield x.astype(np.float32), y.astype(np.int32)
+        i += 1
+
+
+def train(steps: int = 100, batch_size: int = 128, lr: float = 1e-2,
+          seed: int = 0, log_fn=None) -> float:
+    """Train and return final accuracy on a held-out synthetic batch."""
+    params = init_params(jax.random.key(seed))
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = forward(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for i, (x, y) in enumerate(synthetic_mnist(batch_size, steps, seed)):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if log_fn and i % 20 == 0:
+            log_fn(f"mnist step {i} loss {float(loss):.4f}")
+
+    x, y = next(synthetic_mnist(512, 1, seed + 1))
+    acc = float(jnp.mean(jnp.argmax(forward(params, x), -1) == y))
+    if log_fn:
+        log_fn(f"mnist final accuracy {acc:.3f}")
+    return acc

@@ -1,0 +1,17 @@
+"""TPU compute ops: attention (XLA reference + pallas flash), RoPE, RMSNorm,
+collective wrappers with busBW accounting."""
+
+from container_engine_accelerators_tpu.ops.attention import (
+    multi_head_attention,
+    reference_attention,
+)
+from container_engine_accelerators_tpu.ops.rope import apply_rope, rope_frequencies
+from container_engine_accelerators_tpu.ops.rmsnorm import rms_norm
+
+__all__ = [
+    "multi_head_attention",
+    "reference_attention",
+    "apply_rope",
+    "rope_frequencies",
+    "rms_norm",
+]

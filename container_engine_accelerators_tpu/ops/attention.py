@@ -1,0 +1,67 @@
+"""Attention: XLA reference implementation + dispatch to the pallas flash
+kernel on TPU.
+
+The reference repo ships no attention code (it is node infra); this is the
+compute layer its demo workloads rely on, built TPU-first: GQA via einsum so
+XLA maps the contraction onto the MXU, flash attention in pallas
+(ops/flash_attention.py) when running on real TPU with long sequences.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, Hkv, D] -> [B, S, Hkv * n_rep, D] for grouped-query attention.
+    Shared by the XLA reference path, the pallas flash kernel, and ring
+    attention — keep GQA layout logic in exactly one place."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d))
+    return k.reshape(b, s, h * n_rep, d)
+
+
+def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        causal: bool = True,
+                        segment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Plain softmax attention. q: [B, S, Hq, D], k/v: [B, S, Hkv, D].
+
+    Softmax statistics in float32; output in q.dtype. Used on CPU, in tests,
+    and as the numerics oracle for the pallas flash kernel.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    s_q, s_k = q.shape[1], k.shape[1]
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg_mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         causal: bool = True,
+                         use_flash: bool | None = None) -> jnp.ndarray:
+    """Dispatch: pallas flash attention on TPU, XLA reference elsewhere.
+
+    `use_flash=None` auto-selects based on the default backend platform.
+    """
+    if use_flash is None:
+        platform = jax.default_backend()
+        use_flash = platform not in ("cpu", "gpu")
+    if use_flash:
+        from container_engine_accelerators_tpu.ops import flash_attention as fa
+
+        if fa.supported(q, k, v):
+            return fa.flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)

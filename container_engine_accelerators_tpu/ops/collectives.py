@@ -1,0 +1,152 @@
+"""ICI/DCN collective bandwidth probers — the TPU-native replacement for the
+reference's nccl-tests harnesses (reference gpudirect-tcpx/nccl-config.yaml:31-57,
+gpudirect-tcpxo/nccl-test-latest.yaml:124).
+
+Where the reference installs NCCL net plugins and launches
+`all_gather_perf -b 1M -e 512M -f 2 -w 5 --iters 100 -c 0` over mpirun, the
+TPU path needs no plugin: XLA collectives ride ICI natively. The deliverable
+is therefore the measurement harness itself — `jax.lax.psum` / `all_gather` /
+`ppermute` / `psum_scatter` over a mesh axis, with nccl-tests-compatible
+busBW accounting so numbers are comparable across fabrics.
+
+busBW factors follow the nccl-tests convention:
+  all_reduce:     busBW = algBW * 2 * (n-1) / n
+  all_gather:     busBW = algBW * (n-1) / n      (size = full gathered bytes)
+  reduce_scatter: busBW = algBW * (n-1) / n
+  all_to_all:     busBW = algBW * (n-1) / n
+  ppermute (ring sendrecv): busBW = algBW
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveResult:
+    collective: str
+    size_bytes: int          # nccl-tests "size" column
+    time_us: float           # mean per-iteration latency
+    alg_bw_gbps: float       # GB/s
+    bus_bw_gbps: float       # GB/s
+
+    def row(self) -> str:
+        return (f"{self.collective:>16} {self.size_bytes:>12} "
+                f"{self.time_us:>10.1f} {self.alg_bw_gbps:>8.2f} "
+                f"{self.bus_bw_gbps:>8.2f}")
+
+
+_BUS_FACTORS: dict[str, Callable[[int], float]] = {
+    "all_reduce": lambda n: 2 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+}
+
+COLLECTIVES = tuple(_BUS_FACTORS)
+
+
+def _collective_fn(name: str, axis: str, n: int):
+    """Per-shard function run under shard_map; input shard is 1-D [elems]."""
+    if name == "all_reduce":
+        return lambda x: jax.lax.psum(x, axis)
+    if name == "all_gather":
+        return lambda x: jax.lax.all_gather(x, axis, tiled=True)
+    if name == "reduce_scatter":
+        return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+    if name == "all_to_all":
+        def a2a(x):
+            chunks = x.reshape(n, -1)
+            return jax.lax.all_to_all(chunks, axis, 0, 0, tiled=False).reshape(-1)
+        return a2a
+    if name == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return lambda x: jax.lax.ppermute(x, axis, perm)
+    raise ValueError(f"unknown collective {name!r}")
+
+
+_OUT_SPECS: dict[str, Callable[[str], P]] = {
+    "all_reduce": lambda axis: P(axis),       # per-shard psum result, kept sharded
+    "all_gather": lambda axis: P(),           # replicated full buffer
+    "reduce_scatter": lambda axis: P(axis),
+    "all_to_all": lambda axis: P(axis),
+    "ppermute": lambda axis: P(axis),
+}
+
+
+def build_probe(mesh: Mesh, axis: str, collective: str):
+    """Return (jitted_fn, n). jitted_fn maps a [n*elems] array sharded on
+    `axis` through the collective once per call."""
+    n = mesh.shape[axis]
+    fn = _collective_fn(collective, axis, n)
+    out_spec = _OUT_SPECS[collective](axis)
+    # check_vma=False: all_gather outputs are replicated over `axis`, which
+    # the varying-mesh-axes inference can't prove statically.
+    mapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(axis),
+                                   out_specs=out_spec, check_vma=False))
+    return mapped, n
+
+
+def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
+                     warmup: int = 5, iters: int = 20,
+                     dtype=jnp.float32) -> CollectiveResult:
+    """Time one collective at one per-device size over `axis` of `mesh`.
+
+    Discipline mirrors nccl-tests `-w 5 --iters N`: warmup runs excluded,
+    block_until_ready around the timed loop (XLA dispatch is async).
+    """
+    mapped, n = build_probe(mesh, axis, collective)
+    itemsize = np.dtype(dtype).itemsize
+    elems = max(size_bytes // itemsize, n)
+    elems -= elems % n  # keep shard evenly divisible for a2a/scatter tiling
+
+    x = jax.device_put(jnp.zeros(elems * n, dtype=dtype),
+                       NamedSharding(mesh, P(axis)))
+
+    out = None
+    for _ in range(warmup):
+        out = mapped(x)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = mapped(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    per_dev_bytes = elems * itemsize
+    size = per_dev_bytes * n if collective == "all_gather" else per_dev_bytes
+    alg_bw = size / dt / 1e9
+    bus_bw = alg_bw * _BUS_FACTORS[collective](n)
+    return CollectiveResult(collective, size, dt * 1e6, alg_bw, bus_bw)
+
+
+def sweep(mesh: Mesh, axis: str, collective: str,
+          begin_bytes: int = 1 << 20, end_bytes: int = 1 << 29,
+          factor: int = 2, warmup: int = 5, iters: int = 20,
+          dtype=jnp.float32) -> list[CollectiveResult]:
+    """`-b 1M -e 512M -f 2` sweep, one CollectiveResult per size."""
+    results = []
+    size = begin_bytes
+    while size <= end_bytes:
+        results.append(probe_collective(mesh, axis, collective, size,
+                                        warmup=warmup, iters=iters, dtype=dtype))
+        size *= factor
+    return results
+
+
+def report(results: list[CollectiveResult]) -> str:
+    header = (f"{'collective':>16} {'bytes':>12} {'us':>10} "
+              f"{'algbw GB/s':>10} {'busbw GB/s':>10}")
+    lines = [header] + [r.row() for r in results]
+    peak = max((r.bus_bw_gbps for r in results), default=0.0)
+    lines.append(f"# peak busBW {peak:.2f} GB/s")
+    return "\n".join(lines)

@@ -1,0 +1,347 @@
+"""Flash attention for TPU in pallas.
+
+Online-softmax tiled attention: O(S) memory, MXU-shaped [block_q, d] x
+[d, block_k] contractions, float32 accumulators in VMEM scratch. Causal
+blocks above the diagonal are skipped entirely (predicated via pl.when).
+
+Layout contract: q, k, v are [B, H, S, D] (heads-major, so each (b, h)
+grid step addresses one contiguous [S, D] slab). GQA callers repeat KV
+heads before entry (cheap: broadcast_in_dim, fused by XLA).
+
+Backward is the standard two-kernel flash bwd (dq kernel scanning K,
+dk/dv kernel scanning Q) wired through jax.custom_vjp with (q, k, v, o,
+lse) residuals.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def supported(q, k, v) -> bool:
+    """Shape gate for the kernel: lane-dim and sublane-dim tiling limits."""
+    b, s, h, d = q.shape
+    return d % 128 == 0 and s % 128 == 0 and s >= 256
+
+
+def _pick_block(requested: int, s: int) -> int:
+    """Largest multiple of 128 that divides s and is <= requested — the
+    grid is (s // block), so the block must divide s exactly or trailing
+    rows/keys would be silently dropped (s=640 with block 512 would leave
+    rows 512+ unwritten)."""
+    block = min(requested, s)
+    while s % block:
+        block -= 128
+    return block
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale: float, block_q: int, block_k: int, causal: bool):
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    run = True
+    if causal:
+        # Skip blocks strictly above the diagonal.
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :]  # [bq, d]
+        k = k_ref[0, 0, :, :]  # [bk, d]
+        v = v_ref[0, 0, :, :]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        # Rows with no attended keys (can't happen causally) would have l=0.
+        l = jnp.maximum(l, 1e-30)
+        o_ref[0, 0, :, :] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, 0] = (m_scr[:, 0] + jnp.log(l[:, 0]))
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    block_q = _pick_block(block_q, s)
+    block_k = _pick_block(block_k, s)
+    grid = (b, h, s // block_q, s // block_k)
+
+    def qmap(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kmap(bi, hi, qi, ki):
+        return (bi, hi, ki, 0)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            # Stats carry a trailing singleton lane dim: TPU lowering needs
+            # the last two block dims divisible by (8, 128) or equal to the
+            # array dims.
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, block_q, block_k, causal):
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = pl.program_id(2) * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, 0]      # [bq]
+        delta = delta_ref[0, 0, :, 0]  # [bq]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale              # [bq, bk]
+        dq_acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, block_q, block_k, causal):
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = pl.program_id(2) * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    b, h, s, d = q.shape
+    scale = d ** -0.5
+    block_q = _pick_block(block_q, s)
+    block_k = _pick_block(block_k, s)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,S,1]
+
+    def qmap(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kmap(bi, hi, qi, ki):
+        return (bi, hi, ki, 0)
+
+    def qvecmap(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(b, h, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qvecmap),
+            pl.BlockSpec((1, 1, block_q, 1), qvecmap),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid puts K blocks in dim 2, Q scan innermost.
+    def kmap2(bi, hi, ki, qi):
+        return (bi, hi, ki, 0)
+
+    def qmap2(bi, hi, ki, qi):
+        return (bi, hi, qi, 0)
+
+    def qvecmap2(bi, hi, ki, qi):
+        return (bi, hi, qi, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(b, h, s // block_k, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap2),
+            pl.BlockSpec((1, 1, block_k, d), kmap2),
+            pl.BlockSpec((1, 1, block_k, d), kmap2),
+            pl.BlockSpec((1, 1, block_q, d), qmap2),
+            pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
+            pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), kmap2),
+            pl.BlockSpec((1, 1, block_k, d), kmap2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """q: [B, S, Hq, D]; k, v: [B, S, Hkv, D]. Returns [B, S, Hq, D].
+
+    Transposes to heads-major internally, repeats KV heads for GQA.
+    """
+    from container_engine_accelerators_tpu.ops.attention import _repeat_kv
+
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return jnp.swapaxes(out, 1, 2)

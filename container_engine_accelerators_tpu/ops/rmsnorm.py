@@ -1,0 +1,13 @@
+"""RMSNorm. Pure-XLA — fuses into neighbors; accumulate in float32."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    x_f = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x_f), axis=-1, keepdims=True)
+    normed = x_f * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Pure-XLA: the rotation is a fused elementwise op that XLA folds into the
+surrounding matmuls; no pallas needed here (HBM-bound, not MXU-bound).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 500_000.0,
+                     dtype=jnp.float32) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute cos/sin tables, each [max_seq_len, head_dim // 2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Rotate pairs of channels. x: [..., S, H, D]; cos/sin: [S_table, D/2].
+
+    `positions` ([..., S] int32) selects rows of the table; defaults to arange.
+    Computed in float32 for stability, cast back to x.dtype.
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        c = cos[:seq_len]  # [S, D/2]
+        s = sin[:seq_len]
+    else:
+        c = cos[positions]  # [..., S, D/2]
+        s = sin[positions]
+    # Broadcast over the heads axis: [..., S, 1, D/2]
+    c = jnp.expand_dims(c, axis=-2)
+    s = jnp.expand_dims(s, axis=-2)
+    x_f = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x_f, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,29 @@
+"""Parallelism: device mesh construction (dp/fsdp/sp/tp), sharding rules,
+ring attention for sequence/context parallelism.
+
+The reference enables multi-node data-parallel training by installing NCCL
+transports (reference gpudirect-*/); here scaling is expressed natively as
+`jax.sharding.Mesh` axes + XLA collectives over ICI/DCN.
+"""
+
+from container_engine_accelerators_tpu.parallel.mesh import (
+    MeshAxes,
+    auto_axis_sizes,
+    make_mesh,
+)
+from container_engine_accelerators_tpu.parallel.sharding import (
+    batch_spec,
+    llama_param_specs,
+    make_constrain,
+    param_shardings,
+)
+
+__all__ = [
+    "MeshAxes",
+    "auto_axis_sizes",
+    "make_mesh",
+    "batch_spec",
+    "llama_param_specs",
+    "make_constrain",
+    "param_shardings",
+]

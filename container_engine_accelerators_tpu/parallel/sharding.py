@@ -1,0 +1,73 @@
+"""Sharding rules: logical placement of Llama params/activations on the
+(dp, fsdp, sp, tp) mesh.
+
+Parameter placement (GSPMD inserts the collectives):
+  - vocab/ff/heads dims -> tp  (per-layer all-reduce on the residual)
+  - d_model dim         -> fsdp (params all-gathered per layer, grads
+                                 reduce-scattered — ZeRO-3 style)
+  - stacked layer dim   -> unsharded (scanned over)
+Activation hints keep batch on (dp, fsdp), sequence on sp, heads/ff on tp.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXES = ("dp", "fsdp")
+
+
+def llama_param_specs() -> dict:
+    """PartitionSpec tree matching models.llama.init_params structure."""
+    return {
+        # Vocab dim replicated: a vocab-sharded table turns the token gather
+        # into an SPMD full-remat (XLA warns "involuntary full
+        # rematerialization"); d_model on fsdp keeps memory bounded.
+        "embed": P(None, "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def param_shardings(mesh: Mesh, specs: dict | None = None):
+    specs = specs if specs is not None else llama_param_specs()
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec(sequence_parallel: bool = False) -> P:
+    """[B, S] token batches: batch over dp+fsdp, seq over sp when enabled."""
+    return P(BATCH_AXES, "sp" if sequence_parallel else None)
+
+
+# Activation-sharding hints, keyed by the `kind` strings models/llama.py
+# passes to its `constrain` hook.
+_ACTIVATION_SPECS = {
+    "resid": lambda sp: P(BATCH_AXES, "sp" if sp else None, None),
+    "qkv": lambda sp: P(BATCH_AXES, "sp" if sp else None, "tp", None),
+    "ff": lambda sp: P(BATCH_AXES, "sp" if sp else None, "tp"),
+    "logits": lambda sp: P(BATCH_AXES, "sp" if sp else None, "tp"),
+}
+
+
+def make_constrain(mesh: Mesh | None, sequence_parallel: bool = False):
+    """Build the `constrain(x, kind)` hook for models.llama.forward."""
+    if mesh is None:
+        return lambda x, kind: x
+
+    def constrain(x, kind):
+        spec = _ACTIVATION_SPECS[kind](sequence_parallel)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return constrain

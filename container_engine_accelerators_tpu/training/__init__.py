@@ -1,0 +1,15 @@
+"""Training loop: sharded train step, synthetic data, orbax checkpointing."""
+
+from container_engine_accelerators_tpu.training.train import (
+    TrainState,
+    create_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,61 @@
+"""Checkpoint/resume via orbax — a first-class subsystem here, where the
+reference repo's only 'checkpointing' is driver-install caching (reference
+nvidia-driver-installer/ubuntu/entrypoint.sh:33-61) and demos writing TF
+checkpoints to GCS (reference demo/tpu-training/resnet-tpu.yaml:55-68).
+
+Orbax handles sharded arrays natively: each host writes its own shards
+(OCDBT), restore re-shards onto the current mesh from abstract targets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from container_engine_accelerators_tpu.training.train import TrainState
+
+
+class CheckpointManager:
+    """Thin wrapper: save every N steps, keep last K, restore latest."""
+
+    def __init__(self, directory: str, save_interval_steps: int = 100,
+                 max_to_keep: int = 3):
+        directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                save_interval_steps=save_interval_steps,
+                max_to_keep=max_to_keep,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+        saved = self._mngr.save(
+            step, args=ocp.args.StandardSave(state._asdict()), force=force)
+        return bool(saved)
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: TrainState, step: int | None = None
+                ) -> TrainState | None:
+        """Restore into the shardings/dtypes of `state_like` (an existing or
+        abstract TrainState)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                state_like._asdict())
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return TrainState(**restored)
+
+    def close(self):
+        self._mngr.close()

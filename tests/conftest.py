@@ -1,0 +1,37 @@
+"""Test config: force an 8-device virtual CPU mesh, mirroring how the
+reference tests distributed behavior without a cluster (SURVEY.md §4 —
+both ends of every contract in one process).
+
+Must run before jax initializes its backends, hence module scope here.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+    return make_mesh(MeshAxes(dp=2, fsdp=2, sp=1, tp=2), devices=cpu_devices)
+
+
+@pytest.fixture(scope="session")
+def mesh_sp(cpu_devices):
+    from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+    return make_mesh(MeshAxes(dp=1, fsdp=2, sp=4, tp=1), devices=cpu_devices)

@@ -1,0 +1,105 @@
+"""Llama model: shapes, dtypes, causality, param count, sharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from container_engine_accelerators_tpu.models import (
+    forward,
+    init_params,
+    llama_tiny,
+)
+from container_engine_accelerators_tpu.parallel import (
+    make_constrain,
+    param_shardings,
+)
+from container_engine_accelerators_tpu.training import (
+    create_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from container_engine_accelerators_tpu.training.data import synthetic_batches
+from container_engine_accelerators_tpu.training.train import shard_batch
+
+
+def test_forward_shapes_and_dtype():
+    cfg = llama_tiny()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_matches_config():
+    cfg = llama_tiny()
+    params = init_params(jax.random.key(0), cfg)
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert n == cfg.num_params()
+
+
+def test_forward_is_causal():
+    cfg = llama_tiny()
+    params = init_params(jax.random.key(0), cfg)
+    t1 = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab_size)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+    l1 = forward(params, t1, cfg)
+    l2 = forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_params_placement(mesh8):
+    cfg = llama_tiny()
+    pshard = param_shardings(mesh8)
+    init = jax.jit(lambda k: init_params(k, cfg), out_shardings=pshard)
+    params = init(jax.random.key(0))
+    # wq sharded over fsdp (dim1) and tp (dim2): per-device shard is smaller.
+    wq = params["layers"]["wq"]
+    shard_shape = wq.addressable_shards[0].data.shape
+    assert shard_shape[1] == wq.shape[1] // 2  # fsdp=2
+    assert shard_shape[2] == wq.shape[2] // 2  # tp=2
+
+
+def test_train_step_decreases_loss(mesh8):
+    cfg = llama_tiny(vocab_size=64)
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2, decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh8, opt)
+    step_fn = make_train_step(cfg, mesh8, opt)
+    losses = []
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=8, seq_len=32,
+                                   num_batches=30, seed=0):
+        batch = shard_batch(batch, mesh8)
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert int(jax.device_get(state.step)) == 30
+    # Learnable synthetic structure: loss must drop substantially.
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_forward_with_constraints_matches_unconstrained(mesh8):
+    # float32 activations so the only difference is sharded-matmul reduction
+    # order (bf16 would add quantisation noise on top).
+    cfg = llama_tiny(dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)
+    base = forward(params, tokens, cfg)
+    constrain = make_constrain(mesh8)
+    sharded = jax.jit(
+        lambda p, t: forward(p, t, cfg, constrain=constrain))(params, tokens)
+    np.testing.assert_allclose(base, jax.device_get(sharded),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_train_step_sequence_parallel(mesh_sp):
+    # Full train step with ring attention over sp=4: exercises the
+    # long-context path end to end (fwd + bwd through ppermute).
+    cfg = llama_tiny(vocab_size=64, sequence_parallel=True)
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2, decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh_sp, opt)
+    step_fn = make_train_step(cfg, mesh_sp, opt)
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=4, seq_len=64,
+                                   num_batches=2, seed=0):
+        batch = shard_batch(batch, mesh_sp, sequence_parallel=True)
+        state, metrics = step_fn(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(jax.device_get(state.step)) == 2

@@ -1,0 +1,136 @@
+"""Ops numerics: RoPE, RMSNorm, reference attention, flash attention kernel
+(pallas interpret mode) vs the XLA oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.ops import (
+    apply_rope,
+    reference_attention,
+    rms_norm,
+    rope_frequencies,
+)
+from container_engine_accelerators_tpu.ops import flash_attention as fa
+
+
+def test_rms_norm_matches_numpy():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 16))
+    w = jax.random.normal(jax.random.key(1), (16,)) + 1.0
+    got = rms_norm(x, w)
+    xn = np.asarray(x, np.float64)
+    expect = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-5)
+    expect = expect * np.asarray(w, np.float64)
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    cos, sin = rope_frequencies(32, 64, theta=10_000.0)
+    x = jax.random.normal(jax.random.key(0), (1, 64, 2, 32))
+    y = apply_rope(x, cos, sin)
+    # Rotation preserves per-pair norms.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5, atol=1e-5)
+    # Position 0 is identity.
+    np.testing.assert_allclose(y[:, 0], x[:, 0], rtol=1e-6, atol=1e-6)
+
+
+def test_rope_positions_override():
+    cos, sin = rope_frequencies(16, 128)
+    x = jax.random.normal(jax.random.key(0), (1, 4, 1, 16))
+    pos = jnp.array([[5, 6, 7, 8]])
+    y1 = apply_rope(x, cos, sin, positions=pos)
+    # Same rows of the default table.
+    full = apply_rope(
+        jnp.broadcast_to(x[:, 0:1], (1, 9, 1, 16)).at[:, 5:9].set(x),
+        cos, sin)
+    np.testing.assert_allclose(y1[0, 0], full[0, 5], rtol=1e-5, atol=1e-5)
+
+
+def test_reference_attention_causality():
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (1, 8, 2, 16))
+    k = jax.random.normal(jax.random.key(1), (1, 8, 2, 16))
+    v = jax.random.normal(jax.random.key(2), (1, 8, 2, 16))
+    out1 = reference_attention(q, k, v, causal=True)
+    # Perturb the future: outputs at earlier positions must not change.
+    k2 = k.at[:, -1].add(10.0)
+    v2 = v.at[:, -1].add(10.0)
+    out2 = reference_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(out1[:, -1], out2[:, -1])
+
+
+def test_reference_attention_gqa_matches_mha():
+    key = jax.random.key(3)
+    b, s, h, d = 2, 16, 4, 8
+    q = jax.random.normal(key, (b, s, h, d))
+    k = jax.random.normal(jax.random.key(4), (b, s, 2, d))
+    v = jax.random.normal(jax.random.key(5), (b, s, 2, d))
+    # Manually expanding KV heads must equal the GQA path.
+    k_full = jnp.repeat(k, 2, axis=2)
+    v_full = jnp.repeat(v, 2, axis=2)
+    got = reference_attention(q, k, v)
+    expect = reference_attention(q, k_full, v_full)
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    b, s, hq, hkv, d = 1, 256, 2, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, d), jnp.float32)
+    got = fa.flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                             interpret=True)
+    expect = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grads_match_reference():
+    b, s, h, d = 1, 256, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, block_q=128,
+                               block_k=128, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a, b_, rtol=5e-4, atol=5e-4)
+
+
+def test_flash_supported_gate():
+    mk = lambda s, d: jnp.zeros((1, s, 1, d))
+    assert fa.supported(mk(256, 128), mk(256, 128), mk(256, 128))
+    assert not fa.supported(mk(256, 64), mk(256, 64), mk(256, 64))
+    assert not fa.supported(mk(100, 128), mk(100, 128), mk(100, 128))
+
+
+def test_flash_attention_nondivisible_block_seq():
+    # s=640 passes the supported() gate but does not divide the default 512
+    # block — _pick_block must fall back to a divisor (128) instead of
+    # silently truncating the grid.
+    b, s, h, d = 1, 640, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    got = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    expect = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+
+def test_mnist_smoke():
+    from container_engine_accelerators_tpu.models import mnist
+    acc = mnist.train(steps=60, batch_size=64)
+    assert acc > 0.9, acc
