@@ -1,0 +1,1 @@
+"""Entry points: device plugin daemon, partition_tpu one-shot, tpu-info."""

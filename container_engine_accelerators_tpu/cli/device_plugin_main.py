@@ -1,0 +1,113 @@
+"""TPU device plugin daemon — the analog of the reference's main
+(reference cmd/nvidia_gpu/nvidia_gpu.go:110-226): parse flags, load config,
+wait for chip device nodes, wire metrics + health + version visibility,
+then run the kubelet serve loop.
+
+Run: python -m container_engine_accelerators_tpu.cli.device_plugin_main
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+import time
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    TPUManager,
+    config as tpu_config,
+)
+from container_engine_accelerators_tpu.deviceplugin import manager as mgr
+
+log = logging.getLogger("tpu-device-plugin")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--device-plugin-path", default=mgr.DEFAULT_PLUGIN_DIR,
+                   help="kubelet device-plugin socket directory")
+    p.add_argument("--libtpu-host-dir", default=mgr.DEFAULT_LIBTPU_HOST_DIR,
+                   help="host dir with libtpu.so staged by the installer")
+    p.add_argument("--libtpu-container-dir",
+                   default=mgr.DEFAULT_LIBTPU_CONTAINER_DIR)
+    p.add_argument("--config-file", default="/etc/tpu/tpu_config.json")
+    p.add_argument("--dev-root", default=None,
+                   help="override /dev (smoke tests against fake chip trees)")
+    p.add_argument("--sysfs-accel-root", default=None,
+                   help="override /sys/class/accel")
+    p.add_argument("--enable-metrics", action="store_true",
+                   help="serve Prometheus chip metrics")
+    p.add_argument("--metrics-port", type=int, default=2112)
+    p.add_argument("--enable-health-monitoring", action="store_true",
+                   help="run the chip health checker / Node conditions")
+    p.add_argument("--publish-version-annotations", action="store_true",
+                   help="publish libtpu/runtime versions as node annotations")
+    p.add_argument("--wait-for-devices-timeout", type=float, default=0.0,
+                   help="seconds to wait for /dev/accel* (0 = forever)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cfg = tpu_config.load(args.config_file)
+    log.info("config: %s", cfg)
+
+    from container_engine_accelerators_tpu.deviceplugin.devutil import (
+        DEFAULT_DEV_ROOT,
+        DEFAULT_SYSFS_ACCEL_ROOT,
+        SysfsDeviceInfo,
+    )
+    dev_root = args.dev_root or DEFAULT_DEV_ROOT
+    sysfs_root = args.sysfs_accel_root or DEFAULT_SYSFS_ACCEL_ROOT
+    manager = TPUManager(
+        cfg,
+        SysfsDeviceInfo(dev_root=dev_root, sysfs_accel_root=sysfs_root),
+        plugin_dir=args.device_plugin_path,
+        libtpu_host_dir=args.libtpu_host_dir,
+        libtpu_container_dir=args.libtpu_container_dir)
+
+    # Block until the libtpu-installer / accel driver has created the chip
+    # nodes (reference nvidia_gpu.go:144-154 waits on /dev/nvidiactl).
+    deadline = (time.monotonic() + args.wait_for_devices_timeout
+                if args.wait_for_devices_timeout else None)
+    while not manager.check_device_paths():
+        if deadline and time.monotonic() > deadline:
+            log.error("no TPU chips appeared under /dev; giving up")
+            return 1
+        log.info("waiting for TPU chip device nodes...")
+        time.sleep(5)
+
+    manager.discover()
+    log.info("discovered %d advertised devices", len(manager.devices))
+
+    if args.enable_metrics:
+        from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+        from container_engine_accelerators_tpu.metrics.sampler import make_sampler
+        MetricServer(manager, sampler=make_sampler(sysfs_root),
+                     port=args.metrics_port).start_background()
+    if args.enable_health_monitoring:
+        from container_engine_accelerators_tpu.healthcheck.health_checker import (
+            TPUHealthChecker,
+        )
+        checker = TPUHealthChecker(manager, cfg)
+        threading.Thread(target=checker.run, daemon=True,
+                         name="health-checker").start()
+    if args.publish_version_annotations:
+        from container_engine_accelerators_tpu.deviceplugin.version_visibility import (
+            publish_version_annotations_forever,
+        )
+        threading.Thread(target=publish_version_annotations_forever,
+                         daemon=True, name="version-visibility").start()
+
+    manager.serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Kubelet device plugin for TPU (L1): advertises `google.com/tpu`, mounts
+/dev/accel* + libtpu into pods — the analog of the reference's
+cmd/nvidia_gpu + pkg/gpu/nvidia (reference pkg/gpu/nvidia/manager.go,
+beta_plugin.go)."""
+
+from container_engine_accelerators_tpu.deviceplugin.config import (
+    SharingConfig,
+    TPUConfig,
+)
+from container_engine_accelerators_tpu.deviceplugin.devutil import (
+    Chip,
+    DeviceInfo,
+    MockDeviceInfo,
+    SysfsDeviceInfo,
+)
+from container_engine_accelerators_tpu.deviceplugin.manager import (
+    HEALTHY,
+    UNHEALTHY,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.deviceplugin.plugin_service import (
+    DevicePluginService,
+)
+
+__all__ = [
+    "SharingConfig",
+    "TPUConfig",
+    "Chip",
+    "DeviceInfo",
+    "MockDeviceInfo",
+    "SysfsDeviceInfo",
+    "HEALTHY",
+    "UNHEALTHY",
+    "TPUManager",
+    "DevicePluginService",
+]

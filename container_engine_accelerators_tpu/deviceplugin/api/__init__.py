@@ -1,0 +1,24 @@
+"""Kubelet device-plugin v1beta1 API: protoc-generated messages + hand-written
+gRPC stubs. Regenerate messages with:
+    protoc --python_out=. deviceplugin.proto
+"""
+
+from container_engine_accelerators_tpu.deviceplugin.api import deviceplugin_pb2
+from container_engine_accelerators_tpu.deviceplugin.api.deviceplugin_grpc import (
+    DevicePluginServicer,
+    DevicePluginStub,
+    RegistrationServicer,
+    RegistrationStub,
+    add_device_plugin_servicer,
+    add_registration_servicer,
+)
+
+__all__ = [
+    "deviceplugin_pb2",
+    "DevicePluginServicer",
+    "DevicePluginStub",
+    "RegistrationServicer",
+    "RegistrationStub",
+    "add_device_plugin_servicer",
+    "add_registration_servicer",
+]

@@ -1,0 +1,93 @@
+"""Plugin configuration — the analog of the reference's GPUConfig JSON file
+(/etc/nvidia/gpu_config.json, reference pkg/gpu/nvidia/manager.go:72-139)
+with the same three knobs re-targeted at TPU:
+
+  GPUPartitionSize        -> chips_per_partition (subslice partitioning)
+  GPUSharingConfig        -> sharing strategy + max clients per chip
+  HealthCriticalXid       -> health_critical_errors (TPU error classes)
+
+plus the env override channel (XID_CONFIG ConfigMap pattern, reference
+manager.go:119-139 + test/nvidia_gpu/xid-config.yaml) as
+TPU_HEALTH_CONFIG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+TIME_SHARING = "time-sharing"
+VALID_STRATEGIES = (TIME_SHARING,)
+
+# TPU runtime error classes monitored by the health checker; the subset
+# marked critical flips devices to Unhealthy (analog of the XID lists,
+# reference health_check/health_checker.go:64-99).
+KNOWN_ERROR_CLASSES = (
+    "HBM_ECC_UNCORRECTABLE",
+    "ICI_LINK_DOWN",
+    "CHIP_LOST",
+    "THERMAL_TRIP",
+    "RUNTIME_HANG",
+    "HBM_ECC_CORRECTABLE",
+    "ICI_CRC_ERROR",
+)
+DEFAULT_CRITICAL = ("HBM_ECC_UNCORRECTABLE", "ICI_LINK_DOWN", "CHIP_LOST",
+                    "THERMAL_TRIP")
+
+
+@dataclasses.dataclass
+class SharingConfig:
+    strategy: str = ""
+    max_shared_clients_per_chip: int = 0
+
+
+@dataclasses.dataclass
+class TPUConfig:
+    chips_per_partition: int = 0          # 0 = no subslice partitioning
+    sharing: SharingConfig = dataclasses.field(default_factory=SharingConfig)
+    health_critical_errors: tuple[str, ...] = DEFAULT_CRITICAL
+
+    def validate(self) -> None:
+        if self.chips_per_partition < 0:
+            raise ValueError("chips_per_partition must be >= 0")
+        if self.chips_per_partition and self.sharing.strategy:
+            raise ValueError(
+                "subslice partitioning and chip sharing are mutually "
+                "exclusive")
+        if self.sharing.strategy:
+            if self.sharing.strategy not in VALID_STRATEGIES:
+                raise ValueError(
+                    f"invalid sharing strategy {self.sharing.strategy!r}; "
+                    f"valid: {VALID_STRATEGIES}")
+            if self.sharing.max_shared_clients_per_chip < 2:
+                raise ValueError(
+                    "sharing requires max_shared_clients_per_chip >= 2")
+        for e in self.health_critical_errors:
+            if e not in KNOWN_ERROR_CLASSES:
+                raise ValueError(f"unknown health error class {e!r}")
+
+
+def load(path: str | None = None) -> TPUConfig:
+    """Load /etc/tpu/tpu_config.json (absent file -> defaults), then apply
+    the TPU_HEALTH_CONFIG env override ("CLASS1,CLASS2")."""
+    cfg = TPUConfig()
+    if path and os.path.exists(path):
+        with open(path) as f:
+            raw = json.load(f)
+        sharing = raw.get("chipSharingConfig", {})
+        cfg = TPUConfig(
+            chips_per_partition=int(raw.get("chipsPerPartition", 0)),
+            sharing=SharingConfig(
+                strategy=sharing.get("strategy", ""),
+                max_shared_clients_per_chip=int(
+                    sharing.get("maxSharedClientsPerChip", 0))),
+            health_critical_errors=tuple(
+                raw.get("healthCriticalErrors", DEFAULT_CRITICAL)),
+        )
+    env = os.environ.get("TPU_HEALTH_CONFIG")
+    if env:
+        cfg.health_critical_errors = tuple(
+            e.strip() for e in env.split(",") if e.strip())
+    cfg.validate()
+    return cfg

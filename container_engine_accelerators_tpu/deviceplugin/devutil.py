@@ -1,0 +1,151 @@
+"""TPU chip discovery behind a mockable interface — the analog of the
+reference's NVML wrapper (reference pkg/gpu/nvidia/nvmlutil/nvmlutil.go:30-42,
+mock at nvml_mock.go:28-70).
+
+Where NVIDIA discovery goes through NVML handles + a /dev regex (reference
+pkg/gpu/nvidia/manager.go:237-304), TPU chips appear as `/dev/accel<N>`
+char devices (Google TPU 'accel' driver) or VFIO groups, with per-chip
+sysfs entries under /sys/class/accel/accel<N>/device for NUMA and PCI
+info. Everything is rooted on configurable dev/sysfs prefixes so tests
+fabricate chip trees in tempdirs (SURVEY.md §4 fake-/dev pattern).
+
+When built, the native C++ shim (native/tpudev, loaded via ctypes in
+metrics/sampler.py) provides the duty-cycle counters; discovery here is
+pure Python on devfs/sysfs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import stat
+
+ACCEL_RE = re.compile(r"^accel(\d+)$")
+DEFAULT_DEV_ROOT = "/dev"
+DEFAULT_SYSFS_ACCEL_ROOT = "/sys/class/accel"
+
+
+@dataclasses.dataclass(frozen=True)
+class Chip:
+    index: int
+    dev_path: str            # /dev/accel0
+    numa_node: int | None    # None if unknown / single-node host
+    pci_address: str | None  # 0000:05:00.0
+
+
+class DeviceInfo:
+    """Interface: concrete impls are SysfsDeviceInfo and MockDeviceInfo."""
+
+    def discover(self) -> list[Chip]:
+        raise NotImplementedError
+
+    def chip_generation(self) -> str:
+        raise NotImplementedError
+
+
+class SysfsDeviceInfo(DeviceInfo):
+    def __init__(self, dev_root: str = DEFAULT_DEV_ROOT,
+                 sysfs_accel_root: str = DEFAULT_SYSFS_ACCEL_ROOT):
+        self.dev_root = dev_root
+        self.sysfs_accel_root = sysfs_accel_root
+
+    def discover(self) -> list[Chip]:
+        chips = []
+        try:
+            entries = sorted(os.listdir(self.dev_root))
+        except FileNotFoundError:
+            return []
+        for name in entries:
+            m = ACCEL_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.dev_root, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if not stat.S_ISCHR(st.st_mode) and not stat.S_ISREG(st.st_mode):
+                # Real chips are char devices; plain files accepted so fake
+                # trees in tests don't need mknod (root-only).
+                continue
+            idx = int(m.group(1))
+            chips.append(Chip(index=idx, dev_path=path,
+                              numa_node=self._numa_node(idx),
+                              pci_address=self._pci_address(idx)))
+        return chips
+
+    def _sys_device_dir(self, idx: int) -> str:
+        return os.path.join(self.sysfs_accel_root, f"accel{idx}", "device")
+
+    def _numa_node(self, idx: int) -> int | None:
+        # Same source the reference reads for GPUs:
+        # /sys/bus/pci/devices/<busid>/numa_node (nvmlutil.go:114-151).
+        path = os.path.join(self._sys_device_dir(idx), "numa_node")
+        try:
+            with open(path) as f:
+                node = int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+        return node if node >= 0 else None
+
+    def _pci_address(self, idx: int) -> str | None:
+        # /sys/class/accel/accelN/device is a symlink into the PCI tree;
+        # its basename is the bus address.
+        dev_dir = self._sys_device_dir(idx)
+        try:
+            target = os.readlink(dev_dir)
+        except OSError:
+            return None
+        return os.path.basename(target) or None
+
+    def chip_generation(self) -> str:
+        # GKE nodes carry the TPU generation in node labels; on-host the
+        # accel driver exposes it via sysfs 'device/device' PCI id. Fall
+        # back to the env contract used by the test/bench images.
+        env = os.environ.get("TPU_CHIP_GENERATION")
+        if env:
+            return env
+        ids = {
+            "0x0027": "v4",
+            "0x0062": "v5e",
+            "0x0063": "v5p",
+            "0x006f": "v6e",
+        }
+        path = os.path.join(self._sys_device_dir(0), "device")
+        try:
+            with open(path) as f:
+                return ids.get(f.read().strip().lower(), "unknown")
+        except OSError:
+            return "unknown"
+
+
+class MockDeviceInfo(DeviceInfo):
+    """Test double: discovery over a fabricated dev tree, fixed metadata —
+    mirror of the reference's MockDeviceInfo counting fake dev files."""
+
+    def __init__(self, dev_root: str, numa_nodes: dict[int, int] | None = None,
+                 generation: str = "v5e"):
+        self.dev_root = dev_root
+        self.numa_nodes = numa_nodes or {}
+        self.generation = generation
+
+    def discover(self) -> list[Chip]:
+        chips = []
+        try:
+            entries = sorted(os.listdir(self.dev_root))
+        except FileNotFoundError:
+            return []
+        for name in entries:
+            m = ACCEL_RE.match(name)
+            if m:
+                idx = int(m.group(1))
+                chips.append(Chip(
+                    index=idx,
+                    dev_path=os.path.join(self.dev_root, name),
+                    numa_node=self.numa_nodes.get(idx),
+                    pci_address=f"0000:{idx:02x}:00.0"))
+        return chips
+
+    def chip_generation(self) -> str:
+        return self.generation

@@ -1,0 +1,306 @@
+"""TPU device manager: discovery, advertised-device construction, health
+fan-out, and the kubelet serve/restart state machine.
+
+Design transplanted from the reference's nvidiaGPUManager (reference
+pkg/gpu/nvidia/manager.go:142-157 state, :237-304 discovery, :442-549
+serve loop) with the concurrency re-expressed as a polling loop +
+per-stream queues instead of fsnotify + channels:
+
+  - kubelet wipes /device-plugin/  -> plugin socket vanishes -> restart
+    gRPC server and re-register (manager.go:507-516 analog)
+  - kubelet restarts               -> kubelet.sock inode changes ->
+    re-register (manager.go:517-533 analog)
+  - chip appears/disappears        -> advertised set changes -> restart
+    so kubelet resyncs (manager.go:534-545 analog)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from container_engine_accelerators_tpu import TPU_RESOURCE_NAME
+from container_engine_accelerators_tpu.deviceplugin import sharing, subslice
+from container_engine_accelerators_tpu.deviceplugin.api import (
+    RegistrationStub,
+    add_device_plugin_servicer,
+    deviceplugin_pb2 as pb,
+)
+from container_engine_accelerators_tpu.deviceplugin.config import (
+    TIME_SHARING,
+    TPUConfig,
+)
+from container_engine_accelerators_tpu.deviceplugin.devutil import (
+    Chip,
+    DeviceInfo,
+    SysfsDeviceInfo,
+)
+
+log = logging.getLogger(__name__)
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+DEVICE_PLUGIN_API_VERSION = "v1beta1"
+DEFAULT_PLUGIN_DIR = "/device-plugin"
+KUBELET_SOCKET = "kubelet.sock"
+PLUGIN_SOCKET = "tpu.sock"
+DEFAULT_LIBTPU_HOST_DIR = "/home/kubernetes/bin/tpu"
+DEFAULT_LIBTPU_CONTAINER_DIR = "/usr/lib/tpu"
+
+
+class TPUManager:
+    def __init__(self, config: TPUConfig,
+                 device_info: DeviceInfo | None = None, *,
+                 plugin_dir: str = DEFAULT_PLUGIN_DIR,
+                 libtpu_host_dir: str = DEFAULT_LIBTPU_HOST_DIR,
+                 libtpu_container_dir: str = DEFAULT_LIBTPU_CONTAINER_DIR,
+                 resource_name: str = TPU_RESOURCE_NAME,
+                 poll_interval: float = 1.0,
+                 chip_check_interval: float = 10.0):
+        self.config = config
+        self.device_info = device_info or SysfsDeviceInfo()
+        self.plugin_dir = plugin_dir
+        self.libtpu_host_dir = libtpu_host_dir
+        self.libtpu_container_dir = libtpu_container_dir
+        self.resource_name = resource_name
+        self.poll_interval = poll_interval
+        self.chip_check_interval = chip_check_interval
+
+        self.devices: dict[str, pb.Device] = {}
+        self._chips: dict[int, Chip] = {}
+        self._subslices: dict[str, subslice.Subslice] = {}
+        self._lock = threading.Lock()
+        self._listeners: list[queue.SimpleQueue] = []
+        self._stop = threading.Event()
+        self.restarts = 0  # observable for tests
+
+    # ---------- discovery ----------
+
+    def check_device_paths(self) -> bool:
+        """True once at least one chip node exists — the startup gate the
+        reference holds on /dev/nvidiactl + /dev/nvidia-uvm
+        (cmd/nvidia_gpu/nvidia_gpu.go:144-154)."""
+        return bool(self.device_info.discover())
+
+    def discover(self) -> None:
+        """Scan chips and rebuild the advertised device map."""
+        chips = self.device_info.discover()
+        with self._lock:
+            old_health = {d.ID: d.health for d in self.devices.values()}
+            self._chips = {c.index: c for c in chips}
+            self.devices = {}
+            self._subslices = {}
+            if self.config.chips_per_partition:
+                for sub in subslice.partition(
+                        chips, self.config.chips_per_partition):
+                    self._subslices[sub.id] = sub
+                    self.devices[sub.id] = self._make_device(
+                        sub.id, sub.numa_node,
+                        old_health.get(sub.id, HEALTHY))
+            elif self.config.sharing.strategy == TIME_SHARING:
+                n = self.config.sharing.max_shared_clients_per_chip
+                for c in chips:
+                    phys = os.path.basename(c.dev_path)
+                    for i in range(n):
+                        vid = sharing.virtual_id(phys, i)
+                        self.devices[vid] = self._make_device(
+                            vid, c.numa_node, old_health.get(vid, HEALTHY))
+            else:
+                for c in chips:
+                    phys = os.path.basename(c.dev_path)
+                    self.devices[phys] = self._make_device(
+                        phys, c.numa_node, old_health.get(phys, HEALTHY))
+
+    @staticmethod
+    def _make_device(dev_id: str, numa: int | None, health: str) -> pb.Device:
+        dev = pb.Device(ID=dev_id, health=health)
+        if numa is not None:
+            dev.topology.nodes.add(ID=numa)
+        return dev
+
+    # ---------- health fan-out ----------
+
+    def set_device_health(self, device_id: str, health: str) -> None:
+        with self._lock:
+            dev = self.devices.get(device_id)
+            if dev is None or dev.health == health:
+                return
+            dev.health = health
+            listeners = list(self._listeners)
+        log.info("device %s -> %s", device_id, health)
+        for q in listeners:
+            q.put(None)  # wake ListAndWatch streams to resend the snapshot
+
+    def set_chip_health(self, chip_index: int, health: str) -> None:
+        """Flip every advertised device backed by a chip (virtual devices
+        share fate with their physical chip; subslices with any member)."""
+        with self._lock:
+            targets = []
+            phys = f"accel{chip_index}"
+            for dev_id in self.devices:
+                if dev_id == phys or dev_id.startswith(phys + "/"):
+                    targets.append(dev_id)
+            for sid, sub in self._subslices.items():
+                if any(c.index == chip_index for c in sub.chips):
+                    targets.append(sid)
+        for t in targets:
+            self.set_device_health(t, health)
+
+    def snapshot(self) -> list[pb.Device]:
+        with self._lock:
+            return [pb.Device.FromString(d.SerializeToString())
+                    for d in self.devices.values()]
+
+    def add_listener(self) -> queue.SimpleQueue:
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        with self._lock:
+            self._listeners.append(q)
+        return q
+
+    def remove_listener(self, q) -> None:
+        with self._lock:
+            if q in self._listeners:
+                self._listeners.remove(q)
+
+    # ---------- allocation support ----------
+
+    def chips_for_device(self, device_id: str) -> list[Chip]:
+        with self._lock:
+            if device_id in self._subslices:
+                return list(self._subslices[device_id].chips)
+            if sharing.is_virtual_id(device_id):
+                device_id = sharing.virtual_to_physical(device_id)
+            for c in self._chips.values():
+                if os.path.basename(c.dev_path) == device_id:
+                    return [c]
+        raise KeyError(f"unknown device {device_id!r}")
+
+    def device_specs(self, device_ids: list[str]) -> list[pb.DeviceSpec]:
+        specs, seen = [], set()
+        for dev_id in device_ids:
+            for chip in self.chips_for_device(dev_id):
+                if chip.dev_path in seen:
+                    continue
+                seen.add(chip.dev_path)
+                specs.append(pb.DeviceSpec(
+                    container_path=chip.dev_path,
+                    host_path=chip.dev_path,
+                    permissions="mrw"))
+        return specs
+
+    def mounts(self) -> list[pb.Mount]:
+        # libtpu.so staged by the libtpu-installer DaemonSet, mounted
+        # read-only the way the reference mounts the driver tree
+        # (cmd/nvidia_gpu/nvidia_gpu.go:113-115).
+        if not self.libtpu_host_dir:
+            return []
+        return [pb.Mount(container_path=self.libtpu_container_dir,
+                         host_path=self.libtpu_host_dir, read_only=True)]
+
+    def envs(self, device_ids: list[str]) -> dict[str, str]:
+        """libtpu visibility contract (the role MPS envs play in reference
+        manager.go:335-348): which chips this container may open."""
+        indices = sorted({c.index for d in device_ids
+                          for c in self.chips_for_device(d)})
+        vis = ",".join(str(i) for i in indices)
+        return {
+            "TPU_VISIBLE_CHIPS": vis,
+            "TPU_VISIBLE_DEVICES": vis,  # legacy tpu_driver spelling
+            "TPU_CHIP_GENERATION": self.device_info.chip_generation(),
+            "TPU_SKIP_MDS_QUERY": "true",
+        }
+
+    # ---------- serve state machine ----------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def serve(self) -> None:
+        """Run until stop(): serve the plugin socket, register with the
+        kubelet, watch for the three restart triggers."""
+        from container_engine_accelerators_tpu.deviceplugin.plugin_service import (
+            DevicePluginService,
+        )
+        while not self._stop.is_set():
+            try:
+                self._serve_once(DevicePluginService(self))
+            except Exception:
+                log.exception("serve loop error; retrying in 2s")
+                self._stop.wait(2.0)
+            self.restarts += 1
+
+    def _serve_once(self, service) -> None:
+        sock_path = os.path.join(self.plugin_dir, PLUGIN_SOCKET)
+        kubelet_path = os.path.join(self.plugin_dir, KUBELET_SOCKET)
+        try:
+            os.unlink(sock_path)
+        except FileNotFoundError:
+            pass
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        add_device_plugin_servicer(service, server)
+        server.add_insecure_port(f"unix://{sock_path}")
+        server.start()
+        log.info("device plugin serving on %s", sock_path)
+        try:
+            self._register_with_kubelet(kubelet_path)
+            kubelet_id = self._file_identity(kubelet_path)
+            last_chip_check = time.monotonic()
+            while not self._stop.is_set():
+                self._stop.wait(self.poll_interval)
+                if not os.path.exists(sock_path):
+                    log.warning("plugin socket removed; restarting server")
+                    return
+                if self._file_identity(kubelet_path) != kubelet_id:
+                    log.warning("kubelet restart detected; re-registering")
+                    return
+                now = time.monotonic()
+                if now - last_chip_check >= self.chip_check_interval:
+                    last_chip_check = now
+                    before = set(self.devices)
+                    self.discover()
+                    if set(self.devices) != before:
+                        log.warning("advertised devices changed "
+                                    "(%d -> %d); restarting server",
+                                    len(before), len(self.devices))
+                        return
+        finally:
+            service.stop()
+            server.stop(grace=1).wait()
+
+    @staticmethod
+    def _file_identity(path: str):
+        try:
+            st = os.stat(path)
+            return (st.st_ino, st.st_ctime)
+        except OSError:
+            return None
+
+    def _register_with_kubelet(self, kubelet_path: str,
+                               timeout: float = 30.0) -> None:
+        # Reference beta_plugin.go:110-131. Wait for the socket file first:
+        # dialing a nonexistent unix socket puts gRPC into connect backoff,
+        # which can outlast the ready-future timeout after a kubelet restart.
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(kubelet_path):
+            if time.monotonic() > deadline or self._stop.is_set():
+                raise TimeoutError(f"kubelet socket {kubelet_path} absent")
+            time.sleep(0.1)
+        with grpc.insecure_channel(f"unix://{kubelet_path}") as channel:
+            grpc.channel_ready_future(channel).result(timeout=10)
+            stub = RegistrationStub(channel)
+            stub.Register(pb.RegisterRequest(
+                version=DEVICE_PLUGIN_API_VERSION,
+                endpoint=PLUGIN_SOCKET,
+                resource_name=self.resource_name,
+                options=pb.DevicePluginOptions(
+                    get_preferred_allocation_available=True),
+            ), timeout=10)
+        log.info("registered %s with kubelet", self.resource_name)

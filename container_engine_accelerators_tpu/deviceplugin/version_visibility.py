@@ -1,0 +1,76 @@
+"""Publish TPU software versions as node annotations — the analog of the
+reference's version_visibility package, which annotates
+cloud.google.com/cuda.driver-version.* from NVML (reference
+pkg/gpu/nvidia/version_visibility/version_visibility.go:38-86).
+
+TPU versions come from the libtpu install dir (the installer DaemonSet
+writes a `version` stamp next to libtpu.so) and, when importable, the JAX
+runtime."""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+
+ANNOTATION_PREFIX = "cloud.google.com/tpu.libtpu-version"
+VERSION_RE = re.compile(r"^(\d+)\.(\d+)\.(\d+)")
+
+log = logging.getLogger(__name__)
+
+
+def read_libtpu_version(libtpu_dir: str) -> str | None:
+    """The installer stages `<dir>/version`; fall back to a versioned
+    soname like libtpu.so.1.9.0."""
+    stamp = os.path.join(libtpu_dir, "version")
+    try:
+        with open(stamp) as f:
+            return f.read().strip()
+    except OSError:
+        pass
+    try:
+        for name in os.listdir(libtpu_dir):
+            m = re.match(r"libtpu\.so\.(\d+\.\d+\.\d+)", name)
+            if m:
+                return m.group(1)
+    except OSError:
+        pass
+    return None
+
+
+def version_annotations(version: str) -> dict[str, str]:
+    """Split major/minor/revision the way the reference publishes CUDA
+    driver components (version_visibility.go:48-64)."""
+    ann = {ANNOTATION_PREFIX + ".full": version}
+    m = VERSION_RE.match(version)
+    if m:
+        ann[ANNOTATION_PREFIX + ".major"] = m.group(1)
+        ann[ANNOTATION_PREFIX + ".minor"] = m.group(2)
+        ann[ANNOTATION_PREFIX + ".revision"] = m.group(3)
+    return ann
+
+
+def publish_version_annotations(k8s, node_name: str, libtpu_dir: str) -> bool:
+    version = read_libtpu_version(libtpu_dir)
+    if not version:
+        log.warning("no libtpu version found under %s", libtpu_dir)
+        return False
+    k8s.annotate_node(node_name, version_annotations(version))
+    log.info("published libtpu version %s on node %s", version, node_name)
+    return True
+
+
+def publish_version_annotations_forever(k8s=None, node_name: str | None = None,
+                                        libtpu_dir: str = "/home/kubernetes/bin/tpu",
+                                        interval: float = 600.0):
+    from container_engine_accelerators_tpu.k8s import in_cluster_client
+
+    k8s = k8s or in_cluster_client()
+    node_name = node_name or os.environ.get("NODE_NAME", "")
+    while True:
+        try:
+            publish_version_annotations(k8s, node_name, libtpu_dir)
+        except Exception:
+            log.exception("version annotation publish failed")
+        time.sleep(interval)

@@ -1,0 +1,18 @@
+"""Chip health monitoring (L2): polled TPU error sources -> device
+Unhealthy (kubelet stops scheduling) + Node condition + K8s Events —
+the analog of the reference's XID pipeline (reference
+pkg/gpu/nvidia/health_check/health_checker.go)."""
+
+from container_engine_accelerators_tpu.healthcheck.health_checker import (
+    DevfsPresenceSource,
+    ErrorEvent,
+    LogFileErrorSource,
+    TPUHealthChecker,
+)
+
+__all__ = [
+    "DevfsPresenceSource",
+    "ErrorEvent",
+    "LogFileErrorSource",
+    "TPUHealthChecker",
+]

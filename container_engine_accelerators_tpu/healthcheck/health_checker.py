@@ -1,0 +1,247 @@
+"""TPU health checker.
+
+The reference subscribes to NVML XID events and (a) flips devices to
+Unhealthy over a channel into ListAndWatch, (b) maintains a Node condition
+`XidCriticalError` whose Reason carries a JSON error map and whose Message
+carries the boot ID, with a heartbeat and a reset-on-reboot path
+(reference health_check/health_checker.go:163-241 start, :288-346
+condition, :101-160 bootID reset, :348-384 heartbeat).
+
+TPUs expose no event API — health is *polled* (SURVEY.md §7 hard part b):
+
+  - LogFileErrorSource tails a JSONL error feed (the contract the TPU
+    runtime/driver writes on GKE nodes; also the fault-injection hook used
+    by demo/tpu-error)
+  - DevfsPresenceSource reports CHIP_LOST when a chip node vanishes
+
+De-flapping: a device only transitions Healthy -> Unhealthy here; recovery
+is a node repair (bootID change clears the condition, plugin restart
+rebuilds device state) — same recovery contract as the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+
+from container_engine_accelerators_tpu.deviceplugin.manager import UNHEALTHY
+
+log = logging.getLogger(__name__)
+
+NODE_CONDITION_TYPE = "TpuCriticalError"
+BOOT_ID_PATH = "/proc/sys/kernel/random/boot_id"
+DEFAULT_ERROR_LOG = "/var/log/tpu/errors.jsonl"
+HEARTBEAT_INTERVAL = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorEvent:
+    chip_index: int          # -1 = whole host
+    error_class: str
+    message: str = ""
+
+
+class LogFileErrorSource:
+    """Tail a JSONL file of {"chip": N, "class": "...", "message": "..."}
+    records, tolerating rotation/truncation."""
+
+    def __init__(self, path: str = DEFAULT_ERROR_LOG):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> list[ErrorEvent]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._offset:  # rotated/truncated
+            self._offset = 0
+        if size == self._offset:
+            return []
+        events = []
+        with open(self.path) as f:
+            f.seek(self._offset)
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # partial write; re-read next poll
+                self._offset += len(line.encode())
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    events.append(ErrorEvent(
+                        chip_index=int(rec.get("chip", -1)),
+                        error_class=str(rec["class"]),
+                        message=str(rec.get("message", ""))))
+                except (ValueError, KeyError):
+                    log.warning("malformed error record: %r", line)
+        return events
+
+
+class DevfsPresenceSource:
+    """CHIP_LOST when a previously seen chip node disappears."""
+
+    def __init__(self, device_info):
+        self.device_info = device_info
+        self._seen: set[int] = {c.index for c in device_info.discover()}
+        self._reported: set[int] = set()
+
+    def poll(self) -> list[ErrorEvent]:
+        current = {c.index for c in self.device_info.discover()}
+        lost = self._seen - current - self._reported
+        self._reported |= lost
+        self._reported -= current  # chip returned: arm for re-report
+        self._seen |= current
+        return [ErrorEvent(chip_index=i, error_class="CHIP_LOST",
+                           message=f"/dev/accel{i} disappeared")
+                for i in sorted(lost)]
+
+
+class TPUHealthChecker:
+    def __init__(self, manager, config, sources=None, k8s=None,
+                 node_name: str | None = None,
+                 poll_interval: float = 5.0,
+                 boot_id_path: str = BOOT_ID_PATH,
+                 error_log_path: str = DEFAULT_ERROR_LOG):
+        self.manager = manager
+        self.config = config
+        self.sources = sources if sources is not None else [
+            LogFileErrorSource(error_log_path),
+            DevfsPresenceSource(manager.device_info),
+        ]
+        self.k8s = k8s
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
+        self.poll_interval = poll_interval
+        self.boot_id_path = boot_id_path
+        self.error_counts: dict[str, int] = {}
+        self._stopped = False
+        self._last_heartbeat = 0.0
+
+    # ---------- lifecycle ----------
+
+    def stop(self):
+        self._stopped = True
+
+    def run(self):
+        """Poll loop. Resets a stale Node condition first if the node
+        rebooted since it was set (reference resetXIDCondition
+        :129-160)."""
+        self.maybe_reset_condition()
+        while not self._stopped:
+            self.poll_once()
+            time.sleep(self.poll_interval)
+
+    # ---------- single iteration (test entry point) ----------
+
+    def poll_once(self):
+        for source in self.sources:
+            try:
+                events = source.poll()
+            except Exception:
+                log.exception("error source %r failed", source)
+                continue
+            for ev in events:
+                self.handle_event(ev)
+        if self.k8s and self.error_counts:
+            now = time.monotonic()
+            if now - self._last_heartbeat >= HEARTBEAT_INTERVAL:
+                self._last_heartbeat = now
+                self.update_condition()
+
+    def handle_event(self, ev: ErrorEvent):
+        log.warning("TPU error: chip=%d class=%s %s",
+                    ev.chip_index, ev.error_class, ev.message)
+        self.error_counts[ev.error_class] = (
+            self.error_counts.get(ev.error_class, 0) + 1)
+        critical = ev.error_class in self.config.health_critical_errors
+        if critical:
+            if ev.chip_index < 0:
+                for dev_id in list(self.manager.devices):
+                    self.manager.set_device_health(dev_id, UNHEALTHY)
+            else:
+                self.manager.set_chip_health(ev.chip_index, UNHEALTHY)
+        if self.k8s:
+            self.record_event(ev, critical)
+            self.update_condition()
+
+    # ---------- K8s surface ----------
+
+    def boot_id(self) -> str:
+        try:
+            with open(self.boot_id_path) as f:
+                return f.read().strip()
+        except OSError:
+            return "unknown"
+
+    def record_event(self, ev: ErrorEvent, critical: bool):
+        ns = "default"
+        try:
+            self.k8s.create_event(ns, {
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {
+                    "generateName": "tpu-error-",
+                    "namespace": ns},
+                "involvedObject": {"kind": "Node", "name": self.node_name},
+                "reason": ev.error_class,
+                "message": (f"TPU chip {ev.chip_index}: {ev.message}"
+                            if ev.chip_index >= 0 else ev.message),
+                "type": "Warning" if critical else "Normal",
+                "source": {"component": "tpu-device-plugin",
+                           "host": self.node_name},
+            })
+        except Exception:
+            log.exception("failed to create event")
+
+    def _condition(self, status: str, reason: str, message: str) -> dict:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        return {"type": NODE_CONDITION_TYPE, "status": status,
+                "reason": reason, "message": message,
+                "lastHeartbeatTime": now, "lastTransitionTime": now}
+
+    def update_condition(self):
+        """Condition True with the error-count map in Reason-adjacent
+        message JSON + bootID, driving external node auto-repair
+        (reference monitorXidevent :288-346)."""
+        payload = json.dumps({"errors": self.error_counts,
+                              "bootID": self.boot_id()}, sort_keys=True)
+        try:
+            self.k8s.set_node_condition(
+                self.node_name,
+                self._condition("True", "TpuErrorsObserved", payload))
+        except Exception:
+            log.exception("failed to set node condition")
+
+    def maybe_reset_condition(self, max_attempts: int = 3):
+        """If the stored condition's bootID differs from the current one,
+        the node was repaired/rebooted -> clear the condition."""
+        if not self.k8s:
+            return
+        for attempt in range(max_attempts):
+            try:
+                node = self.k8s.get_node(self.node_name)
+                conds = (node.get("status", {}) or {}).get("conditions", [])
+                cond = next((c for c in conds
+                             if c.get("type") == NODE_CONDITION_TYPE), None)
+                if not cond or cond.get("status") != "True":
+                    return
+                stored = ""
+                try:
+                    stored = json.loads(cond.get("message", "{}")).get(
+                        "bootID", "")
+                except ValueError:
+                    pass
+                if stored and stored == self.boot_id():
+                    return  # same boot: errors still current
+                self.k8s.set_node_condition(
+                    self.node_name,
+                    self._condition("False", "NodeRebooted",
+                                    json.dumps({"bootID": self.boot_id()})))
+                log.info("cleared %s after reboot", NODE_CONDITION_TYPE)
+                return
+            except Exception:
+                log.exception("reset attempt %d failed", attempt)
+                time.sleep(2 ** attempt)

@@ -1,0 +1,25 @@
+"""Prometheus chip metrics (L2): per-node and per-container duty cycle /
+memory gauges with kubelet PodResources attribution — the analog of the
+reference's metrics package (reference pkg/gpu/nvidia/metrics/)."""
+
+from container_engine_accelerators_tpu.metrics.devices import (
+    PodResourcesClient,
+    PodResourcesStub,
+)
+from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.metrics.sampler import (
+    ChipSample,
+    FakeSampler,
+    SysfsSampler,
+    make_sampler,
+)
+
+__all__ = [
+    "PodResourcesClient",
+    "PodResourcesStub",
+    "MetricServer",
+    "ChipSample",
+    "FakeSampler",
+    "SysfsSampler",
+    "make_sampler",
+]

@@ -1,0 +1,148 @@
+"""Prometheus metric server: gauge names/semantics follow the reference
+(reference pkg/gpu/nvidia/metrics/metrics.go:59-115 — duty_cycle,
+memory_used/total, request_* — per node and per container via PodResources
+attribution), labeled for TPU chips.
+
+Serves on :2112/metrics like the reference
+(cmd/nvidia_gpu/nvidia_gpu.go:57).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import wsgiref.simple_server
+
+from prometheus_client import CollectorRegistry, Gauge, make_wsgi_app
+
+from container_engine_accelerators_tpu.deviceplugin import sharing
+
+log = logging.getLogger(__name__)
+
+CONTAINER_LABELS = ["namespace", "pod", "container", "tpu_chip", "model"]
+NODE_LABELS = ["tpu_chip", "model"]
+
+
+class MetricServer:
+    def __init__(self, manager, sampler=None, pod_resources=None,
+                 port: int = 2112, interval: float = 10.0):
+        from container_engine_accelerators_tpu.metrics.devices import (
+            PodResourcesClient,
+        )
+        from container_engine_accelerators_tpu.metrics.sampler import (
+            make_sampler,
+        )
+        self.manager = manager
+        self.sampler = sampler or make_sampler()
+        self.pod_resources = pod_resources or PodResourcesClient()
+        self.port = port
+        self.interval = interval
+        self._stop = threading.Event()
+
+        self.registry = CollectorRegistry()
+        self.duty_cycle = Gauge(
+            "duty_cycle", "TPU chip utilization percent, per container",
+            CONTAINER_LABELS, registry=self.registry)
+        self.memory_used = Gauge(
+            "memory_used", "TPU HBM used bytes, per container",
+            CONTAINER_LABELS, registry=self.registry)
+        self.memory_total = Gauge(
+            "memory_total", "TPU HBM total bytes, per container",
+            CONTAINER_LABELS, registry=self.registry)
+        self.node_duty_cycle = Gauge(
+            "node_duty_cycle", "TPU chip utilization percent, per chip",
+            NODE_LABELS, registry=self.registry)
+        self.node_memory_used = Gauge(
+            "node_memory_used", "TPU HBM used bytes, per chip",
+            NODE_LABELS, registry=self.registry)
+        self.node_memory_total = Gauge(
+            "node_memory_total", "TPU HBM total bytes, per chip",
+            NODE_LABELS, registry=self.registry)
+        self.request_count = Gauge(
+            "request", "TPU chips requested by container",
+            ["namespace", "pod", "container"], registry=self.registry)
+
+    # ---------- metric computation ----------
+
+    def _device_chips(self, device_id: str) -> list[int]:
+        try:
+            return [c.index for c in self.manager.chips_for_device(device_id)]
+        except KeyError:
+            # Device vanished between attribution and sampling.
+            if sharing.is_virtual_id(device_id):
+                device_id = sharing.virtual_to_physical(device_id)
+            digits = "".join(ch for ch in device_id if ch.isdigit())
+            return [int(digits)] if digits else []
+
+    def update_once(self) -> None:
+        model = self.manager.device_info.chip_generation()
+
+        # Node-level: every discovered chip.
+        for chip in sorted(self.manager._chips):
+            s = self.sampler.sample(chip)
+            if s is None:
+                continue
+            labels = dict(tpu_chip=f"accel{chip}", model=model)
+            self.node_duty_cycle.labels(**labels).set(s.duty_cycle_pct)
+            self.node_memory_used.labels(**labels).set(s.memory_used_bytes)
+            self.node_memory_total.labels(**labels).set(s.memory_total_bytes)
+
+        # Container-level: PodResources attribution (reference
+        # devices.go:51-101). Clear first so exited pods drop out (the
+        # 1-minute reset loop of metrics.go:241-253).
+        self.duty_cycle.clear()
+        self.memory_used.clear()
+        self.memory_total.clear()
+        self.request_count.clear()
+        try:
+            attributions = self.pod_resources.containers_with_devices()
+        except Exception:
+            log.exception("PodResources query failed")
+            return
+        for attr in attributions:
+            chips = sorted({c for d in attr.device_ids
+                            for c in self._device_chips(d)})
+            self.request_count.labels(
+                namespace=attr.namespace, pod=attr.pod,
+                container=attr.container).set(len(attr.device_ids))
+            for chip in chips:
+                s = self.sampler.sample(chip)
+                if s is None:
+                    continue
+                labels = dict(namespace=attr.namespace, pod=attr.pod,
+                              container=attr.container,
+                              tpu_chip=f"accel{chip}", model=model)
+                self.duty_cycle.labels(**labels).set(s.duty_cycle_pct)
+                self.memory_used.labels(**labels).set(s.memory_used_bytes)
+                self.memory_total.labels(**labels).set(s.memory_total_bytes)
+
+    # ---------- serving ----------
+
+    def start_background(self):
+        app = make_wsgi_app(self.registry)
+        self._httpd = wsgiref.simple_server.make_server(
+            "", self.port, app,
+            handler_class=_QuietHandler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="metrics-http").start()
+        threading.Thread(target=self._update_loop, daemon=True,
+                         name="metrics-update").start()
+        log.info("metrics serving on :%d/metrics", self.port)
+
+    def _update_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.update_once()
+            except Exception:
+                log.exception("metrics update failed")
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if hasattr(self, "_httpd"):
+            self._httpd.shutdown()
+
+
+class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, *args):
+        pass

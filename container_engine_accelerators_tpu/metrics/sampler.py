@@ -1,0 +1,139 @@
+"""Per-chip utilization/memory sampling.
+
+The reference needs a cgo shim because NVML's sample buffer API has no Go
+binding (reference pkg/gpu/nvidia/metrics/util.go:17-88,
+nvmlDeviceGetAverageUsage averages ~6 samples/s over a 100-sample buffer).
+The TPU analog reads the accel driver's sysfs counters; the native
+libtpudev.so (native/tpudev, C++) does the windowed duty-cycle averaging
+and is loaded via ctypes, with a pure-Python fallback so the plugin
+degrades gracefully where the shim isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import logging
+import os
+import time
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SYSFS_ACCEL_ROOT = "/sys/class/accel"
+LIBTPUDEV_ENV = "LIBTPUDEV_PATH"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSample:
+    duty_cycle_pct: float      # 0-100 average over the sampling window
+    memory_used_bytes: int
+    memory_total_bytes: int
+
+
+class SysfsSampler:
+    """Read per-chip counters from the accel driver's sysfs files.
+
+    Contract (mirrors the driver's exposure on GKE TPU hosts; also written
+    by tests and the fault-injection demo):
+      <root>/accelN/device/mem_used       bytes
+      <root>/accelN/device/mem_total      bytes
+      <root>/accelN/device/busy_time_ms   cumulative busy milliseconds
+
+    Duty cycle is the delta of busy_time over the wall-clock delta between
+    polls — the windowed-average role of the reference's cgo shim.
+    """
+
+    def __init__(self, sysfs_accel_root: str = DEFAULT_SYSFS_ACCEL_ROOT):
+        self.root = sysfs_accel_root
+        self._last: dict[int, tuple[float, float]] = {}  # chip -> (t, busy_ms)
+
+    def _read(self, chip: int, name: str) -> float | None:
+        path = os.path.join(self.root, f"accel{chip}", "device", name)
+        try:
+            with open(path) as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def sample(self, chip: int) -> ChipSample | None:
+        used = self._read(chip, "mem_used")
+        total = self._read(chip, "mem_total")
+        busy = self._read(chip, "busy_time_ms")
+        if total is None and busy is None:
+            return None
+        duty = 0.0
+        now = time.monotonic()
+        if busy is not None:
+            prev = self._last.get(chip)
+            self._last[chip] = (now, busy)
+            if prev and now > prev[0]:
+                duty = max(0.0, min(
+                    100.0, (busy - prev[1]) / ((now - prev[0]) * 1000) * 100))
+        return ChipSample(duty_cycle_pct=duty,
+                          memory_used_bytes=int(used or 0),
+                          memory_total_bytes=int(total or 0))
+
+
+class NativeSampler:
+    """ctypes binding over native/tpudev's libtpudev.so (C++), which keeps
+    a background sampling thread per chip — higher resolution than the
+    poll-delta python fallback."""
+
+    def __init__(self, lib_path: str):
+        self.lib = ctypes.CDLL(lib_path)
+        self.lib.tpudev_sample.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        self.lib.tpudev_sample.restype = ctypes.c_int
+        if hasattr(self.lib, "tpudev_set_sysfs_root"):
+            self.lib.tpudev_set_sysfs_root.argtypes = [ctypes.c_char_p]
+
+    def set_sysfs_root(self, root: str) -> None:
+        self.lib.tpudev_set_sysfs_root(root.encode())
+
+    def sample(self, chip: int) -> ChipSample | None:
+        duty = ctypes.c_double()
+        used = ctypes.c_longlong()
+        total = ctypes.c_longlong()
+        rc = self.lib.tpudev_sample(chip, ctypes.byref(duty),
+                                    ctypes.byref(used), ctypes.byref(total))
+        if rc != 0:
+            return None
+        return ChipSample(duty_cycle_pct=duty.value,
+                          memory_used_bytes=used.value,
+                          memory_total_bytes=total.value)
+
+
+class FakeSampler:
+    def __init__(self, samples: dict[int, ChipSample]):
+        self.samples = samples
+
+    def sample(self, chip: int) -> ChipSample | None:
+        return self.samples.get(chip)
+
+
+def make_sampler(sysfs_accel_root: str = DEFAULT_SYSFS_ACCEL_ROOT):
+    """Prefer the native shim when built/installed; fall back to sysfs."""
+    candidates = []
+    env = os.environ.get(LIBTPUDEV_ENV)
+    if env:
+        candidates.append(env)
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    candidates += [
+        os.path.join(here, "native", "build", "libtpudev.so"),
+        "/usr/local/lib/libtpudev.so",
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            try:
+                sampler = NativeSampler(path)
+                if sysfs_accel_root != DEFAULT_SYSFS_ACCEL_ROOT:
+                    sampler.set_sysfs_root(sysfs_accel_root)
+                log.info("using native sampler %s", path)
+                return sampler
+            except OSError:
+                log.warning("failed to load %s; falling back", path)
+    return SysfsSampler(sysfs_accel_root)

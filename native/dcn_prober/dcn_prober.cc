@@ -1,0 +1,156 @@
+// dcn-prober — host-to-host TCP bandwidth prober for the DCN path.
+//
+// Role: the reference validates its cross-host datapath with nccl-tests
+// over the installed net plugin (reference gpudirect-tcpx/nccl-config.yaml
+// :31-57 runs all_gather_perf via mpirun). On TPU, the ICI path is probed
+// in JAX (ops/collectives.py); the *DCN* leg between slices is plain
+// networking, so this native tool measures per-stream and aggregate TCP
+// throughput between two pods/hosts before a multislice job runs —
+// the bring-up check that replaces the 2-node nccl-test pod pair.
+//
+//   server: dcn-prober -s [-p PORT]
+//   client: dcn-prober -c HOST [-p PORT] [-n STREAMS] [-t SECONDS]
+//                      [-b BUFFER_KB]
+// Client prints one JSON line: {"streams":N,"seconds":S,"gbytes":G,
+// "gbps_total":X,"gbps_per_stream":Y}.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kDefaultPort = 18515;
+
+int Die(const char* what) {
+  std::perror(what);
+  std::exit(1);
+}
+
+void RunServer(int port) {
+  int lfd = socket(AF_INET6, SOCK_STREAM, 0);
+  if (lfd < 0) Die("socket");
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  int zero = 0;
+  setsockopt(lfd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+  sockaddr_in6 addr{};
+  addr.sin6_family = AF_INET6;
+  addr.sin6_addr = in6addr_any;
+  addr.sin6_port = htons(port);
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    Die("bind");
+  if (listen(lfd, 64) < 0) Die("listen");
+  std::fprintf(stderr, "dcn-prober: listening on :%d\n", port);
+  for (;;) {
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread([fd] {
+      std::vector<char> buf(1 << 20);
+      long long total = 0;
+      ssize_t n;
+      while ((n = read(fd, buf.data(), buf.size())) > 0) total += n;
+      close(fd);
+      std::fprintf(stderr, "dcn-prober: stream done, %.3f GB received\n",
+                   total / 1e9);
+    }).detach();
+  }
+}
+
+void RunClient(const std::string& host, int port, int streams, double seconds,
+               int buffer_kb) {
+  addrinfo hints{};
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+    std::fprintf(stderr, "dcn-prober: cannot resolve %s\n", host.c_str());
+    std::exit(1);
+  }
+  std::atomic<long long> total_bytes{0};
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+  for (int i = 0; i < streams; ++i) {
+    workers.emplace_back([&, i] {
+      int fd = socket(res->ai_family, SOCK_STREAM, 0);
+      if (fd < 0) Die("socket");
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      if (connect(fd, res->ai_addr, res->ai_addrlen) < 0) Die("connect");
+      std::vector<char> buf(static_cast<size_t>(buffer_kb) << 10, 0x5a);
+      long long sent = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        ssize_t n = write(fd, buf.data(), buf.size());
+        if (n <= 0) break;
+        sent += n;
+      }
+      shutdown(fd, SHUT_WR);
+      close(fd);
+      total_bytes.fetch_add(sent);
+    });
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(std::chrono::duration<double>(seconds));
+  stop.store(true);
+  for (auto& w : workers) w.join();
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  freeaddrinfo(res);
+  double gb = total_bytes.load() / 1e9;
+  std::printf(
+      "{\"streams\":%d,\"seconds\":%.2f,\"gbytes\":%.3f,"
+      "\"gbps_total\":%.3f,\"gbps_per_stream\":%.3f}\n",
+      streams, dt, gb, gb * 8 / dt, gb * 8 / dt / streams);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool server = false;
+  std::string host;
+  int port = kDefaultPort;
+  int streams = 4;
+  double seconds = 5.0;
+  int buffer_kb = 1024;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "-s")) server = true;
+    else if (!std::strcmp(argv[i], "-c") && i + 1 < argc) host = argv[++i];
+    else if (!std::strcmp(argv[i], "-p") && i + 1 < argc)
+      port = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "-n") && i + 1 < argc)
+      streams = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "-t") && i + 1 < argc)
+      seconds = std::atof(argv[++i]);
+    else if (!std::strcmp(argv[i], "-b") && i + 1 < argc)
+      buffer_kb = std::atoi(argv[++i]);
+    else {
+      std::fprintf(stderr,
+                   "usage: dcn-prober -s [-p PORT] | -c HOST [-p PORT] "
+                   "[-n STREAMS] [-t SECONDS] [-b BUFFER_KB]\n");
+      return 2;
+    }
+  }
+  if (server) {
+    RunServer(port);
+  } else if (!host.empty()) {
+    RunClient(host, port, streams, seconds, buffer_kb);
+  } else {
+    std::fprintf(stderr, "dcn-prober: need -s or -c HOST\n");
+    return 2;
+  }
+  return 0;
+}

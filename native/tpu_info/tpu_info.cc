@@ -1,0 +1,101 @@
+// tpu-info — chip inventory / status CLI.
+//
+// Role: the reference execs nvidia-smi for partition state and status
+// (reference partition_gpu/partition_gpu.go:254-345); TPU hosts have no
+// vendor CLI in this stack, so this binary is the native status tool the
+// partition_tpu one-shot and operators use. Reads the same devfs/sysfs
+// contract as libtpudev.
+//
+// Output (stable, parse-friendly — partition_tpu greps it the way the
+// reference parses `nvidia-smi mig -lgi` tables):
+//   CHIP  PATH         NUMA  MEM_USED     MEM_TOTAL    DUTY%
+//   0     /dev/accel0  0     1073741824   17179869184  37.5
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <dirent.h>
+
+extern "C" {
+int tpudev_chip_count(void);
+int tpudev_sample(int chip, double* duty_pct, long long* mem_used,
+                  long long* mem_total);
+void tpudev_set_sysfs_root(const char* root);
+void tpudev_set_dev_root(const char* root);
+}
+
+namespace {
+
+std::string g_dev_root = "/dev";
+std::string g_sysfs_root = "/sys/class/accel";
+
+int ReadNuma(int chip) {
+  std::string path =
+      g_sysfs_root + "/accel" + std::to_string(chip) + "/device/numa_node";
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (!f) return -1;
+  int numa = -1;
+  if (std::fscanf(f, "%d", &numa) != 1) numa = -1;
+  std::fclose(f);
+  return numa;
+}
+
+std::vector<int> ScanChips() {
+  std::vector<int> chips;
+  DIR* d = opendir(g_dev_root.c_str());
+  if (!d) return chips;
+  while (dirent* e = readdir(d)) {
+    int idx;
+    char extra;
+    if (std::sscanf(e->d_name, "accel%d%c", &idx, &extra) == 1) {
+      chips.push_back(idx);
+    }
+  }
+  closedir(d);
+  return chips;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--dev-root") && i + 1 < argc) {
+      g_dev_root = argv[++i];
+      tpudev_set_dev_root(g_dev_root.c_str());
+    } else if (!std::strcmp(argv[i], "--sysfs-root") && i + 1 < argc) {
+      g_sysfs_root = argv[++i];
+      tpudev_set_sysfs_root(g_sysfs_root.c_str());
+    } else if (!std::strcmp(argv[i], "--help")) {
+      std::printf("usage: tpu-info [--dev-root DIR] [--sysfs-root DIR]\n");
+      return 0;
+    } else {
+      std::fprintf(stderr, "unknown flag %s\n", argv[i]);
+      return 2;
+    }
+  }
+
+  std::vector<int> chips = ScanChips();
+  std::printf("%-5s %-20s %-5s %-13s %-13s %-6s\n", "CHIP", "PATH", "NUMA",
+              "MEM_USED", "MEM_TOTAL", "DUTY%");
+  for (int chip : chips) {
+    double duty = 0;
+    long long used = 0, total = 0;
+    int rc = tpudev_sample(chip, &duty, &used, &total);
+    std::string path = g_dev_root + "/accel" + std::to_string(chip);
+    if (rc == 0) {
+      std::printf("%-5d %-20s %-5d %-13lld %-13lld %-6.1f\n", chip,
+                  path.c_str(), ReadNuma(chip), used, total, duty);
+    } else {
+      std::printf("%-5d %-20s %-5d %-13s %-13s %-6s\n", chip, path.c_str(),
+                  ReadNuma(chip), "-", "-", "-");
+    }
+  }
+  if (chips.empty()) {
+    std::fprintf(stderr, "no TPU chips found under %s\n",
+                 g_dev_root.c_str());
+    return 1;
+  }
+  return 0;
+}

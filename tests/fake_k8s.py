@@ -1,0 +1,161 @@
+"""In-process fake Kubernetes API server — the fake.Clientset analog the
+reference tests assert against (reference health_checker_test.go:26-31).
+
+Serves a minimal object store over HTTP: nodes + pods + events, with
+strategic-merge-patch handling for node conditions (merge key `type`) and
+metadata merges. Tests point K8sClient.base_url here and assert on
+`requests` / the object store."""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeK8s:
+    def __init__(self):
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.events: list[dict] = []
+        self.bindings: list[dict] = []
+        self.requests: list[tuple[str, str]] = []  # (method, path)
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else None
+
+            def _send(self, obj, status=200):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                fake.requests.append(("GET", self.path))
+                path = self.path.split("?")[0]
+                m = re.fullmatch(r"/api/v1/nodes/([^/]+)", path)
+                if m:
+                    node = fake.nodes.get(m.group(1))
+                    return (self._send(node) if node else
+                            self._send({"message": "not found"}, 404))
+                if path == "/api/v1/nodes":
+                    return self._send({"items": list(fake.nodes.values())})
+                m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)",
+                                 path)
+                if m:
+                    pod = fake.pods.get((m.group(1), m.group(2)))
+                    return (self._send(pod) if pod else
+                            self._send({"message": "not found"}, 404))
+                if path == "/api/v1/pods" or re.fullmatch(
+                        r"/api/v1/namespaces/[^/]+/pods", path):
+                    items = [p for p in fake.pods.values()
+                             if self._pod_matches(p)]
+                    return self._send({"items": items})
+                return self._send({"message": "not found"}, 404)
+
+            def _pod_matches(self, pod):
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                fs = q.get("fieldSelector", [None])[0]
+                if fs:
+                    for clause in fs.split(","):
+                        key, _, val = clause.partition("=")
+                        if key == "status.phase" and \
+                                pod.get("status", {}).get("phase") != val:
+                            return False
+                        if key == "spec.nodeName" and \
+                                pod.get("spec", {}).get("nodeName") != val:
+                            return False
+                return True
+
+            def do_POST(self):
+                fake.requests.append(("POST", self.path))
+                path = self.path.split("?")[0]
+                body = self._body()
+                if re.fullmatch(r"/api/v1/namespaces/[^/]+/events", path):
+                    fake.events.append(body)
+                    return self._send(body, 201)
+                m = re.fullmatch(
+                    r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding", path)
+                if m:
+                    fake.bindings.append(body)
+                    pod = fake.pods.get((m.group(1), m.group(2)))
+                    if pod is not None:
+                        pod.setdefault("spec", {})["nodeName"] = \
+                            body["target"]["name"]
+                    return self._send({}, 201)
+                return self._send({"message": "not found"}, 404)
+
+            def do_PUT(self):
+                fake.requests.append(("PUT", self.path))
+                path = self.path.split("?")[0]
+                body = self._body()
+                m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)",
+                                 path)
+                if m:
+                    fake.pods[(m.group(1), m.group(2))] = body
+                    return self._send(body)
+                return self._send({"message": "not found"}, 404)
+
+            def do_PATCH(self):
+                fake.requests.append(("PATCH", self.path))
+                path = self.path.split("?")[0]
+                body = self._body()
+                m = re.fullmatch(r"/api/v1/nodes/([^/]+)(/status)?", path)
+                if m:
+                    node = fake.nodes.setdefault(
+                        m.group(1),
+                        {"metadata": {"name": m.group(1)}, "status": {}})
+                    fake._merge(node, body)
+                    return self._send(node)
+                m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)",
+                                 path)
+                if m:
+                    pod = fake.pods.get((m.group(1), m.group(2)))
+                    if pod is None:
+                        return self._send({"message": "not found"}, 404)
+                    fake._merge(pod, body)
+                    return self._send(pod)
+                return self._send({"message": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # Strategic-merge-patch, scoped to what the clients send: dict merge,
+    # with status.conditions merged on the `type` key.
+    def _merge(self, target: dict, patch: dict):
+        for key, val in patch.items():
+            if key == "conditions" and isinstance(val, list):
+                existing = target.setdefault("conditions", [])
+                for cond in val:
+                    for i, c in enumerate(existing):
+                        if c.get("type") == cond.get("type"):
+                            existing[i] = copy.deepcopy(cond)
+                            break
+                    else:
+                        existing.append(copy.deepcopy(cond))
+            elif isinstance(val, dict) and isinstance(target.get(key), dict):
+                self._merge(target[key], val)
+            else:
+                target[key] = copy.deepcopy(val)

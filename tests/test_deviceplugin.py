@@ -1,0 +1,320 @@
+"""Device plugin: discovery over fake /dev trees, sharing/subslice rules,
+and the full kubelet contract driven end-to-end in one process via a
+KubeletStub (SURVEY.md §4: the reference tests ListAndWatch/Allocate and
+the hot-restart path with an in-process registration server +
+real gRPC client; same here)."""
+
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    HEALTHY,
+    UNHEALTHY,
+    MockDeviceInfo,
+    SharingConfig,
+    TPUConfig,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.deviceplugin import (
+    config as tpu_config,
+    sharing,
+    subslice,
+)
+from container_engine_accelerators_tpu.deviceplugin.api import (
+    DevicePluginStub,
+    RegistrationServicer,
+    add_registration_servicer,
+    deviceplugin_pb2 as pb,
+)
+from container_engine_accelerators_tpu.deviceplugin.devutil import SysfsDeviceInfo
+from container_engine_accelerators_tpu.deviceplugin.manager import (
+    KUBELET_SOCKET,
+    PLUGIN_SOCKET,
+)
+
+
+def make_fake_devfs(tmp_path, n=4):
+    dev = tmp_path / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(n):
+        (dev / f"accel{i}").touch()
+    (dev / "null").touch()     # non-accel noise
+    (dev / "accelX").touch()   # malformed name, must be ignored
+    return str(dev)
+
+
+# ---------- config ----------
+
+def test_config_defaults_and_env_override(tmp_path, monkeypatch):
+    cfg = tpu_config.load(None)
+    assert cfg.chips_per_partition == 0
+    monkeypatch.setenv("TPU_HEALTH_CONFIG", "CHIP_LOST,RUNTIME_HANG")
+    cfg = tpu_config.load(None)
+    assert cfg.health_critical_errors == ("CHIP_LOST", "RUNTIME_HANG")
+
+
+def test_config_json_file(tmp_path):
+    p = tmp_path / "tpu_config.json"
+    p.write_text('{"chipsPerPartition": 2, '
+                 '"healthCriticalErrors": ["CHIP_LOST"]}')
+    cfg = tpu_config.load(str(p))
+    assert cfg.chips_per_partition == 2
+    assert cfg.health_critical_errors == ("CHIP_LOST",)
+
+
+def test_config_validation_rejects_bad_combos():
+    with pytest.raises(ValueError):
+        TPUConfig(chips_per_partition=2,
+                  sharing=SharingConfig("time-sharing", 4)).validate()
+    with pytest.raises(ValueError):
+        TPUConfig(sharing=SharingConfig("mps", 4)).validate()
+    with pytest.raises(ValueError):
+        TPUConfig(sharing=SharingConfig("time-sharing", 1)).validate()
+    with pytest.raises(ValueError):
+        TPUConfig(health_critical_errors=("NOT_A_CLASS",)).validate()
+
+
+# ---------- sharing ----------
+
+def test_sharing_ids_roundtrip():
+    vid = sharing.virtual_id("accel0", 3)
+    assert vid == "accel0/vtpu3"
+    assert sharing.is_virtual_id(vid)
+    assert not sharing.is_virtual_id("accel0")
+    assert sharing.virtual_to_physical(vid) == "accel0"
+    with pytest.raises(ValueError):
+        sharing.virtual_to_physical("accel0")
+    with pytest.raises(ValueError):
+        sharing.virtual_to_physical("accel0/vtpuX")
+
+
+def test_sharing_request_validation():
+    sharing.validate_request(["accel0"], sharing_enabled=False)
+    with pytest.raises(ValueError):
+        sharing.validate_request(["accel0/vtpu0"], sharing_enabled=False)
+    sharing.validate_request(["accel0/vtpu1"], sharing_enabled=True)
+    with pytest.raises(ValueError):
+        sharing.validate_request(["accel0/vtpu0", "accel1/vtpu0"],
+                                 sharing_enabled=True)
+    with pytest.raises(ValueError):
+        sharing.validate_request(["accel0"], sharing_enabled=True)
+
+
+# ---------- subslice ----------
+
+def test_subslice_partition(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=4)
+    chips = MockDeviceInfo(dev, numa_nodes={0: 0, 1: 0, 2: 1, 3: 1}).discover()
+    subs = subslice.partition(chips, 2)
+    assert [s.id for s in subs] == ["tpu-sub0-2", "tpu-sub1-2"]
+    assert subs[0].numa_node == 0 and subs[1].numa_node == 1
+    assert subslice.parse_subslice_id("tpu-sub1-2") == (1, 2)
+    with pytest.raises(ValueError):
+        subslice.partition(chips, 3)
+    with pytest.raises(ValueError):
+        subslice.parse_subslice_id("accel0")
+
+
+# ---------- sysfs discovery over fake trees ----------
+
+def test_sysfs_discovery_fake_tree(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=2)
+    sysfs = tmp_path / "sys" / "class" / "accel"
+    for i, numa in enumerate([0, 1]):
+        d = sysfs / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "numa_node").write_text(f"{numa}\n")
+    info = SysfsDeviceInfo(dev_root=dev, sysfs_accel_root=str(sysfs))
+    chips = info.discover()
+    assert [c.index for c in chips] == [0, 1]
+    assert [c.numa_node for c in chips] == [0, 1]
+
+
+def test_sysfs_discovery_missing_roots():
+    info = SysfsDeviceInfo(dev_root="/nonexistent-dev-root")
+    assert info.discover() == []
+
+
+# ---------- manager ----------
+
+def test_manager_discovery_modes(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=4)
+    info = MockDeviceInfo(dev, numa_nodes={i: i // 2 for i in range(4)})
+
+    m = TPUManager(TPUConfig(), info)
+    m.discover()
+    assert sorted(m.devices) == ["accel0", "accel1", "accel2", "accel3"]
+    assert m.devices["accel2"].topology.nodes[0].ID == 1
+
+    m = TPUManager(TPUConfig(sharing=SharingConfig("time-sharing", 2)), info)
+    m.discover()
+    assert len(m.devices) == 8
+    assert "accel0/vtpu0" in m.devices
+
+    m = TPUManager(TPUConfig(chips_per_partition=2), info)
+    m.discover()
+    assert sorted(m.devices) == ["tpu-sub0-2", "tpu-sub1-2"]
+
+
+def test_manager_health_propagation(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=2)
+    info = MockDeviceInfo(dev)
+    m = TPUManager(TPUConfig(sharing=SharingConfig("time-sharing", 2)), info)
+    m.discover()
+    m.set_chip_health(0, UNHEALTHY)
+    assert m.devices["accel0/vtpu0"].health == UNHEALTHY
+    assert m.devices["accel0/vtpu1"].health == UNHEALTHY
+    assert m.devices["accel1/vtpu0"].health == HEALTHY
+    # Health survives rediscovery (old_health carry-over).
+    m.discover()
+    assert m.devices["accel0/vtpu0"].health == UNHEALTHY
+
+
+def test_manager_envs_and_specs(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=4)
+    info = MockDeviceInfo(dev)
+    m = TPUManager(TPUConfig(chips_per_partition=2), info,
+                   libtpu_host_dir="/host/tpu")
+    m.discover()
+    specs = m.device_specs(["tpu-sub1-2"])
+    assert [s.host_path for s in specs] == [f"{dev}/accel2", f"{dev}/accel3"]
+    envs = m.envs(["tpu-sub1-2"])
+    assert envs["TPU_VISIBLE_CHIPS"] == "2,3"
+    mounts = m.mounts()
+    assert mounts[0].host_path == "/host/tpu" and mounts[0].read_only
+
+
+# ---------- end-to-end over real gRPC: KubeletStub pattern ----------
+
+class KubeletStub(RegistrationServicer):
+    """In-process kubelet: accepts Register calls on kubelet.sock."""
+
+    def __init__(self, plugin_dir: str):
+        self.plugin_dir = plugin_dir
+        self.requests = []
+        self.event = threading.Event()
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        add_registration_servicer(self, self.server)
+        self.sock = os.path.join(plugin_dir, KUBELET_SOCKET)
+        self.server.add_insecure_port(f"unix://{self.sock}")
+        self.server.start()
+
+    def Register(self, request, context):
+        self.requests.append(request)
+        self.event.set()
+        return pb.Empty()
+
+    def wait_for_registration(self, timeout=10.0) -> pb.RegisterRequest:
+        assert self.event.wait(timeout), "plugin never registered"
+        self.event.clear()
+        return self.requests[-1]
+
+    def stop(self):
+        self.server.stop(grace=0.2).wait()
+
+
+@pytest.fixture
+def served_manager(tmp_path):
+    """Real manager serve loop + KubeletStub + DevicePlugin client."""
+    dev = make_fake_devfs(tmp_path, n=2)
+    plugin_dir = str(tmp_path / "device-plugin")
+    os.makedirs(plugin_dir)
+    info = MockDeviceInfo(dev)
+    m = TPUManager(TPUConfig(), info, plugin_dir=plugin_dir,
+                   poll_interval=0.05, chip_check_interval=0.3)
+    m.discover()
+    stub = KubeletStub(plugin_dir)
+    t = threading.Thread(target=m.serve, daemon=True)
+    t.start()
+    req = stub.wait_for_registration()
+    channel = grpc.insecure_channel(
+        f"unix://{os.path.join(plugin_dir, PLUGIN_SOCKET)}")
+    grpc.channel_ready_future(channel).result(timeout=10)
+    client = DevicePluginStub(channel)
+    yield m, stub, client, req, dev, plugin_dir
+    m.stop()
+    channel.close()
+    stub.stop()
+    t.join(timeout=5)
+
+
+def test_e2e_registration_and_listandwatch(served_manager):
+    m, stub, client, req, dev, plugin_dir = served_manager
+    assert req.resource_name == "google.com/tpu"
+    assert req.version == "v1beta1"
+    stream = client.ListAndWatch(pb.Empty())
+    first = next(stream)
+    assert sorted(d.ID for d in first.devices) == ["accel0", "accel1"]
+    assert all(d.health == HEALTHY for d in first.devices)
+    # Health flip streams an update.
+    m.set_chip_health(1, UNHEALTHY)
+    update = next(stream)
+    healths = {d.ID: d.health for d in update.devices}
+    assert healths["accel1"] == UNHEALTHY
+
+
+def test_e2e_allocate(served_manager):
+    m, stub, client, req, dev, plugin_dir = served_manager
+    resp = client.Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(
+            devicesIDs=["accel0", "accel1"])]))
+    cresp = resp.container_responses[0]
+    assert [d.host_path for d in cresp.devices] == [
+        f"{dev}/accel0", f"{dev}/accel1"]
+    assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert cresp.mounts[0].read_only
+
+
+def test_e2e_allocate_unknown_device(served_manager):
+    m, stub, client, req, dev, plugin_dir = served_manager
+    with pytest.raises(grpc.RpcError) as err:
+        client.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(
+                devicesIDs=["accel9"])]))
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_e2e_kubelet_restart_reregisters(served_manager):
+    m, stub, client, req, dev, plugin_dir = served_manager
+    # Simulate kubelet restart: recreate kubelet.sock (new inode; grpc
+    # removes the socket file on stop).
+    stub.stop()
+    stub2 = KubeletStub(plugin_dir)
+    try:
+        req2 = stub2.wait_for_registration(timeout=10)
+        assert req2.resource_name == "google.com/tpu"
+    finally:
+        stub2.stop()
+
+
+def test_e2e_new_chip_restarts_server(served_manager):
+    m, stub, client, req, dev, plugin_dir = served_manager
+    open(os.path.join(dev, "accel2"), "w").close()
+    # The chip re-scan must notice and re-register with a 3-device set.
+    stub.wait_for_registration(timeout=10)
+    deadline = time.time() + 5
+    while time.time() < deadline and len(m.devices) != 3:
+        time.sleep(0.05)
+    assert sorted(m.devices) == ["accel0", "accel1", "accel2"]
+
+
+def test_e2e_preferred_allocation(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=4)
+    info = MockDeviceInfo(dev, numa_nodes={0: 0, 1: 0, 2: 1, 3: 1})
+    m = TPUManager(TPUConfig(), info)
+    m.discover()
+    from container_engine_accelerators_tpu.deviceplugin.plugin_service import (
+        DevicePluginService,
+    )
+    svc = DevicePluginService(m)
+    resp = svc.GetPreferredAllocation(pb.PreferredAllocationRequest(
+        container_requests=[pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=["accel3", "accel1", "accel0", "accel2"],
+            allocation_size=2)]), None)
+    # Same-NUMA, lowest-index chips first.
+    assert list(resp.container_responses[0].deviceIDs) == ["accel0", "accel1"]

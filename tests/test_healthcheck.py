@@ -1,0 +1,204 @@
+"""Health checker + K8s client + version visibility against the fake API
+server (reference pattern: health_checker_test.go with fake.Clientset)."""
+
+import json
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    HEALTHY,
+    UNHEALTHY,
+    MockDeviceInfo,
+    TPUConfig,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.deviceplugin.version_visibility import (
+    publish_version_annotations,
+    read_libtpu_version,
+    version_annotations,
+)
+from container_engine_accelerators_tpu.healthcheck import (
+    DevfsPresenceSource,
+    ErrorEvent,
+    LogFileErrorSource,
+    TPUHealthChecker,
+)
+from container_engine_accelerators_tpu.k8s import ApiError, K8sClient
+from tests.fake_k8s import FakeK8s
+from tests.test_deviceplugin import make_fake_devfs
+
+
+@pytest.fixture
+def fake_k8s():
+    srv = FakeK8s()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(fake_k8s):
+    return K8sClient(fake_k8s.url)
+
+
+def make_manager(tmp_path, n=2, cfg=None):
+    dev = make_fake_devfs(tmp_path, n=n)
+    m = TPUManager(cfg or TPUConfig(), MockDeviceInfo(dev))
+    m.discover()
+    return m, dev
+
+
+def make_checker(tmp_path, manager, client, **kw):
+    boot = tmp_path / "boot_id"
+    boot.write_text("boot-1\n")
+    log_path = tmp_path / "errors.jsonl"
+    kw.setdefault("sources", [LogFileErrorSource(str(log_path))])
+    return TPUHealthChecker(
+        manager, manager.config, k8s=client, node_name="node-a",
+        boot_id_path=str(boot), **kw), log_path, boot
+
+
+# ---------- K8s client basics ----------
+
+def test_k8s_client_node_roundtrip(fake_k8s, client):
+    fake_k8s.nodes["node-a"] = {"metadata": {"name": "node-a"}, "status": {}}
+    assert client.get_node("node-a")["metadata"]["name"] == "node-a"
+    client.annotate_node("node-a", {"k": "v"})
+    assert fake_k8s.nodes["node-a"]["metadata"]["annotations"] == {"k": "v"}
+    with pytest.raises(ApiError) as e:
+        client.get_node("missing")
+    assert e.value.status == 404
+
+
+def test_k8s_client_condition_merge(fake_k8s, client):
+    client.set_node_condition("node-a", {"type": "A", "status": "True"})
+    client.set_node_condition("node-a", {"type": "B", "status": "True"})
+    client.set_node_condition("node-a", {"type": "A", "status": "False"})
+    conds = fake_k8s.nodes["node-a"]["status"]["conditions"]
+    assert {c["type"]: c["status"] for c in conds} == {
+        "A": "False", "B": "True"}
+
+
+# ---------- error sources ----------
+
+def test_logfile_source_tail_and_rotation(tmp_path):
+    path = tmp_path / "errors.jsonl"
+    src = LogFileErrorSource(str(path))
+    assert src.poll() == []
+    path.write_text('{"chip": 0, "class": "THERMAL_TRIP"}\n')
+    events = src.poll()
+    assert events == [ErrorEvent(0, "THERMAL_TRIP", "")]
+    assert src.poll() == []  # no re-delivery
+    with path.open("a") as f:
+        f.write('{"chip": 1, "class": "RUNTIME_HANG", "message": "stuck"}\n')
+        f.write("not-json\n")
+    events = src.poll()
+    assert events == [ErrorEvent(1, "RUNTIME_HANG", "stuck")]
+    # Rotation: smaller file re-read from zero.
+    path.write_text('{"chip": 2, "class": "CHIP_LOST"}\n')
+    assert src.poll() == [ErrorEvent(2, "CHIP_LOST", "")]
+
+
+def test_devfs_presence_source(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=2)
+    info = MockDeviceInfo(dev)
+    src = DevfsPresenceSource(info)
+    assert src.poll() == []
+    os.unlink(os.path.join(dev, "accel1"))
+    assert src.poll() == [ErrorEvent(1, "CHIP_LOST", "/dev/accel1 disappeared")]
+    assert src.poll() == []  # reported once
+
+
+# ---------- checker pipeline ----------
+
+def test_critical_error_marks_device_unhealthy(tmp_path, fake_k8s, client):
+    m, dev = make_manager(tmp_path)
+    checker, log_path, _ = make_checker(tmp_path, m, client)
+    log_path.write_text('{"chip": 0, "class": "HBM_ECC_UNCORRECTABLE"}\n')
+    checker.poll_once()
+    assert m.devices["accel0"].health == UNHEALTHY
+    assert m.devices["accel1"].health == HEALTHY
+    # Node condition set with error map + bootID.
+    cond = fake_k8s.nodes["node-a"]["status"]["conditions"][0]
+    assert cond["type"] == "TpuCriticalError" and cond["status"] == "True"
+    payload = json.loads(cond["message"])
+    assert payload["errors"] == {"HBM_ECC_UNCORRECTABLE": 1}
+    assert payload["bootID"] == "boot-1"
+    # Warning event recorded.
+    assert fake_k8s.events[0]["reason"] == "HBM_ECC_UNCORRECTABLE"
+    assert fake_k8s.events[0]["type"] == "Warning"
+
+
+def test_noncritical_error_keeps_device_healthy(tmp_path, fake_k8s, client):
+    m, dev = make_manager(tmp_path)
+    checker, log_path, _ = make_checker(tmp_path, m, client)
+    log_path.write_text('{"chip": 0, "class": "HBM_ECC_CORRECTABLE"}\n')
+    checker.poll_once()
+    assert m.devices["accel0"].health == HEALTHY
+    assert fake_k8s.events[0]["type"] == "Normal"
+    # Condition still surfaces the observation.
+    payload = json.loads(
+        fake_k8s.nodes["node-a"]["status"]["conditions"][0]["message"])
+    assert payload["errors"] == {"HBM_ECC_CORRECTABLE": 1}
+
+
+def test_hostwide_error_flips_all_devices(tmp_path, fake_k8s, client):
+    m, dev = make_manager(tmp_path)
+    checker, log_path, _ = make_checker(tmp_path, m, client)
+    log_path.write_text('{"class": "THERMAL_TRIP", "message": "host hot"}\n')
+    checker.poll_once()
+    assert all(d.health == UNHEALTHY for d in m.devices.values())
+
+
+def test_boot_id_reset_clears_stale_condition(tmp_path, fake_k8s, client):
+    m, dev = make_manager(tmp_path)
+    checker, log_path, boot = make_checker(tmp_path, m, client)
+    fake_k8s.nodes["node-a"] = {
+        "metadata": {"name": "node-a"},
+        "status": {"conditions": [{
+            "type": "TpuCriticalError", "status": "True",
+            "message": json.dumps({"bootID": "boot-0", "errors": {}})}]}}
+    checker.maybe_reset_condition()
+    cond = fake_k8s.nodes["node-a"]["status"]["conditions"][0]
+    assert cond["status"] == "False"
+    assert cond["reason"] == "NodeRebooted"
+
+
+def test_boot_id_reset_keeps_current_condition(tmp_path, fake_k8s, client):
+    m, dev = make_manager(tmp_path)
+    checker, log_path, boot = make_checker(tmp_path, m, client)
+    fake_k8s.nodes["node-a"] = {
+        "metadata": {"name": "node-a"},
+        "status": {"conditions": [{
+            "type": "TpuCriticalError", "status": "True",
+            "message": json.dumps({"bootID": "boot-1", "errors": {}})}]}}
+    checker.maybe_reset_condition()
+    assert fake_k8s.nodes["node-a"]["status"]["conditions"][0][
+        "status"] == "True"
+
+
+# ---------- version visibility ----------
+
+def test_version_annotations_split():
+    ann = version_annotations("1.9.0")
+    assert ann == {
+        "cloud.google.com/tpu.libtpu-version.full": "1.9.0",
+        "cloud.google.com/tpu.libtpu-version.major": "1",
+        "cloud.google.com/tpu.libtpu-version.minor": "9",
+        "cloud.google.com/tpu.libtpu-version.revision": "0",
+    }
+
+
+def test_read_libtpu_version(tmp_path):
+    assert read_libtpu_version(str(tmp_path)) is None
+    (tmp_path / "libtpu.so.2.3.1").touch()
+    assert read_libtpu_version(str(tmp_path)) == "2.3.1"
+    (tmp_path / "version").write_text("9.9.9\n")
+    assert read_libtpu_version(str(tmp_path)) == "9.9.9"
+
+
+def test_publish_version_annotations(tmp_path, fake_k8s, client):
+    (tmp_path / "version").write_text("1.9.0\n")
+    assert publish_version_annotations(client, "node-a", str(tmp_path))
+    ann = fake_k8s.nodes["node-a"]["metadata"]["annotations"]
+    assert ann["cloud.google.com/tpu.libtpu-version.full"] == "1.9.0"

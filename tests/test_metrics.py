@@ -1,0 +1,167 @@
+"""Metrics: sampler windowing, PodResources attribution over a real unix
+socket (in-process kubelet stub), Prometheus scrape text (the
+mockCollector + testutil pattern of reference metrics_test.go:26-115)."""
+
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+from prometheus_client import generate_latest
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    MockDeviceInfo,
+    TPUConfig,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.metrics import (
+    ChipSample,
+    FakeSampler,
+    MetricServer,
+    PodResourcesClient,
+    SysfsSampler,
+)
+from container_engine_accelerators_tpu.metrics import podresources_pb2 as pb
+from container_engine_accelerators_tpu.metrics.devices import (
+    add_podresources_servicer,
+)
+from tests.test_deviceplugin import make_fake_devfs
+
+
+# ---------- sysfs sampler ----------
+
+def write_counters(sysfs, chip, used, total, busy_ms):
+    d = sysfs / f"accel{chip}" / "device"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "mem_used").write_text(str(used))
+    (d / "mem_total").write_text(str(total))
+    (d / "busy_time_ms").write_text(str(busy_ms))
+
+
+def test_sysfs_sampler_duty_cycle_window(tmp_path):
+    sysfs = tmp_path / "accel"
+    write_counters(sysfs, 0, 100, 1000, 0)
+    s = SysfsSampler(str(sysfs))
+    first = s.sample(0)
+    assert first.memory_used_bytes == 100
+    assert first.duty_cycle_pct == 0.0  # no window yet
+    time.sleep(0.05)
+    # 50ms busy over ~50ms wall  -> ~100% duty cycle.
+    write_counters(sysfs, 0, 200, 1000, 50)
+    second = s.sample(0)
+    assert second.memory_used_bytes == 200
+    assert 50.0 <= second.duty_cycle_pct <= 100.0
+
+
+def test_sysfs_sampler_missing_chip(tmp_path):
+    s = SysfsSampler(str(tmp_path))
+    assert s.sample(7) is None
+
+
+# ---------- PodResources client over a real socket ----------
+
+class PodResourcesStubServer:
+    def __init__(self, sock_path, response):
+        self.response = response
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        outer = self
+
+        class Servicer:
+            def List(self, request, context):
+                return outer.response
+
+        add_podresources_servicer(Servicer(), self.server)
+        self.server.add_insecure_port(f"unix://{sock_path}")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=0.2).wait()
+
+
+def test_podresources_attribution(tmp_path):
+    sock = str(tmp_path / "podresources.sock")
+    resp = pb.ListPodResourcesResponse(pod_resources=[
+        pb.PodResources(name="train-0", namespace="ml", containers=[
+            pb.ContainerResources(name="main", devices=[
+                pb.ContainerDevices(resource_name="google.com/tpu",
+                                    device_ids=["accel0", "accel1"]),
+                pb.ContainerDevices(resource_name="other.com/thing",
+                                    device_ids=["x"]),
+            ])]),
+        pb.PodResources(name="idle", namespace="ml",
+                        containers=[pb.ContainerResources(name="c")]),
+    ])
+    srv = PodResourcesStubServer(sock, resp)
+    try:
+        client = PodResourcesClient(socket_path=sock)
+        out = client.containers_with_devices()
+    finally:
+        srv.stop()
+    assert len(out) == 1
+    assert out[0].pod == "train-0"
+    assert out[0].device_ids == ("accel0", "accel1")
+
+
+# ---------- full scrape ----------
+
+def test_metric_server_scrape(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=2)
+    manager = TPUManager(TPUConfig(), MockDeviceInfo(dev))
+    manager.discover()
+
+    sock = str(tmp_path / "podresources.sock")
+    resp = pb.ListPodResourcesResponse(pod_resources=[
+        pb.PodResources(name="train-0", namespace="ml", containers=[
+            pb.ContainerResources(name="main", devices=[
+                pb.ContainerDevices(resource_name="google.com/tpu",
+                                    device_ids=["accel1"])])])])
+    srv = PodResourcesStubServer(sock, resp)
+    sampler = FakeSampler({
+        0: ChipSample(10.0, 1 << 30, 16 << 30),
+        1: ChipSample(85.5, 8 << 30, 16 << 30),
+    })
+    try:
+        ms = MetricServer(manager, sampler=sampler,
+                          pod_resources=PodResourcesClient(socket_path=sock))
+        ms.update_once()
+        text = generate_latest(ms.registry).decode()
+    finally:
+        srv.stop()
+
+    assert ('node_duty_cycle{model="v5e",tpu_chip="accel1"} 85.5' in text)
+    assert ('duty_cycle{container="main",model="v5e",namespace="ml",'
+            'pod="train-0",tpu_chip="accel1"} 85.5' in text)
+    assert ('memory_used{container="main",model="v5e",namespace="ml",'
+            'pod="train-0",tpu_chip="accel1"} 8.589934592e+09' in text)
+    assert ('request{container="main",namespace="ml",pod="train-0"} 1.0'
+            in text)
+    # Chip 0 has no container attribution: node-level only.
+    assert 'node_duty_cycle{model="v5e",tpu_chip="accel0"} 10.0' in text
+    assert 'duty_cycle{container="main",model="v5e",namespace="ml",' \
+           'pod="train-0",tpu_chip="accel0"' not in text
+
+
+def test_metric_server_clears_stale_containers(tmp_path):
+    dev = make_fake_devfs(tmp_path, n=1)
+    manager = TPUManager(TPUConfig(), MockDeviceInfo(dev))
+    manager.discover()
+    sock = str(tmp_path / "pr.sock")
+    resp = pb.ListPodResourcesResponse(pod_resources=[
+        pb.PodResources(name="gone", namespace="ml", containers=[
+            pb.ContainerResources(name="c", devices=[
+                pb.ContainerDevices(resource_name="google.com/tpu",
+                                    device_ids=["accel0"])])])])
+    srv = PodResourcesStubServer(sock, resp)
+    sampler = FakeSampler({0: ChipSample(50.0, 1, 2)})
+    try:
+        ms = MetricServer(manager, sampler=sampler,
+                          pod_resources=PodResourcesClient(socket_path=sock))
+        ms.update_once()
+        assert 'pod="gone"' in generate_latest(ms.registry).decode()
+        srv.response = pb.ListPodResourcesResponse()  # pod exited
+        ms.update_once()
+        assert 'pod="gone"' not in generate_latest(ms.registry).decode()
+    finally:
+        srv.stop()

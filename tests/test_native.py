@@ -1,0 +1,105 @@
+"""Native components: libtpudev via ctypes, tpu-info CLI output,
+dcn-prober loopback run. Builds native/build on demand (g++ is part of the
+toolchain contract)."""
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+    return BUILD
+
+
+def fake_tree(tmp_path, chips=2):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    sysfs = tmp_path / "accelclass"
+    for i in range(chips):
+        (dev / f"accel{i}").touch()
+        d = sysfs / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "mem_used").write_text(str((i + 1) * 1000))
+        (d / "mem_total").write_text("16000")
+        (d / "busy_time_ms").write_text("0")
+        (d / "numa_node").write_text(str(i % 2))
+    return str(dev), str(sysfs)
+
+
+def test_native_sampler_roundtrip(native_build, tmp_path):
+    from container_engine_accelerators_tpu.metrics.sampler import NativeSampler
+    dev, sysfs = fake_tree(tmp_path)
+    s = NativeSampler(os.path.join(native_build, "libtpudev.so"))
+    s.set_sysfs_root(sysfs)
+    first = s.sample(0)
+    assert first is not None
+    assert first.memory_used_bytes == 1000
+    assert first.memory_total_bytes == 16000
+    # Busy counter advances 100ms over ~100ms wall: duty approaches 100%.
+    time.sleep(0.1)
+    with open(os.path.join(sysfs, "accel0", "device", "busy_time_ms"),
+              "w") as f:
+        f.write("100")
+    second = s.sample(0)
+    assert second.duty_cycle_pct > 30.0
+    assert s.sample(9) is None
+
+
+def test_make_sampler_prefers_native(native_build, tmp_path, monkeypatch):
+    from container_engine_accelerators_tpu.metrics.sampler import (
+        NativeSampler, make_sampler)
+    monkeypatch.setenv("LIBTPUDEV_PATH",
+                       os.path.join(native_build, "libtpudev.so"))
+    s = make_sampler(str(tmp_path))
+    assert isinstance(s, NativeSampler)
+
+
+def test_tpu_info_cli(native_build, tmp_path):
+    dev, sysfs = fake_tree(tmp_path)
+    out = subprocess.run(
+        [os.path.join(native_build, "tpu-info"),
+         "--dev-root", dev, "--sysfs-root", sysfs],
+        check=True, capture_output=True, text=True).stdout
+    lines = out.strip().splitlines()
+    assert lines[0].split()[:3] == ["CHIP", "PATH", "NUMA"]
+    assert len(lines) == 3
+    row0 = lines[1].split()
+    assert row0[0] == "0" and row0[1] == f"{dev}/accel0"
+    assert row0[3] == "1000" and row0[4] == "16000"
+
+
+def test_tpu_info_cli_no_chips(native_build, tmp_path):
+    r = subprocess.run(
+        [os.path.join(native_build, "tpu-info"),
+         "--dev-root", str(tmp_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "no TPU chips" in r.stderr
+
+
+def test_dcn_prober_loopback(native_build):
+    prober = os.path.join(native_build, "dcn-prober")
+    port = "19321"
+    server = subprocess.Popen([prober, "-s", "-p", port],
+                              stderr=subprocess.PIPE)
+    try:
+        time.sleep(0.3)
+        out = subprocess.run(
+            [prober, "-c", "127.0.0.1", "-p", port, "-n", "2", "-t", "1",
+             "-b", "256"],
+            check=True, capture_output=True, text=True, timeout=30).stdout
+        result = json.loads(out)
+        assert result["streams"] == 2
+        assert result["gbps_total"] > 0.1  # loopback is fast
+    finally:
+        server.terminate()
+        server.wait(timeout=5)
