@@ -1,0 +1,27 @@
+# Image for every daemon/CLI in this repo (device plugin, health checker,
+# metrics, topology scheduler, labeler, partition_tpu, collective bench,
+# demos) — the single-image pattern of the reference Dockerfile, with
+# native components built in a toolchain stage (the CGO_ENABLED=1 +
+# cross-gcc role of reference Dockerfile:16-31).
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+COPY native /src/native
+RUN make -C /src/native
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir grpcio protobuf prometheus_client pyyaml \
+    "jax[tpu]" optax orbax-checkpoint einops chex
+
+COPY --from=native-build /src/native/build/libtpudev.so /usr/local/lib/
+COPY --from=native-build /src/native/build/tpu-info /usr/local/bin/
+COPY --from=native-build /src/native/build/dcn-prober /usr/local/bin/
+ENV LIBTPUDEV_PATH=/usr/local/lib/libtpudev.so
+
+COPY container_engine_accelerators_tpu /app/container_engine_accelerators_tpu
+COPY example /examples
+ENV PYTHONPATH=/app
+
+# Suggest verbose logging for bug reports (reference Dockerfile:37).
+CMD ["python", "-m", \
+     "container_engine_accelerators_tpu.cli.device_plugin_main", "-v"]

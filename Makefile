@@ -1,0 +1,37 @@
+# Build/test entry points — the targets of the reference Makefile
+# (test = hermetic unit tests, presubmit = lint/format/boilerplate,
+# device-injector-test = root-gated device-node tests; reference
+# Makefile:20-36,97-102).
+
+PYTHON ?= python
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Root-gated NRI device-node tests (mknod), split out like the
+# reference's `make device-injector-test`.
+device-injector-test:
+	$(PYTHON) -m pytest tests/test_nri.py -q
+
+presubmit:
+	$(PYTHON) -m compileall -q container_engine_accelerators_tpu tests \
+	    bench.py __graft_entry__.py
+	$(PYTHON) build/check_boilerplate.py
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	    import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native test device-injector-test presubmit bench dryrun clean

@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Presubmit hygiene checker — the role of the reference's boilerplate
+checker (reference build/boilerplate/boilerplate.py): every Python module
+must open with a docstring, every YAML with a comment, shell scripts with
+a shebang; no tabs in Python; no trailing whitespace."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+SKIP_DIRS = {".git", "__pycache__", "build", "vendor", ".claude", "native"}
+SKIP_SUFFIXES = ("_pb2.py",)
+
+
+def check_python(path: str, text: str) -> list[str]:
+    errors = []
+    stripped = text.lstrip()
+    if not (stripped.startswith('"""') or stripped.startswith("'''")
+            or stripped.startswith("#")):
+        errors.append("missing module docstring")
+    if "\t" in text:
+        errors.append("tab character")
+    return errors
+
+
+def check_yaml(path: str, text: str) -> list[str]:
+    first = text.lstrip().splitlines()[0] if text.strip() else ""
+    if not first.startswith("#"):
+        return ["missing leading comment describing the manifest"]
+    return []
+
+
+def check_shell(path: str, text: str) -> list[str]:
+    if not text.startswith("#!"):
+        return ["missing shebang"]
+    return []
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            if name.endswith(SKIP_SUFFIXES):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            if name.endswith(".py"):
+                errors = check_python(path, text)
+            elif name.endswith((".yaml", ".yml")):
+                errors = check_yaml(path, text)
+            elif name.endswith(".sh"):
+                errors = check_shell(path, text)
+            else:
+                continue
+            for line_no, line in enumerate(text.splitlines(), 1):
+                if line != line.rstrip():
+                    errors.append(f"trailing whitespace at line {line_no}")
+                    break
+            for e in errors:
+                print(f"{rel}: {e}")
+                failures += 1
+    if failures:
+        print(f"\n{failures} hygiene failure(s)")
+        return 1
+    print("boilerplate check passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

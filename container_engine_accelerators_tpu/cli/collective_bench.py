@@ -1,0 +1,105 @@
+"""collective-bench — ICI/DCN collective bandwidth harness CLI, replacing
+the reference's nccl-tests pods (reference gpudirect-tcpxo/
+nccl-test-latest.yaml:124 runs `all_gather_perf -b 1M -e 512M -f 2 -w 5
+--iters 100 -c 0` over mpirun; flags here mirror that command set).
+
+Single-slice: run on all local devices over ICI.
+Multi-slice: set --coordinator/--num-processes/--process-id (JobSet env)
+and jax.distributed wires the DCN mesh — the mpirun/hostfile replacement.
+
+  python -m container_engine_accelerators_tpu.cli.collective_bench \
+      --collective all_gather -b 1M -e 512M -f 2 -w 5 --iters 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+
+def parse_size(text: str) -> int:
+    m = re.fullmatch(r"(\d+)([kKmMgG]?)", text)
+    if not m:
+        raise argparse.ArgumentTypeError(f"bad size {text!r}")
+    mult = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+    return int(m.group(1)) * mult[m.group(2).lower()]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--collective", default="all_reduce",
+                   help="all_reduce|all_gather|reduce_scatter|all_to_all|"
+                        "ppermute|all (comma list allowed)")
+    p.add_argument("-b", "--begin", type=parse_size, default=parse_size("1M"))
+    p.add_argument("-e", "--end", type=parse_size, default=parse_size("512M"))
+    p.add_argument("-f", "--factor", type=int, default=2)
+    p.add_argument("-w", "--warmup", type=int, default=5)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--axis", default="ici",
+                   help="mesh axis to probe: ici | dcn")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON line per size instead of the table")
+    # Multi-process (multi-slice over DCN) wiring.
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (JobSet headless svc)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    import jax
+
+    if args.coordinator:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+
+    from jax.sharding import Mesh
+
+    from container_engine_accelerators_tpu.ops import collectives
+
+    devices = jax.devices()
+    n_local = jax.local_device_count()
+    n_proc = max(1, len(devices) // max(n_local, 1))
+    if args.axis == "dcn" and n_proc > 1:
+        import numpy as np
+        mesh = Mesh(np.array(devices).reshape(n_proc, n_local),
+                    ("dcn", "ici"))
+        axis = "dcn"
+    else:
+        import numpy as np
+        mesh = Mesh(np.array(devices).reshape(1, len(devices)),
+                    ("dcn", "ici"))
+        axis = "ici"
+
+    names = list(collectives.COLLECTIVES) if args.collective == "all" \
+        else [c.strip() for c in args.collective.split(",")]
+    all_results = []
+    for name in names:
+        results = collectives.sweep(
+            mesh, axis, name, begin_bytes=args.begin, end_bytes=args.end,
+            factor=args.factor, warmup=args.warmup, iters=args.iters)
+        all_results.extend(results)
+        if args.json:
+            for r in results:
+                print(json.dumps({
+                    "collective": r.collective, "size_bytes": r.size_bytes,
+                    "time_us": round(r.time_us, 1),
+                    "alg_bw_gbps": round(r.alg_bw_gbps, 3),
+                    "bus_bw_gbps": round(r.bus_bw_gbps, 3),
+                    "axis": axis, "devices": len(devices)}))
+    if not args.json:
+        print(f"# devices={len(devices)} axis={axis} "
+              f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+        print(collectives.report(all_results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

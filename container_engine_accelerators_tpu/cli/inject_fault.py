@@ -1,0 +1,57 @@
+"""inject-fault — append synthetic TPU error records to the health
+checker's JSONL feed, validating the health pipeline end to end: record ->
+device Unhealthy -> ListAndWatch -> kubelet deschedules; Node condition +
+Event appear.
+
+This is the analog of the reference's intentional-Xid-31 CUDA demo
+(reference demo/gpu-error/illegal-memory-access/vectorAdd.cu, which
+loops an out-of-bounds kernel to trip the health checker).
+
+  python -m container_engine_accelerators_tpu.cli.inject_fault \
+      --chip 0 --error-class HBM_ECC_UNCORRECTABLE
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from container_engine_accelerators_tpu.deviceplugin.config import (
+    KNOWN_ERROR_CLASSES,
+)
+from container_engine_accelerators_tpu.healthcheck.health_checker import (
+    DEFAULT_ERROR_LOG,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--chip", type=int, default=0,
+                   help="-1 targets the whole host")
+    p.add_argument("--error-class", default="HBM_ECC_UNCORRECTABLE",
+                   choices=KNOWN_ERROR_CLASSES)
+    p.add_argument("--message", default="injected by inject_fault")
+    p.add_argument("--error-log", default=DEFAULT_ERROR_LOG)
+    p.add_argument("--repeat", type=int, default=1)
+    p.add_argument("--interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    os.makedirs(os.path.dirname(args.error_log) or ".", exist_ok=True)
+    for i in range(args.repeat):
+        with open(args.error_log, "a") as f:
+            f.write(json.dumps({
+                "chip": args.chip,
+                "class": args.error_class,
+                "message": args.message}) + "\n")
+        print(f"injected {args.error_class} for chip {args.chip} "
+              f"({i + 1}/{args.repeat})")
+        if i + 1 < args.repeat:
+            time.sleep(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

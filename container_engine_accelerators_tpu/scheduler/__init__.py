@@ -1,0 +1,17 @@
+"""Topology-aware scheduling (L4): gate-based userspace scheduler + node
+labeler, re-targeted from rack/host network locality (reference
+gke-topology-scheduler/) to TPU slice/ICI locality."""
+
+from container_engine_accelerators_tpu.scheduler.topology import (
+    NodeTopology,
+    pairwise_distance,
+    topology_distance,
+    topology_sort_key,
+)
+
+__all__ = [
+    "NodeTopology",
+    "pairwise_distance",
+    "topology_distance",
+    "topology_sort_key",
+]

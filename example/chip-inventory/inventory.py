@@ -1,0 +1,25 @@
+"""Print the TPU inventory visible inside this container — the JAX half of
+the chip-inventory example (see README.md; native half is tpu-info)."""
+
+import os
+
+import jax
+
+
+def main():
+    print("TPU_VISIBLE_CHIPS =", os.environ.get("TPU_VISIBLE_CHIPS"))
+    print("TPU_CHIP_GENERATION =", os.environ.get("TPU_CHIP_GENERATION"))
+    devices = jax.devices()
+    print(f"jax sees {len(devices)} device(s):")
+    for d in devices:
+        line = f"  [{d.id}] {d.device_kind} process={d.process_index}"
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            used = stats.get("bytes_in_use", 0)
+            limit = stats.get("bytes_limit", 0)
+            line += f" hbm={used}/{limit}"
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Developer-workstation flavor (reference nvidia-driver-installer/minikube/
+# entrypoint.sh analog): stage libtpu for a local single-chip box or a CPU
+# fallback, skipping the GKE host-dir conventions.
+set -o errexit
+set -o pipefail
+set -u
+
+TPU_INSTALL_DIR="${TPU_INSTALL_DIR:-/usr/local/tpu}"
+LIBTPU_SOURCE_DIR="${LIBTPU_SOURCE_DIR:-/opt/libtpu}"
+
+mkdir -p "${TPU_INSTALL_DIR}"
+if [[ -f "${TPU_INSTALL_DIR}/libtpu.so" ]] && \
+   cmp -s "${LIBTPU_SOURCE_DIR}/version" "${TPU_INSTALL_DIR}/version"; then
+  echo "libtpu already staged"
+else
+  cp "${LIBTPU_SOURCE_DIR}/libtpu.so" "${TPU_INSTALL_DIR}/libtpu.so"
+  cp "${LIBTPU_SOURCE_DIR}/version" "${TPU_INSTALL_DIR}/version"
+fi
+
+if compgen -G "/dev/accel*" >/dev/null; then
+  echo "TPU chips present:"
+  ls -l /dev/accel*
+else
+  echo "No TPU chips; workloads will run on CPU (JAX_PLATFORMS=cpu)"
+fi

@@ -1,0 +1,77 @@
+#!/bin/bash
+# libtpu installer for Ubuntu TPU nodes — the L0 analog of the reference's
+# Ubuntu driver flow (reference nvidia-driver-installer/ubuntu/
+# entrypoint.sh). The reference must build/overlay kernel modules
+# (:76-135); TPU nodes ship the accel driver in-kernel, so this installer
+# only stages userspace (libtpu.so + tools) with the same cache-and-verify
+# discipline (:33-61 cache keyed on versions, :149-156 verify step).
+set -o errexit
+set -o pipefail
+set -u
+
+TPU_INSTALL_DIR_HOST="${TPU_INSTALL_DIR_HOST:-/home/kubernetes/bin/tpu}"
+TPU_INSTALL_DIR_CONTAINER="${TPU_INSTALL_DIR_CONTAINER:-/usr/local/tpu}"
+LIBTPU_SOURCE_DIR="${LIBTPU_SOURCE_DIR:-/opt/libtpu}"
+CACHE_FILE="${TPU_INSTALL_DIR_CONTAINER}/.cache"
+
+check_cached_version() {
+  echo "Checking cached version"
+  if [[ ! -f "${CACHE_FILE}" ]]; then
+    echo "Cache file ${CACHE_FILE} not found."
+    return 1
+  fi
+  # shellcheck source=/dev/null
+  source "${CACHE_FILE}"
+  if [[ "${CACHED_LIBTPU_VERSION:-}" == \
+        "$(cat ${LIBTPU_SOURCE_DIR}/version)" ]]; then
+    echo "Found existing libtpu install ${CACHED_LIBTPU_VERSION}"
+    return 0
+  fi
+  return 1
+}
+
+update_cached_version() {
+  cat >"${CACHE_FILE}" <<EOF
+CACHED_LIBTPU_VERSION=$(cat ${LIBTPU_SOURCE_DIR}/version)
+EOF
+  echo "Updated cached version as:"
+  cat "${CACHE_FILE}"
+}
+
+stage_libtpu() {
+  echo "Staging libtpu into ${TPU_INSTALL_DIR_HOST}"
+  mkdir -p "${TPU_INSTALL_DIR_CONTAINER}"
+  cp "${LIBTPU_SOURCE_DIR}/libtpu.so" \
+     "${TPU_INSTALL_DIR_CONTAINER}/libtpu.so.tmp"
+  mv "${TPU_INSTALL_DIR_CONTAINER}/libtpu.so.tmp" \
+     "${TPU_INSTALL_DIR_CONTAINER}/libtpu.so"
+  cp "${LIBTPU_SOURCE_DIR}/version" "${TPU_INSTALL_DIR_CONTAINER}/version"
+  cp "${LIBTPU_SOURCE_DIR}/tpu-info" \
+     "${TPU_INSTALL_DIR_CONTAINER}/tpu-info" 2>/dev/null || true
+}
+
+verify_tpu() {
+  # The nvidia-smi/nvidia-modprobe verification analog (:149-156): the
+  # chips must enumerate under /dev and open cleanly.
+  echo "Verifying TPU chip enumeration"
+  if compgen -G "/dev/accel*" >/dev/null; then
+    "${TPU_INSTALL_DIR_CONTAINER}/tpu-info" --dev-root /dev || return 1
+    return 0
+  fi
+  echo "No /dev/accel* nodes present — is this a TPU node?"
+  return 1
+}
+
+main() {
+  if check_cached_version && \
+     [[ -f "${TPU_INSTALL_DIR_CONTAINER}/libtpu.so" ]]; then
+    echo "libtpu already installed; verifying"
+  else
+    stage_libtpu
+    update_cached_version
+  fi
+  verify_tpu
+  echo "libtpu install complete"
+}
+
+main "$@"

@@ -216,6 +216,16 @@ class KubeletStub(RegistrationServicer):
 
     def stop(self):
         self.server.stop(grace=0.2).wait()
+        # grpc unlinks the unix socket asynchronously during listener
+        # teardown; if a new stub binds the same path first, the late
+        # unlink deletes the *new* socket file. Wait it out.
+        deadline = time.time() + 5
+        while os.path.exists(self.sock) and time.time() < deadline:
+            time.sleep(0.01)
+        try:
+            os.unlink(self.sock)
+        except FileNotFoundError:
+            pass
 
 
 @pytest.fixture

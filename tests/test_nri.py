@@ -1,0 +1,80 @@
+"""NRI injector: annotation parsing + device stat. Device-node creation
+needs mknod, so those tests are root-gated exactly like the reference's
+(reference nri_device_injector_test.go:26-28 skips unless uid 0)."""
+
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.nri import (
+    ANNOTATION_PREFIX,
+    devices_for_container,
+    inject_for_pod,
+    parse_device_annotations,
+    to_nri_device,
+)
+
+needs_root = pytest.mark.skipif(os.getuid() != 0, reason="needs root (mknod)")
+
+
+def test_parse_annotations():
+    ann = {
+        ANNOTATION_PREFIX + "sidecar": "- path: /dev/accel0\n- path: /dev/accel1\n",
+        "unrelated/annotation": "x",
+    }
+    assert parse_device_annotations(ann) == {
+        "sidecar": ["/dev/accel0", "/dev/accel1"]}
+
+
+@pytest.mark.parametrize("bad", [
+    "not a list",
+    "- nopath: /dev/x",
+    "{}",
+])
+def test_parse_annotations_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_device_annotations({ANNOTATION_PREFIX + "c": bad})
+
+
+def test_parse_annotations_empty_container_name():
+    with pytest.raises(ValueError):
+        parse_device_annotations({ANNOTATION_PREFIX: "- path: /dev/x"})
+
+
+def test_to_nri_device_rejects_regular_file(tmp_path):
+    f = tmp_path / "plain"
+    f.touch()
+    with pytest.raises(ValueError):
+        to_nri_device(str(f))
+
+
+@needs_root
+def test_to_nri_device_char_node(tmp_path):
+    node = tmp_path / "fakechar"
+    os.mknod(str(node), 0o600 | 0o020000, os.makedev(240, 7))  # S_IFCHR
+    dev = to_nri_device(str(node))
+    assert dev.type == "c"
+    assert (dev.major, dev.minor) == (240, 7)
+    assert dev.as_nri()["path"] == str(node)
+
+
+@needs_root
+def test_devices_for_container_end_to_end(tmp_path):
+    a = tmp_path / "accel0"
+    b = tmp_path / "accel1"
+    os.mknod(str(a), 0o600 | 0o020000, os.makedev(240, 0))
+    os.mknod(str(b), 0o600 | 0o020000, os.makedev(240, 1))
+    ann = {ANNOTATION_PREFIX + "rxdm":
+           f"- path: {a}\n- path: {b}\n"}
+    devs = devices_for_container(ann, "rxdm")
+    assert [d.minor for d in devs] == [0, 1]
+    assert devices_for_container(ann, "other") == []
+    adjustments = inject_for_pod(ann)
+    assert list(adjustments) == ["rxdm"]
+    assert len(adjustments["rxdm"]) == 2
+
+
+def test_devices_for_container_missing_node(tmp_path):
+    ann = {ANNOTATION_PREFIX + "c": f"- path: {tmp_path}/nope\n"}
+    with pytest.raises(ValueError):
+        devices_for_container(ann, "c")

@@ -1,0 +1,92 @@
+"""partition_tpu one-shot: apply / idempotency / dissolve / errors —
+the test coverage pattern of reference partition_gpu_test.go:22-198
+(canned-layout parsing + desired-state checks), driven through main()."""
+
+import json
+import os
+
+from container_engine_accelerators_tpu.cli import partition_tpu
+from tests.test_deviceplugin import make_fake_devfs
+
+
+def run(tmp_path, *args):
+    cfg = str(tmp_path / "etc" / "tpu_config.json")
+    dev = str(tmp_path / "dev")
+    return partition_tpu.main(
+        ["--config-file", cfg, "--dev-root", dev, *args]), cfg
+
+
+def test_apply_and_verify(tmp_path, capsys):
+    make_fake_devfs(tmp_path, n=4)
+    rc, cfg = run(tmp_path, "--chips-per-partition", "2")
+    assert rc == 0
+    assert json.load(open(cfg))["chipsPerPartition"] == 2
+    out = capsys.readouterr().out
+    assert "tpu-sub0-2" in out and "tpu-sub1-2" in out
+    assert "accel0,accel1" in out
+
+
+def test_idempotent_rerun_preserves_other_keys(tmp_path):
+    make_fake_devfs(tmp_path, n=4)
+    cfg_path = tmp_path / "etc" / "tpu_config.json"
+    cfg_path.parent.mkdir(parents=True)
+    cfg_path.write_text(json.dumps({
+        "chipsPerPartition": 2,
+        "healthCriticalErrors": ["CHIP_LOST"]}))
+    before = os.stat(cfg_path).st_mtime_ns
+    rc, _ = run(tmp_path, "--chips-per-partition", "2")
+    assert rc == 0
+    # No rewrite on a no-op (desired-state check).
+    assert os.stat(cfg_path).st_mtime_ns == before
+    assert json.load(open(cfg_path))["healthCriticalErrors"] == ["CHIP_LOST"]
+
+
+def test_repartition_keeps_unrelated_config(tmp_path):
+    make_fake_devfs(tmp_path, n=4)
+    cfg_path = tmp_path / "etc" / "tpu_config.json"
+    cfg_path.parent.mkdir(parents=True)
+    cfg_path.write_text(json.dumps({
+        "chipsPerPartition": 2,
+        "healthCriticalErrors": ["CHIP_LOST"]}))
+    rc, cfg = run(tmp_path, "--chips-per-partition", "4")
+    assert rc == 0
+    data = json.load(open(cfg))
+    assert data["chipsPerPartition"] == 4
+    assert data["healthCriticalErrors"] == ["CHIP_LOST"]
+
+
+def test_dissolve_partitions(tmp_path, capsys):
+    make_fake_devfs(tmp_path, n=4)
+    run(tmp_path, "--chips-per-partition", "2")
+    rc, cfg = run(tmp_path, "--chips-per-partition", "0")
+    assert rc == 0
+    assert json.load(open(cfg))["chipsPerPartition"] == 0
+    assert "unpartitioned" in capsys.readouterr().out
+
+
+def test_invalid_size_rejected(tmp_path):
+    make_fake_devfs(tmp_path, n=4)
+    rc, cfg = run(tmp_path, "--chips-per-partition", "3")
+    assert rc == 1
+    assert not os.path.exists(cfg)
+
+
+def test_indivisible_chip_count_rejected(tmp_path):
+    make_fake_devfs(tmp_path, n=2)
+    rc, _ = run(tmp_path, "--chips-per-partition", "4")
+    assert rc == 1
+
+
+def test_no_chips_fails(tmp_path):
+    (tmp_path / "dev").mkdir()
+    rc, _ = run(tmp_path, "--chips-per-partition", "2")
+    assert rc == 1
+
+
+def test_list_mode(tmp_path, capsys):
+    make_fake_devfs(tmp_path, n=4)
+    run(tmp_path, "--chips-per-partition", "2")
+    capsys.readouterr()
+    rc, _ = run(tmp_path, "--list")
+    assert rc == 0
+    assert "tpu-sub1-2" in capsys.readouterr().out
