@@ -50,7 +50,7 @@ def main():
         vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=2048, remat_policy="dots",
         dtype=jnp.bfloat16)
-    batch_size, seq_len = 4, 2048
+    batch_size, seq_len = 5, 2048
     warmup_steps, bench_steps = 2, 8
 
     n_dev = len(jax.devices())

@@ -1,0 +1,143 @@
+"""Incremental decoding with a KV cache — the inference path behind
+demo/serving (reference analog: demo/serving/tensorflow-serving.yaml; the
+reference ships no model code, so this is the serving-side counterpart of
+models/llama.py's training path).
+
+TPU-first design: the cache is a preallocated [B, max_len, Hkv, D] ring of
+static shape (XLA-friendly: `lax.dynamic_update_slice` in place, donated
+between steps), decode attention masks by position instead of reshaping,
+and `generate` drives steps under one jit with donated cache so HBM
+traffic stays at O(tokens_read) per step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.models.llama import LlamaConfig
+from container_engine_accelerators_tpu.ops import rms_norm, rope_frequencies
+from container_engine_accelerators_tpu.ops.rope import apply_rope
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray       # [L, B, max_len, Hkv, D]
+    v: jnp.ndarray       # [L, B, max_len, Hkv, D]
+    length: jnp.ndarray  # [] int32 — tokens already cached
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
+    """q: [B, T, Hq, D] for T new tokens at positions
+    [cache_len, cache_len+T); caches: [B, max_len, Hkv, D]."""
+    b, t, hq, d = q.shape
+    max_len = k_cache.shape[1]
+    n_rep = hq // k_cache.shape[2]
+    if n_rep > 1:
+        k_cache = jnp.repeat(k_cache, n_rep, axis=2)
+        v_cache = jnp.repeat(v_cache, n_rep, axis=2)
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    # Causal-by-position mask: new token at cache_len+i sees keys
+    # [0, cache_len+i].
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+    query_pos = cache_len + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 2)
+    logits = jnp.where(key_pos <= query_pos, logits, -1e30)
+    del max_len
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
+                cfg: LlamaConfig) -> tuple[jnp.ndarray, KVCache]:
+    """Run T new tokens ([B, T], T static — 1 for decode, prompt length for
+    prefill). Returns (logits [B, T, vocab] float32, updated cache)."""
+    b, t = tokens.shape
+    max_len = cache.k.shape[2]
+    dt = cfg.dtype
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    positions = cache.length + jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, t))
+
+    x = params["embed"].astype(dt)[tokens]
+
+    def layer_body(x, scanned):
+        lp, k_cache_in, v_cache_in = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, t, cfg.n_kv_heads,
+                                              cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, t, cfg.n_kv_heads,
+                                              cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache_in, k.astype(k_cache_in.dtype), (0, cache.length, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache_in, v.astype(v_cache_in.dtype), (0, cache.length, 0, 0))
+        attn = _cached_attention(q.astype(dt), k_cache.astype(dt),
+                                 v_cache.astype(dt), cache.length, cfg)
+        x = x + attn.reshape(b, t, -1) @ lp["wo"].astype(dt)
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ lp["w_gate"].astype(dt))
+        up = h2 @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    # Scan over layers with stacked params + stacked caches as xs — one
+    # layer traced once regardless of depth, caches updated in place.
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache.k, cache.v))
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
+    return logits, new_cache
+
+
+def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
+             max_new_tokens: int, max_len: int | None = None,
+             temperature: float = 0.0,
+             key: jax.Array | None = None) -> jnp.ndarray:
+    """Greedy (temperature=0) or sampled generation. prompt: [B, T0].
+    Returns [B, T0 + max_new_tokens]."""
+    b, t0 = prompt.shape
+    max_len = max_len or (t0 + max_new_tokens)
+    cache = init_cache(cfg, b, max_len)
+
+    prefill = jax.jit(functools.partial(decode_step, cfg=cfg),
+                      donate_argnums=(1,))
+    logits, cache = prefill(params, cache, prompt)
+
+    step_fn = jax.jit(functools.partial(decode_step, cfg=cfg),
+                      donate_argnums=(1,))
+
+    def pick(logits_1, k):
+        last = logits_1[:, -1]
+        if temperature <= 0.0:
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(k, last / temperature).astype(jnp.int32)
+
+    keys = (jax.random.split(key, max_new_tokens)
+            if key is not None else [None] * max_new_tokens)
+    out = [prompt]
+    tok = pick(logits, keys[0] if key is not None else None)
+    out.append(tok[:, None])
+    for i in range(1, max_new_tokens):
+        logits, cache = step_fn(params, cache, tok[:, None])
+        tok = pick(logits, keys[i] if key is not None else None)
+        out.append(tok[:, None])
+    return jnp.concatenate(out, axis=1)

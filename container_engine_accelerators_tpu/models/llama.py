@@ -199,6 +199,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     x, _ = jax.lax.scan(layer_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    # bf16 operands + float32 accumulation: full-rate MXU on the vocab
+    # projection (a pure-f32 matmul runs at half throughput), logits still
+    # come out f32 for a stable softmax.
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
+                        params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     return constrain(logits, "logits")

@@ -51,8 +51,12 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
-                                state_like._asdict())
+
+        def to_abstract(x):
+            sharding = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        abstract = jax.tree.map(to_abstract, state_like._asdict())
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(abstract))
         return TrainState(**restored)

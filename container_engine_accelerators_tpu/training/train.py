@@ -52,8 +52,21 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
                    out_shardings=pshard)
     params = init(key)
     opt_state = jax.jit(optimizer.init)(params)
-    step = jax.device_put(jnp.zeros((), jnp.int32),
-                          NamedSharding(mesh, P()))
+
+    # GSPMD propagation gives mu/nu the param shardings, but scalar leaves
+    # (adam count, schedule step) can come back committed to one device;
+    # every leaf must span the same mesh or later jits reject the state.
+    mesh_devices = set(mesh.devices.flat)
+    replicated = NamedSharding(mesh, P())
+
+    def span_mesh(x):
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and set(sharding.device_set) != mesh_devices:
+            return jax.device_put(x, replicated)
+        return x
+
+    opt_state = jax.tree.map(span_mesh, opt_state)
+    step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     return TrainState(step=step, params=params, opt_state=opt_state)
 
 
