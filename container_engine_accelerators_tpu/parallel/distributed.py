@@ -1,0 +1,67 @@
+"""Multi-host / multislice bootstrap.
+
+The reference launches cross-host jobs with OpenMPI + ssh hostfiles
+(reference gpudirect-tcpx/nccl-config.yaml:31-37); the TPU-native
+replacement is `jax.distributed.initialize` against a coordinator address
+delivered by the Job/JobSet environment (SURVEY.md §7 hard part d).
+
+Env contract (set by dcn-multislice manifests; JobSet-compatible):
+  JAX_COORDINATOR_ADDRESS  host[:port] of process 0
+  JAX_COORDINATOR_PORT     default 8476 (used when address has no port)
+  JAX_NUM_PROCESSES        total processes
+  JAX_PROCESS_ID           this process's rank, or derived from
+                           JOB_COMPLETION_INDEX (Indexed Jobs) /
+                           hostname ordinal (StatefulSet/JobSet pods)
+
+Device order note: after initialize, jax.devices() sorts all slices'
+devices with each process's local chips contiguous — make_mesh's
+(dp, fsdp, sp, tp) factorisation therefore puts dp outermost, so placing
+*slices* along dp keeps gradient psum the only DCN collective (the
+data-parallel-over-DCN pattern the reference enables with NCCL).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+log = logging.getLogger(__name__)
+
+
+def infer_process_id() -> int | None:
+    for var in ("JAX_PROCESS_ID", "JOB_COMPLETION_INDEX"):
+        val = os.environ.get(var)
+        if val is not None and val.isdigit():
+            return int(val)
+    # StatefulSet/JobSet pod ordinal: name like worker-3.
+    hostname = os.environ.get("HOSTNAME", "")
+    m = re.search(r"-(\d+)$", hostname)
+    if m:
+        return int(m.group(1))
+    return None
+
+
+def initialize_from_env() -> bool:
+    """Call jax.distributed.initialize from env; returns True if multi-
+    process mode was activated, False for single-process (no coordinator
+    configured)."""
+    address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num = os.environ.get("JAX_NUM_PROCESSES")
+    if not address or not num:
+        return False
+    if ":" not in address:
+        address = f"{address}:{os.environ.get('JAX_COORDINATOR_PORT', '8476')}"
+    process_id = infer_process_id()
+    if process_id is None:
+        raise RuntimeError(
+            "JAX_COORDINATOR_ADDRESS set but no process id: set "
+            "JAX_PROCESS_ID or run under an Indexed Job")
+    import jax
+
+    jax.distributed.initialize(coordinator_address=address,
+                               num_processes=int(num),
+                               process_id=process_id)
+    log.info("jax.distributed initialized: %s process %s/%s",
+             address, process_id, num)
+    return True

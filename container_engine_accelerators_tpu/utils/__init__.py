@@ -1,0 +1,8 @@
+"""Shared utilities: profiling hooks, logging helpers."""
+
+from container_engine_accelerators_tpu.utils.profiling import (
+    annotate,
+    maybe_profile,
+)
+
+__all__ = ["annotate", "maybe_profile"]

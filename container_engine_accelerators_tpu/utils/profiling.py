@@ -1,0 +1,49 @@
+"""XLA profiler hooks — the observability the reference lacks in-repo
+(SURVEY.md §5: 'Tracing/profiling: none ... TPU build: add XLA
+profiler/xplane dump hooks in the demo layer').
+
+Usage in training loops / benches:
+
+    with maybe_profile(steps=(10, 15)):      # or TPU_PROFILE_DIR env
+        for i, batch in enumerate(batches):
+            with annotate(f"step{i}"):
+                state, metrics = step(state, batch)
+
+Traces are xplane protos viewable in TensorBoard / xprof.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+PROFILE_DIR_ENV = "TPU_PROFILE_DIR"
+
+
+@contextlib.contextmanager
+def maybe_profile(log_dir: str | None = None):
+    """Capture an XLA profiler trace when a directory is configured
+    (argument or TPU_PROFILE_DIR env); no-op otherwise."""
+    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV)
+    if not log_dir:
+        yield False
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    log.info("profiler trace -> %s", log_dir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+def annotate(name: str):
+    """Named region in the trace timeline (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

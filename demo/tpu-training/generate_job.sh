@@ -1,0 +1,67 @@
+#!/bin/bash
+# Hyperparameter-sweep Job generator — the analog of the reference's
+# demo/gpu-training/generate_job.sh (:16-81), which stamps out one
+# training Job per learning rate. Usage:
+#   ./generate_job.sh 1e-3 3e-4 1e-4 | kubectl apply -f -
+set -o errexit
+set -o nounset
+
+IMAGE="${IMAGE:-gcr.io/PROJECT/tpu-accelerators:latest}"
+CHIPS="${CHIPS:-4}"
+STEPS="${STEPS:-500}"
+
+if [[ $# -lt 1 ]]; then
+  echo "usage: $0 LR [LR...]" >&2
+  exit 1
+fi
+
+for lr in "$@"; do
+  name="llama-sweep-lr-$(echo "${lr}" | tr '.+' '--' | tr -d 'e')"
+  cat <<EOF
+---
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: ${name}
+  labels:
+    sweep: llama-lr
+spec:
+  backoffLimit: 0
+  template:
+    metadata:
+      labels:
+        job-name: ${name}
+    spec:
+      restartPolicy: Never
+      containers:
+      - name: train
+        image: ${IMAGE}
+        command:
+        - python
+        - -c
+        - |
+          import jax, jax.numpy as jnp
+          from container_engine_accelerators_tpu.models import llama
+          from container_engine_accelerators_tpu.parallel import make_mesh
+          from container_engine_accelerators_tpu.training import (
+              create_train_state, make_optimizer, make_train_step)
+          from container_engine_accelerators_tpu.training.data import (
+              synthetic_batches)
+          from container_engine_accelerators_tpu.training.train import (
+              shard_batch, train_loop)
+          cfg = llama.llama3_1b(dtype=jnp.bfloat16)
+          mesh = make_mesh()
+          opt = make_optimizer(learning_rate=${lr})
+          state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+          step = make_train_step(cfg, mesh, opt)
+          batches = synthetic_batches(cfg.vocab_size, 8, 2048,
+                                      num_batches=${STEPS})
+          state, metrics = train_loop(state, batches, step, mesh)
+          print("lr=${lr} final", {k: float(v) for k, v in metrics.items()})
+        resources:
+          requests:
+            google.com/tpu: "${CHIPS}"
+          limits:
+            google.com/tpu: "${CHIPS}"
+EOF
+done

@@ -85,3 +85,45 @@ def test_ring_attention_differentiable(mesh_sp):
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(jax.device_get(a), b_,
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_infer_process_id(monkeypatch):
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        infer_process_id)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    monkeypatch.delenv("JOB_COMPLETION_INDEX", raising=False)
+    monkeypatch.setenv("HOSTNAME", "worker-7")
+    assert infer_process_id() == 7
+    monkeypatch.setenv("JOB_COMPLETION_INDEX", "3")
+    assert infer_process_id() == 3
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    assert infer_process_id() == 1
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    monkeypatch.delenv("JOB_COMPLETION_INDEX")
+    monkeypatch.setenv("HOSTNAME", "nohost")
+    assert infer_process_id() is None
+
+
+def test_initialize_from_env_noop(monkeypatch):
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_env)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize_from_env() is False
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    from container_engine_accelerators_tpu.utils import annotate, maybe_profile
+    with maybe_profile(str(tmp_path / "trace")) as active:
+        assert active
+        with annotate("test-region"):
+            jnp.ones(8).sum().block_until_ready()
+    # xplane dump exists under plugins/profile/<timestamp>/.
+    found = list((tmp_path / "trace").rglob("*.xplane.pb"))
+    assert found, "no xplane trace written"
+
+
+def test_maybe_profile_noop(monkeypatch):
+    from container_engine_accelerators_tpu.utils import maybe_profile
+    monkeypatch.delenv("TPU_PROFILE_DIR", raising=False)
+    with maybe_profile() as active:
+        assert not active

@@ -90,3 +90,36 @@ def test_list_mode(tmp_path, capsys):
     rc, _ = run(tmp_path, "--list")
     assert rc == 0
     assert "tpu-sub1-2" in capsys.readouterr().out
+
+
+# ---------- tpu-runtime-ready sidecar ----------
+
+def test_runtime_ready_once_success(tmp_path, capsys):
+    from container_engine_accelerators_tpu.cli import runtime_ready
+    make_fake_devfs(tmp_path, n=2)
+    ready = tmp_path / "run" / "ready"
+    rc = runtime_ready.main([
+        "--dev-root", str(tmp_path / "dev"), "--once",
+        "--ready-file", str(ready)])
+    assert rc == 0
+    assert ready.read_text().strip() == "2"
+
+
+def test_runtime_ready_once_no_chips(tmp_path):
+    from container_engine_accelerators_tpu.cli import runtime_ready
+    (tmp_path / "dev").mkdir()
+    rc = runtime_ready.main([
+        "--dev-root", str(tmp_path / "dev"), "--once",
+        "--ready-file", str(tmp_path / "ready")])
+    assert rc == 1
+    assert not (tmp_path / "ready").exists()
+
+
+def test_runtime_ready_expected_count(tmp_path):
+    from container_engine_accelerators_tpu.cli import runtime_ready
+    make_fake_devfs(tmp_path, n=2)
+    rc = runtime_ready.main([
+        "--dev-root", str(tmp_path / "dev"), "--once",
+        "--expected-chips", "4",
+        "--ready-file", str(tmp_path / "ready")])
+    assert rc == 1
