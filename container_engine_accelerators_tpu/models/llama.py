@@ -49,6 +49,9 @@ class LlamaConfig:
     # Ring attention over the 'sp' mesh axis (parallel/ring_attention.py);
     # enabled by the training layer when the mesh has sp > 1.
     sequence_parallel: bool = False
+    # GPipe microbatch count for the 'pp' mesh axis (parallel/pipeline.py);
+    # 0 disables pipelining. Requires n_layers % pp == 0.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -186,9 +189,16 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, "resid")
 
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    use_pp = bool(cfg.pipeline_microbatches) and pp > 1
+    # Inside the pipelined shard_map region ('pp' manual, others auto),
+    # with_sharding_constraint over auto axes trips the XLA partitioner;
+    # GSPMD still shards the stage internals from the param shardings.
+    layer_constrain = (lambda y, kind: y) if use_pp else constrain
+
     def layer_body(x, lp):
-        x = _attention(x, lp, cfg, cos, sin, constrain, mesh)
-        x = _mlp(x, lp, cfg, constrain)
+        x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
+        x = _mlp(x, lp, cfg, layer_constrain)
         return x, None
 
     if cfg.remat_policy != "none":
@@ -197,7 +207,22 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                   if policy_name else None)
         layer_body = jax.checkpoint(layer_body, policy=policy)
 
-    x, _ = jax.lax.scan(layer_body, x, params["layers"])
+    if use_pp:
+        if cfg.n_layers % pp:
+            raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                             f"pp={pp}")
+        from container_engine_accelerators_tpu.parallel.pipeline import (
+            pipeline,
+        )
+
+        def stage_fn(local_layers, x_mb):
+            out, _ = jax.lax.scan(layer_body, x_mb, local_layers)
+            return out
+
+        x = pipeline(stage_fn, params["layers"], x, mesh,
+                     cfg.pipeline_microbatches)
+    else:
+        x, _ = jax.lax.scan(layer_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     # bf16 operands + float32 accumulation: full-rate MXU on the vocab
     # projection (a pure-f32 matmul runs at half throughput), logits still

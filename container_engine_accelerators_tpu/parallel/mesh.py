@@ -1,8 +1,11 @@
 """Device mesh construction.
 
 Axis convention (outer -> inner, matching ICI locality preferences):
-  dp    pure data parallel (gradient psum only — cheapest, ride DCN across
-        slices; analog of the reference's NCCL-over-TCPX data parallelism)
+  pp    pipeline parallel (stage-to-stage activation ppermute — lowest
+        volume, tolerates DCN; outermost so stages can span slices)
+  dp    pure data parallel (gradient psum only — cheapest per byte, rides
+        DCN across slices; analog of the reference's NCCL-over-TCPX data
+        parallelism)
   fsdp  data parallel with sharded params/optimizer (all-gather + reduce
         scatter per step — wants ICI)
   sp    sequence/context parallel (ring attention ppermute — wants a true
@@ -18,11 +21,12 @@ import dataclasses
 import jax
 from jax.sharding import Mesh
 
-AXIS_NAMES = ("dp", "fsdp", "sp", "tp")
+AXIS_NAMES = ("pp", "dp", "fsdp", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshAxes:
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     sp: int = 1
@@ -30,18 +34,20 @@ class MeshAxes:
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.pp * self.dp * self.fsdp * self.sp * self.tp
 
-    def as_tuple(self) -> tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.sp, self.tp)
+    def as_tuple(self) -> tuple[int, int, int, int, int]:
+        return (self.pp, self.dp, self.fsdp, self.sp, self.tp)
 
 
 def auto_axis_sizes(n_devices: int, tp: int | None = None,
-                    sp: int | None = None) -> MeshAxes:
-    """Deterministic factorisation of n_devices into (dp, fsdp, sp, tp).
+                    sp: int | None = None,
+                    pp: int | None = None) -> MeshAxes:
+    """Deterministic factorisation of n_devices into (pp, dp, fsdp, sp, tp).
 
     Heuristic: tp soaks up to 4 (per-layer all-reduce wants the shortest
-    links), then fsdp up to 8, remainder to dp. Explicit tp/sp override.
+    links), then fsdp up to 8, remainder to dp. sp and pp are opt-in
+    (long-context / deep-model strategies are workload decisions).
     """
     rem = n_devices
 
@@ -60,10 +66,11 @@ def auto_axis_sizes(n_devices: int, tp: int | None = None,
         return got
 
     tp_sz = take(tp, 4)
-    sp_sz = take(sp, 1)   # off unless requested — long-context opt-in
+    sp_sz = take(sp, 1)
+    pp_sz = take(pp, 1)
     fsdp_sz = take(None, 8)
     dp_sz = rem
-    return MeshAxes(dp=dp_sz, fsdp=fsdp_sz, sp=sp_sz, tp=tp_sz)
+    return MeshAxes(pp=pp_sz, dp=dp_sz, fsdp=fsdp_sz, sp=sp_sz, tp=tp_sz)
 
 
 def make_mesh(axes: MeshAxes | None = None, devices=None) -> Mesh:

@@ -1,0 +1,105 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+GPipe-style schedule expressed SPMD: every pp rank runs the same program;
+`shard_map(axis_names={'pp'})` makes only the pipeline axis manual, so the
+per-stage computation stays a plain jittable function whose internals
+GSPMD continues to shard over dp/fsdp/tp automatically.
+
+Mechanics:
+  - layer params are stacked [L, ...] and sharded P('pp') on the leading
+    axis — each stage materialises only its L/P layers;
+  - activations flow stage->stage via `jax.lax.ppermute` (neighbor
+    point-to-point, the cheapest collective, DCN-tolerant);
+  - the schedule runs M + P - 1 ticks under `lax.scan`; inactive
+    (bubble) ticks skip compute via `lax.cond`;
+  - the last stage's outputs are broadcast back with a masked psum so
+    loss/logits code stays stage-agnostic.
+
+Everything is reverse-differentiable (scan + cond + ppermute), so
+`jax.grad` of a pipelined forward yields the pipelined backward with the
+transposed permutes — no hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
+             axis: str = "pp"):
+    """Run x through P pipeline stages.
+
+    stage_fn(stage_local_params, x_mb) -> x_mb, where stage_local_params
+    is `params` with the stacked leading axis reduced to L/P local layers.
+
+    params: pytree of [L, ...] arrays (sharded P('pp') outside).
+    x: [B, S, D] activations. B must divide by n_microbatches.
+    Returns [B, S, D] after all L layers.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        return stage_fn(params, x)
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible into "
+                         f"{n_microbatches} microbatches")
+    mb = b // n_microbatches
+    x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    # XLA's CPU SPMD partitioner CHECK-fails on bf16 psum (the transpose
+    # of the replicated-in x_all is a psum of its cotangent), so the
+    # shard_map boundary runs in f32 there; TPU keeps the native dtype.
+    compute_dtype = x.dtype
+    boundary_f32 = (jax.default_backend() == "cpu"
+                    and x.dtype == jnp.bfloat16)
+    if boundary_f32:
+        x_mb = x_mb.astype(jnp.float32)
+
+    def per_shard(local_params, x_all):
+        x_all = x_all.astype(compute_dtype)
+        stage = jax.lax.axis_index(axis)
+        m = n_microbatches
+        send_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            mb_idx = t - stage
+            active = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+            first_in = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+            inp = jnp.where(stage == 0, first_in, state)
+            # Bubble ticks run the stage on garbage and mask the result —
+            # branchless keeps the partitioner happy (lax.cond inside
+            # grad-of-shard_map with mixed auto axes trips an XLA SPMD
+            # CHECK, "invalid binary instruction opcode copy").
+            out = stage_fn(local_params, inp)
+            idx = jnp.clip(mb_idx, 0, m - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0,
+                                               keepdims=False)
+            upd = jnp.where(active, out, cur)
+            outputs = jax.lax.dynamic_update_index_in_dim(outputs, upd,
+                                                          idx, 0)
+            state = jax.lax.ppermute(out, axis, send_perm)
+            return (state, outputs), None
+
+        init = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all))
+        (_, outputs), _ = jax.lax.scan(
+            tick, init, jnp.arange(m + n_stages - 1))
+        # Only the last stage holds the fully-processed activations; a
+        # masked psum broadcasts them to every pp rank. The psum runs in
+        # f32: a bf16 psum here trips an XLA SPMD-partitioner CHECK
+        # ("invalid binary instruction opcode copy") on the CPU backend.
+        masked = jnp.where(stage == n_stages - 1,
+                           outputs.astype(jnp.float32), 0.0)
+        return jax.lax.psum(masked, axis).astype(outputs.dtype)
+
+    out = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(params, x_mb)
+    return out.reshape(b, *x.shape[1:])

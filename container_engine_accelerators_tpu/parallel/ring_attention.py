@@ -132,5 +132,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     body = functools.partial(_ring_body, axis_name=axis_name,
                              n_chunks=n_chunks, chunk_len=chunk_len,
                              causal=causal)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    # Nested inside another shard_map (e.g. the 'pp' pipeline region) the
+    # context is an AbstractMesh with some axes already Manual; shard_map
+    # then requires that context mesh, not the concrete one.
+    from jax.sharding import get_abstract_mesh
+
+    ctx = get_abstract_mesh()
+    use_mesh = ctx if not ctx.empty else mesh
+    return jax.shard_map(body, mesh=use_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -17,31 +17,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 BATCH_AXES = ("dp", "fsdp")
 
 
-def llama_param_specs() -> dict:
-    """PartitionSpec tree matching models.llama.init_params structure."""
+def llama_param_specs(pipeline: bool = False) -> dict:
+    """PartitionSpec tree matching models.llama.init_params structure.
+
+    With `pipeline`, the stacked [n_layers, ...] axis is sharded over 'pp'
+    so each pipeline stage materialises only its own layers."""
+    layer_axis = "pp" if pipeline else None
     return {
         # Vocab dim replicated: a vocab-sharded table turns the token gather
         # into an SPMD full-remat (XLA warns "involuntary full
         # rematerialization"); d_model on fsdp keeps memory bounded.
         "embed": P(None, "fsdp"),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            "attn_norm": P(layer_axis, None),
+            "wq": P(layer_axis, "fsdp", "tp"),
+            "wk": P(layer_axis, "fsdp", "tp"),
+            "wv": P(layer_axis, "fsdp", "tp"),
+            "wo": P(layer_axis, "tp", "fsdp"),
+            "mlp_norm": P(layer_axis, None),
+            "w_gate": P(layer_axis, "fsdp", "tp"),
+            "w_up": P(layer_axis, "fsdp", "tp"),
+            "w_down": P(layer_axis, "tp", "fsdp"),
         },
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
 
 
-def param_shardings(mesh: Mesh, specs: dict | None = None):
-    specs = specs if specs is not None else llama_param_specs()
+def param_shardings(mesh: Mesh, specs: dict | None = None,
+                    pipeline: bool = False):
+    specs = specs if specs is not None else llama_param_specs(pipeline)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 

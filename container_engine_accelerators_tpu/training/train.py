@@ -47,7 +47,8 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
                        optimizer: optax.GradientTransformation) -> TrainState:
     """Params initialised directly into their NamedSharding (no host-side
     full copy); optimizer state inherits placement from the sharded params."""
-    pshard = shd.param_shardings(mesh)
+    pipeline = bool(cfg.pipeline_microbatches) and mesh.shape.get("pp", 1) > 1
+    pshard = shd.param_shardings(mesh, pipeline=pipeline)
     init = jax.jit(functools.partial(llama.init_params, cfg=cfg),
                    out_shardings=pshard)
     params = init(key)

@@ -35,3 +35,9 @@ def mesh8(cpu_devices):
 def mesh_sp(cpu_devices):
     from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
     return make_mesh(MeshAxes(dp=1, fsdp=2, sp=4, tp=1), devices=cpu_devices)
+
+
+@pytest.fixture(scope="session")
+def mesh_pp(cpu_devices):
+    from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+    return make_mesh(MeshAxes(pp=2, fsdp=2, tp=2), devices=cpu_devices)

@@ -15,10 +15,12 @@ from container_engine_accelerators_tpu.parallel.ring_attention import ring_atten
 
 
 def test_auto_axis_sizes():
-    assert auto_axis_sizes(1) == MeshAxes(1, 1, 1, 1)
-    assert auto_axis_sizes(8) == MeshAxes(1, 2, 1, 4)
-    assert auto_axis_sizes(8, tp=2) == MeshAxes(1, 4, 1, 2)
-    assert auto_axis_sizes(8, tp=2, sp=2) == MeshAxes(1, 2, 2, 2)
+    assert auto_axis_sizes(1) == MeshAxes()
+    assert auto_axis_sizes(8) == MeshAxes(dp=1, fsdp=2, tp=4)
+    assert auto_axis_sizes(8, tp=2) == MeshAxes(dp=1, fsdp=4, tp=2)
+    assert auto_axis_sizes(8, tp=2, sp=2) == MeshAxes(fsdp=2, sp=2, tp=2)
+    assert auto_axis_sizes(16, tp=2, sp=2, pp=2) == MeshAxes(
+        pp=2, fsdp=2, sp=2, tp=2)
     assert auto_axis_sizes(64).total == 64
     with pytest.raises(ValueError):
         auto_axis_sizes(8, tp=3)
