@@ -52,6 +52,14 @@ class LlamaConfig:
     # GPipe microbatch count for the 'pp' mesh axis (parallel/pipeline.py);
     # 0 disables pipelining. Requires n_layers % pp == 0.
     pipeline_microbatches: int = 0
+    # Mixture-of-Experts FFN (models/moe.py): 0 experts = dense MLP.
+    # Expert weights shard over the 'ep' mesh axis. Not combinable with
+    # pipeline_microbatches (aux losses don't thread through the pipeline).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_z_weight: float = 0.001
 
     @property
     def head_dim(self) -> int:
@@ -71,11 +79,15 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         hd = self.head_dim
+        mlp = 3 * self.d_model * self.d_ff
+        if self.n_experts:
+            mlp = (self.n_experts * mlp                     # experts
+                   + self.d_model * self.n_experts)         # router
         per_layer = (2 * self.d_model                      # norms
                      + self.d_model * hd * self.n_heads     # wq
                      + 2 * self.d_model * hd * self.n_kv_heads  # wk, wv
                      + hd * self.n_heads * self.d_model     # wo
-                     + 3 * self.d_model * self.d_ff)        # gate, up, down
+                     + mlp)
         return (self.vocab_size * self.d_model * 2          # embed + lm_head
                 + self.n_layers * per_layer + self.d_model)
 
@@ -109,19 +121,31 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
                 * (fan_in ** -0.5)).astype(pd)
 
     def one_layer(k):
-        ks = jax.random.split(k, 7)
+        ks = jax.random.split(k, 8)
         d = cfg.d_model
-        return {
+        layer = {
             "attn_norm": jnp.ones((d,), dtype=pd),
             "wq": dense(ks[0], (d, cfg.n_heads * hd), d),
             "wk": dense(ks[1], (d, cfg.n_kv_heads * hd), d),
             "wv": dense(ks[2], (d, cfg.n_kv_heads * hd), d),
             "wo": dense(ks[3], (cfg.n_heads * hd, d), cfg.n_heads * hd),
             "mlp_norm": jnp.ones((d,), dtype=pd),
-            "w_gate": dense(ks[4], (d, cfg.d_ff), d),
-            "w_up": dense(ks[5], (d, cfg.d_ff), d),
-            "w_down": dense(ks[6], (cfg.d_ff, d), cfg.d_ff),
         }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            layer.update({
+                "w_router": dense(ks[7], (d, e), d),
+                "w_gate": dense(ks[4], (e, d, cfg.d_ff), d),
+                "w_up": dense(ks[5], (e, d, cfg.d_ff), d),
+                "w_down": dense(ks[6], (e, cfg.d_ff, d), cfg.d_ff),
+            })
+        else:
+            layer.update({
+                "w_gate": dense(ks[4], (d, cfg.d_ff), d),
+                "w_up": dense(ks[5], (d, cfg.d_ff), d),
+                "w_down": dense(ks[6], (cfg.d_ff, d), cfg.d_ff),
+            })
+        return layer
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
     layers = jax.vmap(one_layer)(layer_keys)
@@ -160,10 +184,17 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
 def _mlp(x, lp, cfg: LlamaConfig, constrain):
     dt = cfg.dtype
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        from container_engine_accelerators_tpu.models.moe import moe_mlp
+
+        out, metrics = moe_mlp(h, lp, cfg, constrain)
+        aux = (cfg.moe_aux_weight * metrics.aux_loss
+               + cfg.moe_z_weight * metrics.router_z_loss)
+        return x + constrain(out, "resid"), aux
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
     ff = constrain(gate * up, "ff")
-    return x + constrain(ff @ lp["w_down"].astype(dt), "resid")
+    return x + constrain(ff @ lp["w_down"].astype(dt), "resid"), None
 
 
 _REMAT_POLICIES = {
@@ -174,7 +205,7 @@ _REMAT_POLICIES = {
 
 
 def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
-            constrain=None, mesh=None) -> jnp.ndarray:
+            constrain=None, mesh=None, return_aux: bool = False):
     """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
 
     `constrain(x, kind)` is an optional activation-sharding hook (see
@@ -198,8 +229,8 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     def layer_body(x, lp):
         x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
-        x = _mlp(x, lp, cfg, layer_constrain)
-        return x, None
+        x, aux = _mlp(x, lp, cfg, layer_constrain)
+        return x, aux
 
     if cfg.remat_policy != "none":
         policy_name = _REMAT_POLICIES[cfg.remat_policy]
@@ -211,6 +242,9 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         if cfg.n_layers % pp:
             raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
                              f"pp={pp}")
+        if cfg.n_experts:
+            raise ValueError("MoE + pipeline parallelism not supported "
+                             "yet (aux losses don't cross the pipeline)")
         from container_engine_accelerators_tpu.parallel.pipeline import (
             pipeline,
         )
@@ -221,8 +255,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
         x = pipeline(stage_fn, params["layers"], x, mesh,
                      cfg.pipeline_microbatches)
+        aux_total = None
     else:
-        x, _ = jax.lax.scan(layer_body, x, params["layers"])
+        x, aux = jax.lax.scan(layer_body, x, params["layers"])
+        aux_total = jnp.sum(aux) if aux is not None else None
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     # bf16 operands + float32 accumulation: full-rate MXU on the vocab
     # projection (a pure-f32 matmul runs at half throughput), logits still
@@ -230,4 +266,8 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
                         params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return constrain(logits, "logits")
+    logits = constrain(logits, "logits")
+    if return_aux:
+        zero = jnp.zeros((), jnp.float32)
+        return logits, (aux_total if aux_total is not None else zero)
+    return logits

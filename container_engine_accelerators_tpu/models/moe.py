@@ -1,0 +1,108 @@
+"""Mixture-of-Experts FFN with capacity-based top-k routing, expert
+weights sharded over the 'ep' mesh axis.
+
+TPU-first formulation: routing is expressed as one-hot dispatch/combine
+einsums (no gather/scatter — everything is MXU-shaped contractions with
+static shapes, the t5x/flaxformer lineage of TPU MoE), so GSPMD inserts
+the expert all-to-alls from the shardings alone:
+
+  dispatch [B, S, E, C] @ tokens [B, S, D]  -> expert_in  [B, E, C, D]
+  expert FFN (weights [E, D, F] on 'ep')    -> expert_out [B, E, C, D]
+  combine  [B, S, E, C] @ expert_out        -> output     [B, S, D]
+
+Capacity C = ceil(capacity_factor * S * k / E) tokens per expert per
+batch row; overflow tokens are dropped (their combine weights are zero,
+so they pass through the residual unchanged — standard Switch behavior).
+The router adds the Switch load-balancing aux loss (E * mean(f_i * P_i))
+and router z-loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeMetrics:
+    aux_loss: jnp.ndarray        # load-balance loss (scalar)
+    router_z_loss: jnp.ndarray   # router logit magnitude control
+    dropped_fraction: jnp.ndarray
+
+
+def capacity(seq_len: int, n_experts: int, top_k: int,
+             capacity_factor: float) -> int:
+    c = int(capacity_factor * seq_len * top_k / n_experts)
+    return max(c, top_k)
+
+
+def route(router_logits: jnp.ndarray, n_experts: int, top_k: int,
+          cap: int):
+    """router_logits: [B, S, E] (float32). Returns (dispatch, combine,
+    metrics) with dispatch/combine [B, S, E, C].
+
+    Priority: earlier sequence positions claim capacity first within each
+    expert; rank-0 (highest-probability) choices claim before rank-1.
+    """
+    b, s, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)     # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # One-hot per routing rank: [B,S,k,E].
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+
+    # Capacity assignment: flatten rank-major so rank-0 choices of every
+    # position outrank rank-1 choices, then cumsum along the combined
+    # (k, S) order per expert.
+    rank_major = jnp.swapaxes(onehot, 1, 2).reshape(b, top_k * s, e)
+    pos = jnp.cumsum(rank_major, axis=1) - 1.0              # [B,k*S,E]
+    pos = pos.reshape(b, top_k, s, e).swapaxes(1, 2)        # [B,S,k,E]
+    within = (pos < cap).astype(jnp.float32) * onehot
+    slot = jnp.sum(pos * within, axis=-1)                   # [B,S,k]
+    slot_oh = jax.nn.one_hot(slot.astype(jnp.int32), cap,
+                             dtype=jnp.float32)             # [B,S,k,C]
+    kept = jnp.sum(within, axis=-1, keepdims=True)          # [B,S,k,1]
+
+    # [B,S,k,E,C] collapsed over k -> [B,S,E,C]
+    dispatch = jnp.einsum("bske,bskc->bsec", within,
+                          slot_oh * kept)
+    combine = jnp.einsum("bske,bskc->bsec", within * gate_vals[..., None],
+                         slot_oh)
+
+    # Switch aux loss: fraction of tokens per expert (rank-0 routing) vs
+    # mean router probability per expert.
+    frac_tokens = jnp.mean(onehot[:, :, 0, :], axis=(0, 1))  # [E]
+    mean_probs = jnp.mean(probs, axis=(0, 1))                # [E]
+    aux = e * jnp.sum(frac_tokens * mean_probs)
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    dropped = 1.0 - jnp.sum(dispatch) / (b * s * top_k)
+    return dispatch, combine, MoeMetrics(aux, z, dropped)
+
+
+def moe_mlp(h: jnp.ndarray, lp: dict, cfg, constrain=None):
+    """h: [B, S, D] normalized activations. lp: {'w_router' [D,E],
+    'w_gate'/'w_up' [E,D,F], 'w_down' [E,F,D]}. Returns (out, metrics)."""
+    if constrain is None:
+        constrain = lambda x, kind: x
+    b, s, d = h.shape
+    e = cfg.n_experts
+    dt = h.dtype
+    cap = capacity(s, e, cfg.moe_top_k, cfg.moe_capacity_factor)
+
+    router_logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32),
+        lp["w_router"].astype(jnp.float32))
+    dispatch, combine, metrics = route(router_logits, e, cfg.moe_top_k, cap)
+
+    expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), h)
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", expert_in,
+                                  lp["w_gate"].astype(dt)))
+    up = jnp.einsum("becd,edf->becf", expert_in, lp["w_up"].astype(dt))
+    expert_out = jnp.einsum("becf,efd->becd", gate * up,
+                            lp["w_down"].astype(dt))
+    out = jnp.einsum("bsec,becd->bsd", combine.astype(dt), expert_out)
+    return out, metrics

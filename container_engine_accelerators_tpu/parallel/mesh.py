@@ -8,6 +8,8 @@ Axis convention (outer -> inner, matching ICI locality preferences):
         parallelism)
   fsdp  data parallel with sharded params/optimizer (all-gather + reduce
         scatter per step — wants ICI)
+  ep    expert parallel (MoE expert weights sharded; token dispatch
+        contracts over the expert axis)
   sp    sequence/context parallel (ring attention ppermute — wants a true
         ICI ring)
   tp    tensor parallel (per-layer all-reduce — most latency sensitive,
@@ -21,7 +23,7 @@ import dataclasses
 import jax
 from jax.sharding import Mesh
 
-AXIS_NAMES = ("pp", "dp", "fsdp", "sp", "tp")
+AXIS_NAMES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -29,20 +31,23 @@ class MeshAxes:
     pp: int = 1
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
     @property
     def total(self) -> int:
-        return self.pp * self.dp * self.fsdp * self.sp * self.tp
+        return (self.pp * self.dp * self.fsdp * self.ep * self.sp
+                * self.tp)
 
-    def as_tuple(self) -> tuple[int, int, int, int, int]:
-        return (self.pp, self.dp, self.fsdp, self.sp, self.tp)
+    def as_tuple(self) -> tuple[int, int, int, int, int, int]:
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def auto_axis_sizes(n_devices: int, tp: int | None = None,
                     sp: int | None = None,
-                    pp: int | None = None) -> MeshAxes:
+                    pp: int | None = None,
+                    ep: int | None = None) -> MeshAxes:
     """Deterministic factorisation of n_devices into (pp, dp, fsdp, sp, tp).
 
     Heuristic: tp soaks up to 4 (per-layer all-reduce wants the shortest
@@ -68,9 +73,11 @@ def auto_axis_sizes(n_devices: int, tp: int | None = None,
     tp_sz = take(tp, 4)
     sp_sz = take(sp, 1)
     pp_sz = take(pp, 1)
+    ep_sz = take(ep, 1)
     fsdp_sz = take(None, 8)
     dp_sz = rem
-    return MeshAxes(pp=pp_sz, dp=dp_sz, fsdp=fsdp_sz, sp=sp_sz, tp=tp_sz)
+    return MeshAxes(pp=pp_sz, dp=dp_sz, fsdp=fsdp_sz, ep=ep_sz,
+                    sp=sp_sz, tp=tp_sz)
 
 
 def make_mesh(axes: MeshAxes | None = None, devices=None) -> Mesh:

@@ -17,12 +17,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 BATCH_AXES = ("dp", "fsdp")
 
 
-def llama_param_specs(pipeline: bool = False) -> dict:
+def llama_param_specs(pipeline: bool = False, moe: bool = False) -> dict:
     """PartitionSpec tree matching models.llama.init_params structure.
 
     With `pipeline`, the stacked [n_layers, ...] axis is sharded over 'pp'
-    so each pipeline stage materialises only its own layers."""
+    so each pipeline stage materialises only its own layers. With `moe`,
+    MLP weights carry an expert axis sharded over 'ep'."""
     layer_axis = "pp" if pipeline else None
+    if moe:
+        mlp_specs = {
+            "w_router": P(layer_axis, "fsdp", None),
+            "w_gate": P(layer_axis, "ep", "fsdp", "tp"),
+            "w_up": P(layer_axis, "ep", "fsdp", "tp"),
+            "w_down": P(layer_axis, "ep", "tp", "fsdp"),
+        }
+    else:
+        mlp_specs = {
+            "w_gate": P(layer_axis, "fsdp", "tp"),
+            "w_up": P(layer_axis, "fsdp", "tp"),
+            "w_down": P(layer_axis, "tp", "fsdp"),
+        }
     return {
         # Vocab dim replicated: a vocab-sharded table turns the token gather
         # into an SPMD full-remat (XLA warns "involuntary full
@@ -35,9 +49,7 @@ def llama_param_specs(pipeline: bool = False) -> dict:
             "wv": P(layer_axis, "fsdp", "tp"),
             "wo": P(layer_axis, "tp", "fsdp"),
             "mlp_norm": P(layer_axis, None),
-            "w_gate": P(layer_axis, "fsdp", "tp"),
-            "w_up": P(layer_axis, "fsdp", "tp"),
-            "w_down": P(layer_axis, "tp", "fsdp"),
+            **mlp_specs,
         },
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
@@ -45,8 +57,8 @@ def llama_param_specs(pipeline: bool = False) -> dict:
 
 
 def param_shardings(mesh: Mesh, specs: dict | None = None,
-                    pipeline: bool = False):
-    specs = specs if specs is not None else llama_param_specs(pipeline)
+                    pipeline: bool = False, moe: bool = False):
+    specs = specs if specs is not None else llama_param_specs(pipeline, moe)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 

@@ -48,7 +48,8 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     """Params initialised directly into their NamedSharding (no host-side
     full copy); optimizer state inherits placement from the sharded params."""
     pipeline = bool(cfg.pipeline_microbatches) and mesh.shape.get("pp", 1) > 1
-    pshard = shd.param_shardings(mesh, pipeline=pipeline)
+    pshard = shd.param_shardings(mesh, pipeline=pipeline,
+                                 moe=bool(cfg.n_experts))
     init = jax.jit(functools.partial(llama.init_params, cfg=cfg),
                    out_shardings=pshard)
     params = init(key)
@@ -72,10 +73,12 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
 
 
 def loss_fn(params, batch, cfg: llama.LlamaConfig, constrain, mesh):
-    """Next-token cross entropy. batch: {'inputs','targets'} each [B, S];
-    targets < 0 are masked out (padding)."""
-    logits = llama.forward(params, batch["inputs"], cfg,
-                           constrain=constrain, mesh=mesh)
+    """Next-token cross entropy (+ MoE router losses when configured).
+    batch: {'inputs','targets'} each [B, S]; targets < 0 are masked out
+    (padding)."""
+    logits, aux = llama.forward(params, batch["inputs"], cfg,
+                                constrain=constrain, mesh=mesh,
+                                return_aux=True)
     targets = batch["targets"]
     mask = (targets >= 0).astype(jnp.float32)
     safe_targets = jnp.maximum(targets, 0)
@@ -83,7 +86,7 @@ def loss_fn(params, batch, cfg: llama.LlamaConfig, constrain, mesh):
         logits, safe_targets)
     total = jnp.sum(losses * mask)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
-    return total / denom
+    return total / denom + aux
 
 
 def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
