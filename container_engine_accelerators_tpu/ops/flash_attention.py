@@ -45,8 +45,9 @@ def _pick_block(requested: int, s: int) -> int:
     return block
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale: float, block_q: int, block_k: int, causal: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, scale: float, block_q: int,
+                block_k: int, causal: bool, segmented: bool):
     ki = pl.program_id(3)
     num_k = pl.num_programs(3)
 
@@ -77,6 +78,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
+        if segmented:
+            sq = seg_q_ref[0, :, 0]  # [bq]
+            sk = seg_k_ref[0, :, 0]  # [bk]
+            s = jnp.where(sq[:, None] == sk[None, :], s, NEG_INF)
 
         m_prev = m_scr[:, :1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
@@ -99,7 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         lse_ref[0, 0, :, 0] = (m_scr[:, 0] + jnp.log(l[:, 0]))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
+         segmented):
     b, h, s, d = q.shape
     block_q = _pick_block(block_q, s)
     block_k = _pick_block(block_k, s)
@@ -113,12 +119,17 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal,
+                          segmented=segmented),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bi, hi, qi, ki: (bi, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
@@ -138,12 +149,13 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, seg, seg)
     return out, lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, block_q, block_k, causal):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_acc, *,
+                   scale, block_q, block_k, causal, segmented):
     ki = pl.program_id(3)
     num_k = pl.num_programs(3)
 
@@ -171,6 +183,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
+        if segmented:
+            sq = seg_q_ref[0, :, 0]
+            sk = seg_k_ref[0, :, 0]
+            s = jnp.where(sq[:, None] == sk[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -184,9 +200,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, block_q, block_k, causal):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, block_q, block_k, causal, segmented):
     qi = pl.program_id(3)
     num_q = pl.num_programs(3)
 
@@ -215,6 +231,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
+        if segmented:
+            sq = seg_q_ref[0, :, 0]
+            sk = seg_k_ref[0, :, 0]
+            s = jnp.where(sq[:, None] == sk[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         # dv += p^T @ do
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
@@ -234,23 +254,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seg, causal, block_q, block_k, interpret, segmented):
     scale = q.shape[-1] ** -0.5
-    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=interpret)
+    out, _ = _fwd(q, k, v, seg, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret, segmented=segmented)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, seg, causal, block_q, block_k, interpret,
+                    segmented):
     scale = q.shape[-1] ** -0.5
-    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                    block_k=block_k, interpret=interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _fwd(q, k, v, seg, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                    segmented=segmented)
+    return out, (q, k, v, seg, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
+    q, k, v, seg, out, lse = res
     b, h, s, d = q.shape
     scale = d ** -0.5
     block_q = _pick_block(block_q, s)
@@ -269,12 +291,17 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal,
+                          segmented=segmented),
         grid=(b, h, s // block_q, s // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bi, hi, qi, ki: (bi, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap),
@@ -283,7 +310,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, seg, seg, do, lse, delta)
 
     # dk/dv: grid puts K blocks in dim 2, Q scan innermost.
     def kmap2(bi, hi, ki, qi):
@@ -297,12 +324,17 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal,
+                          segmented=segmented),
         grid=(b, h, s // block_k, s // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap2),
             pl.BlockSpec((1, 1, block_k, d), kmap2),
             pl.BlockSpec((1, 1, block_k, d), kmap2),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bi, hi, ki, qi: (bi, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d), qmap2),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
@@ -320,20 +352,23 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, seg, seg, do, lse, delta)
+    return dq, dk, dv, jnp.zeros_like(seg)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, causal: bool = True,
+                    segment_ids=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
     """q: [B, S, Hq, D]; k, v: [B, S, Hkv, D]. Returns [B, S, Hq, D].
 
     Transposes to heads-major internally, repeats KV heads for GQA.
+    `segment_ids` ([B, S] int) masks attention across packed-sequence
+    boundaries (tokens attend only within their own segment).
     """
     from container_engine_accelerators_tpu.ops.attention import _repeat_kv
 
@@ -343,5 +378,13 @@ def flash_attention(q, k, v, causal: bool = True,
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    segmented = segment_ids is not None
+    if segmented:
+        # float32 carrier: segment ids only feed equality comparisons, and
+        # a float primal keeps custom_vjp cotangent handling uniform.
+        seg = segment_ids.astype(jnp.float32)[:, :, None]  # [B, S, 1]
+    else:
+        seg = jnp.zeros((q.shape[0], q.shape[1], 1), jnp.float32)
+    out = _flash(qt, kt, vt, seg, causal, block_q, block_k, interpret,
+                 segmented)
     return jnp.swapaxes(out, 1, 2)

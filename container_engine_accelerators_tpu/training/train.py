@@ -129,3 +129,78 @@ def train_loop(state: TrainState, batches: Iterator, step_fn, mesh: Mesh,
                    f"loss {float(m['loss']):.4f} "
                    f"grad_norm {float(m['grad_norm']):.3f}")
     return state, metrics
+
+
+def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
+        ckpt_dir: str | None = None, save_every: int = 100,
+        max_steps: int | None = None, key=None, log_every: int = 10,
+        log_fn=print):
+    """Train with checkpoint/auto-resume — the elastic-recovery loop
+    (SURVEY.md §5: the reference's recovery is node-level repair; the
+    workload-level half is resuming from the latest checkpoint after a
+    preemption/restart, which this provides).
+
+    On start, restores the newest checkpoint under `ckpt_dir` if one
+    exists and skips to that step; saves every `save_every` steps and at
+    the end. Returns (state, last_metrics).
+    """
+    import jax.random as jrandom
+
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    key = key if key is not None else jrandom.key(0)
+    state = create_train_state(key, cfg, mesh, optimizer)
+    mngr = None
+    if ckpt_dir:
+        mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
+        restored = mngr.restore(state)
+        if restored is not None:
+            state = restored
+            log_fn(f"resumed from step {int(jax.device_get(state.step))}")
+
+    step_fn = make_train_step(cfg, mesh, optimizer)
+    sp = cfg.sequence_parallel
+    start_step = int(jax.device_get(state.step))
+    metrics = None
+    for i, batch in enumerate(batches):
+        step_no = start_step + i
+        if max_steps is not None and step_no >= max_steps:
+            break
+        batch = shard_batch(batch, mesh, sp)
+        state, metrics = step_fn(state, batch)
+        cur = int(jax.device_get(state.step))
+        if mngr is not None:
+            mngr.save(cur, state)
+        if log_every and i % log_every == 0:
+            m = jax.device_get(metrics)
+            log_fn(f"step {cur} loss {float(m['loss']):.4f}")
+    if mngr is not None:
+        final = int(jax.device_get(state.step))
+        if mngr.latest_step() != final:
+            mngr.save(final, state, force=True)
+        mngr.wait()
+        mngr.close()
+    return state, metrics
+
+
+def evaluate(state: TrainState, cfg, mesh: Mesh, batches: Iterator,
+             sequence_parallel: bool = False) -> dict:
+    """Average next-token loss / perplexity over an eval stream."""
+    constrain = shd.make_constrain(mesh, sequence_parallel)
+
+    @jax.jit
+    def eval_step(params, batch):
+        return loss_fn(params, batch, cfg, constrain, mesh)
+
+    total, count = 0.0, 0
+    for batch in batches:
+        batch = shard_batch(batch, mesh, sequence_parallel)
+        total += float(jax.device_get(eval_step(state.params, batch)))
+        count += 1
+    mean = total / max(count, 1)
+    import math
+
+    return {"eval_loss": mean, "perplexity": math.exp(min(mean, 30.0)),
+            "batches": count}
