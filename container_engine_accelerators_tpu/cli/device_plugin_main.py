@@ -95,7 +95,16 @@ def main(argv=None) -> int:
         from container_engine_accelerators_tpu.healthcheck.health_checker import (
             TPUHealthChecker,
         )
-        checker = TPUHealthChecker(manager, cfg)
+        # Node conditions + Events need the API server; degrade to
+        # device-health-only when running outside a cluster.
+        k8s = None
+        try:
+            from container_engine_accelerators_tpu.k8s import in_cluster_client
+            k8s = in_cluster_client()
+        except Exception as e:
+            log.warning("no in-cluster K8s API (%s); health checker will "
+                        "only flip device health, not Node conditions", e)
+        checker = TPUHealthChecker(manager, cfg, k8s=k8s)
         threading.Thread(target=checker.run, daemon=True,
                          name="health-checker").start()
     if args.publish_version_annotations:

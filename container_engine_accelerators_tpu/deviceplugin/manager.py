@@ -153,6 +153,10 @@ class TPUManager:
         for t in targets:
             self.set_device_health(t, health)
 
+    def chip_indices(self) -> list[int]:
+        with self._lock:
+            return sorted(self._chips)
+
     def snapshot(self) -> list[pb.Device]:
         with self._lock:
             return [pb.Device.FromString(d.SerializeToString())

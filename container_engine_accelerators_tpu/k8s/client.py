@@ -33,10 +33,14 @@ class ApiError(Exception):
 
 class K8sClient:
     def __init__(self, base_url: str, token: str | None = None,
+                 token_file: str | None = None,
                  ca_file: str | None = None, insecure: bool = False,
                  timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # Re-read per request (client-go behavior): GKE bound SA tokens
+        # expire hourly and the kubelet rotates the file in place.
+        self.token_file = token_file
         self.timeout = timeout
         if base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
@@ -57,8 +61,15 @@ class K8sClient:
             url += "?" + urllib.parse.urlencode(params)
         data = None
         headers = {"Accept": "application/json"}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+        token = self.token
+        if self.token_file:
+            try:
+                with open(self.token_file) as f:
+                    token = f.read().strip()
+            except OSError:
+                pass  # keep the cached token; better a 401 than a crash
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = content_type
@@ -164,7 +175,9 @@ def in_cluster_client(timeout: float = 10.0) -> K8sClient:
     if not host:
         raise RuntimeError("not running in a cluster "
                            "(KUBERNETES_SERVICE_HOST unset)")
-    with open(os.path.join(SA_DIR, "token")) as f:
+    token_file = os.path.join(SA_DIR, "token")
+    with open(token_file) as f:
         token = f.read().strip()
     return K8sClient(f"https://{host}:{port}", token=token,
+                     token_file=token_file,
                      ca_file=os.path.join(SA_DIR, "ca.crt"), timeout=timeout)

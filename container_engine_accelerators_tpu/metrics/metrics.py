@@ -77,23 +77,34 @@ class MetricServer:
     def update_once(self) -> None:
         model = self.manager.device_info.chip_generation()
 
-        # Node-level: every discovered chip.
-        for chip in sorted(self.manager._chips):
+        # One sample per chip per cycle: the sysfs sampler's duty cycle is
+        # a delta between consecutive calls, so sampling again for the
+        # container view microseconds later would return a garbage window.
+        samples = {}
+        for chip in self.manager.chip_indices():
             s = self.sampler.sample(chip)
-            if s is None:
-                continue
+            if s is not None:
+                samples[chip] = s
+
+        # Clear everything each cycle so exited pods and vanished chips
+        # drop out (the 1-minute reset loop of reference metrics.go:241-253
+        # — stale node gauges would otherwise mask a lost chip).
+        self.node_duty_cycle.clear()
+        self.node_memory_used.clear()
+        self.node_memory_total.clear()
+        self.duty_cycle.clear()
+        self.memory_used.clear()
+        self.memory_total.clear()
+        self.request_count.clear()
+
+        for chip, s in sorted(samples.items()):
             labels = dict(tpu_chip=f"accel{chip}", model=model)
             self.node_duty_cycle.labels(**labels).set(s.duty_cycle_pct)
             self.node_memory_used.labels(**labels).set(s.memory_used_bytes)
             self.node_memory_total.labels(**labels).set(s.memory_total_bytes)
 
         # Container-level: PodResources attribution (reference
-        # devices.go:51-101). Clear first so exited pods drop out (the
-        # 1-minute reset loop of metrics.go:241-253).
-        self.duty_cycle.clear()
-        self.memory_used.clear()
-        self.memory_total.clear()
-        self.request_count.clear()
+        # devices.go:51-101).
         try:
             attributions = self.pod_resources.containers_with_devices()
         except Exception:
@@ -106,7 +117,7 @@ class MetricServer:
                 namespace=attr.namespace, pod=attr.pod,
                 container=attr.container).set(len(attr.device_ids))
             for chip in chips:
-                s = self.sampler.sample(chip)
+                s = samples.get(chip)
                 if s is None:
                     continue
                 labels = dict(namespace=attr.namespace, pod=attr.pod,

@@ -113,7 +113,10 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              temperature: float = 0.0,
              key: jax.Array | None = None) -> jnp.ndarray:
     """Greedy (temperature=0) or sampled generation. prompt: [B, T0].
-    Returns [B, T0 + max_new_tokens]."""
+    Returns [B, T0 + max_new_tokens]. With temperature > 0 and no `key`,
+    a fixed default key is used (deterministic sampling)."""
+    if temperature > 0.0 and key is None:
+        key = jax.random.key(0)
     b, t0 = prompt.shape
     max_len = max_len or (t0 + max_new_tokens)
     cache = init_cache(cfg, b, max_len)

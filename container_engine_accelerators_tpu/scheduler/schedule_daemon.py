@@ -184,8 +184,12 @@ def run_once(k8s) -> int:
 
     nodes = k8s.list_nodes()["items"]
     running = k8s.list_pods()["items"]
+    # Terminated pods keep spec.nodeName until garbage-collected but hold
+    # no devices — counting them would leak capacity forever.
     assigned = [p for p in running
-                if p.get("spec", {}).get("nodeName")]
+                if p.get("spec", {}).get("nodeName")
+                and p.get("status", {}).get("phase")
+                not in ("Succeeded", "Failed")]
     free = free_tpus_by_node(nodes, assigned)
 
     scheduled = 0

@@ -11,6 +11,7 @@ params update in place in HBM.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Iterator, NamedTuple
 
 import jax
@@ -141,8 +142,11 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     preemption/restart, which this provides).
 
     On start, restores the newest checkpoint under `ckpt_dir` if one
-    exists and skips to that step; saves every `save_every` steps and at
-    the end. Returns (state, last_metrics).
+    exists and skips to that step — including fast-forwarding the batch
+    stream by that many batches, so `batches` must be the same
+    deterministic stream from step 0 (training/dataset.py streams are).
+    Saves every `save_every` steps and at the end. Returns
+    (state, last_metrics).
     """
     import jax.random as jrandom
 
@@ -163,10 +167,20 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     step_fn = make_train_step(cfg, mesh, optimizer)
     sp = cfg.sequence_parallel
     start_step = int(jax.device_get(state.step))
+    if start_step:
+        # Skip already-consumed data; without this, every resume would
+        # re-train on the stream's first start_step batches.
+        batches = itertools.islice(batches, start_step, None)
     metrics = None
-    for i, batch in enumerate(batches):
+    it = iter(batches)
+    i = 0
+    while True:
         step_no = start_step + i
         if max_steps is not None and step_no >= max_steps:
+            break
+        try:
+            batch = next(it)
+        except StopIteration:
             break
         batch = shard_batch(batch, mesh, sp)
         state, metrics = step_fn(state, batch)
@@ -176,6 +190,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         if log_every and i % log_every == 0:
             m = jax.device_get(metrics)
             log_fn(f"step {cur} loss {float(m['loss']):.4f}")
+        i += 1
     if mngr is not None:
         final = int(jax.device_get(state.step))
         if mngr.latest_step() != final:

@@ -4,7 +4,7 @@ profiler/xplane dump hooks in the demo layer').
 
 Usage in training loops / benches:
 
-    with maybe_profile(steps=(10, 15)):      # or TPU_PROFILE_DIR env
+    with maybe_profile("/tmp/trace"):        # or set TPU_PROFILE_DIR env
         for i, batch in enumerate(batches):
             with annotate(f"step{i}"):
                 state, metrics = step(state, batch)

@@ -202,3 +202,28 @@ def test_publish_version_annotations(tmp_path, fake_k8s, client):
     assert publish_version_annotations(client, "node-a", str(tmp_path))
     ann = fake_k8s.nodes["node-a"]["metadata"]["annotations"]
     assert ann["cloud.google.com/tpu.libtpu-version.full"] == "1.9.0"
+
+
+def test_k8s_client_rereads_token_file(tmp_path, fake_k8s):
+    # Bound SA tokens rotate on disk; each request must read the current
+    # file (the fake server echoes no auth, so assert via sent headers).
+    import urllib.request
+    tf = tmp_path / "token"
+    tf.write_text("tok-1")
+    client = K8sClient(fake_k8s.url, token="tok-1", token_file=str(tf))
+    captured = {}
+    orig = urllib.request.urlopen
+
+    def spy(req, **kw):
+        captured["auth"] = req.headers.get("Authorization")
+        return orig(req, **kw)
+
+    urllib.request.urlopen = spy
+    try:
+        client.list_nodes()
+        assert captured["auth"] == "Bearer tok-1"
+        tf.write_text("tok-2")
+        client.list_nodes()
+        assert captured["auth"] == "Bearer tok-2"
+    finally:
+        urllib.request.urlopen = orig

@@ -165,3 +165,45 @@ def test_metric_server_clears_stale_containers(tmp_path):
         assert 'pod="gone"' not in generate_latest(ms.registry).decode()
     finally:
         srv.stop()
+
+
+class CountingSampler:
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def sample(self, chip):
+        self.calls += 1
+        return self.inner.sample(chip)
+
+
+def test_metric_server_samples_once_per_chip_and_clears_node(tmp_path):
+    # Delta-based samplers must be called once per chip per cycle, and
+    # node gauges for vanished chips must drop out.
+    import os
+    dev = make_fake_devfs(tmp_path, n=2)
+    manager = TPUManager(TPUConfig(), MockDeviceInfo(dev))
+    manager.discover()
+    sock = str(tmp_path / "pr.sock")
+    resp = pb.ListPodResourcesResponse(pod_resources=[
+        pb.PodResources(name="p", namespace="ml", containers=[
+            pb.ContainerResources(name="c", devices=[
+                pb.ContainerDevices(resource_name="google.com/tpu",
+                                    device_ids=["accel0", "accel1"])])])])
+    srv = PodResourcesStubServer(sock, resp)
+    sampler = CountingSampler(FakeSampler({
+        0: ChipSample(10.0, 1, 2), 1: ChipSample(20.0, 1, 2)}))
+    try:
+        ms = MetricServer(manager, sampler=sampler,
+                          pod_resources=PodResourcesClient(socket_path=sock))
+        ms.update_once()
+        assert sampler.calls == 2  # one per chip despite container reuse
+        # Chip 1 disappears: node gauges must not keep serving it.
+        os.unlink(os.path.join(dev, "accel1"))
+        manager.discover()
+        ms.update_once()
+        text = generate_latest(ms.registry).decode()
+        assert 'node_duty_cycle{model="v5e",tpu_chip="accel0"}' in text
+        assert 'node_duty_cycle{model="v5e",tpu_chip="accel1"}' not in text
+    finally:
+        srv.stop()

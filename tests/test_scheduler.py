@@ -282,3 +282,15 @@ def test_topology_labels_no_metadata():
         assert topology_labels(md.url) == {}
     finally:
         md.stop()
+
+
+def test_run_once_ignores_terminated_pods(fake_k8s, client):
+    # A Succeeded pod still carrying nodeName must not consume capacity.
+    fake_k8s.nodes["n0"] = node("n0", tpus=4,
+                                labels=slice_labels("s1", "0-0"))
+    done = pod("old-job", gates=(), node="n0", phase="Succeeded")
+    fake_k8s.pods[("default", "old-job")] = done
+    fake_k8s.pods[("default", "j-0")] = pod("j-0",
+                                            labels={"job-name": "j"})
+    assert sd.run_once(client) == 1
+    assert fake_k8s.pods[("default", "j-0")]["spec"]["schedulingGates"] == []
