@@ -24,8 +24,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# 1024 blocks measured fastest on v5e (2.75x over XLA attention at S=2048,
+# 73x at S=8192, see PARITY.md bench notes); _pick_block degrades for
+# shorter sequences.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 
 def supported(q, k, v) -> bool:
