@@ -1,0 +1,91 @@
+"""HuggingFace Llama checkpoint conversion.
+
+Maps `transformers` LlamaForCausalLM weights onto this repo's stacked
+param pytree so real Llama-3-family checkpoints train/serve here. The
+numerical contract is tested end-to-end: logits from models.llama.forward
+must match the torch reference implementation on the same weights
+(tests/test_convert.py).
+
+Layout notes:
+  - HF Linear stores [out, in]; our matmuls are x @ W, so every
+    projection transposes.
+  - HF rotary uses the rotate-half convention — identical to
+    ops/rope.py's split-half rotation, so Q/K need no permutation.
+  - Per-layer tensors stack on a leading [n_layers] axis (lax.scan).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from container_engine_accelerators_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Build a LlamaConfig from a transformers LlamaConfig."""
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 500_000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+    )
+
+
+def _t(tensor) -> np.ndarray:
+    return np.asarray(tensor.detach().cpu().float().numpy())
+
+
+def params_from_hf(model, dtype=np.float32) -> dict:
+    """Convert a transformers LlamaForCausalLM (in memory) to our pytree.
+
+    For on-disk checkpoints, load with
+    `LlamaForCausalLM.from_pretrained(dir)` first — loading stays in
+    torch land so sharded/safetensors formats come for free.
+    """
+    sd = model.state_dict()
+    n_layers = model.config.num_hidden_layers
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = []
+        for i in range(n_layers):
+            w = _t(sd[fmt.format(i=i)])
+            mats.append(w.T if transpose else w)
+        return np.stack(mats).astype(dtype)
+
+    embed = _t(sd["model.embed_tokens.weight"]).astype(dtype)
+    if "lm_head.weight" in sd:
+        lm_head = _t(sd["lm_head.weight"]).T.astype(dtype)
+    else:  # tied embeddings (Llama-3.2-1B/3B style)
+        lm_head = embed.T.copy()
+
+    return {
+        "embed": embed,
+        "layers": {
+            "attn_norm": stack(
+                "model.layers.{i}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
+            "mlp_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight", False),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", True),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", True),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", True),
+        },
+        "final_norm": _t(sd["model.norm.weight"]).astype(dtype),
+        "lm_head": lm_head,
+    }
+
+
+def load_hf_checkpoint(path: str):
+    """Load an on-disk HF Llama checkpoint -> (params, cfg)."""
+    from transformers import LlamaForCausalLM
+
+    model = LlamaForCausalLM.from_pretrained(path)
+    return params_from_hf(model), config_from_hf(model.config)

@@ -1,0 +1,71 @@
+"""HF Llama conversion: our forward must reproduce the canonical
+transformers implementation's logits on the same (random tiny) weights —
+the strongest correctness oracle available for models/llama.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from container_engine_accelerators_tpu.models import forward
+from container_engine_accelerators_tpu.models.convert import (
+    config_from_hf,
+    params_from_hf,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_config_mapping(hf_model):
+    cfg = config_from_hf(hf_model.config)
+    assert cfg.d_model == 64 and cfg.n_layers == 2
+    assert cfg.n_heads == 4 and cfg.n_kv_heads == 2
+    assert cfg.rope_theta == 10000.0
+
+
+def test_logits_match_transformers(hf_model):
+    cfg = config_from_hf(hf_model.config).__class__(
+        **{**config_from_hf(hf_model.config).__dict__,
+           "dtype": jnp.float32})
+    params = params_from_hf(hf_model)
+
+    tokens = np.array([[1, 5, 9, 42, 17, 99, 3, 64],
+                       [2, 4, 6, 8, 10, 12, 14, 16]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens, dtype=torch.long)).logits
+    got = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(got), ref.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tied_embeddings(hf_model):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        tie_word_embeddings=True)
+    torch.manual_seed(1)
+    tied = transformers.LlamaForCausalLM(hf_cfg)
+    tied.eval()
+    params = params_from_hf(tied)
+    np.testing.assert_allclose(params["lm_head"], params["embed"].T)
+    cfg = config_from_hf(tied.config)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32})
+    tokens = np.array([[3, 1, 4, 1, 5, 9, 2, 6]], dtype=np.int32)
+    with torch.no_grad():
+        ref = tied(torch.tensor(tokens, dtype=torch.long)).logits
+    got = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(got), ref.numpy(),
+                               rtol=2e-4, atol=2e-4)
