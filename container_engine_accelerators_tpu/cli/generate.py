@@ -1,0 +1,85 @@
+"""generate — text/token generation CLI over the KV-cache decode path.
+
+Loads a HuggingFace Llama checkpoint directory (models/convert.py) or a
+random tiny model, runs prefill + incremental decode, prints generated
+token ids (and text when the checkpoint ships a tokenizer).
+
+  python -m container_engine_accelerators_tpu.cli.generate \
+      --checkpoint /ckpt/llama3-8b --prompt "The TPU is" --max-new-tokens 64
+  python -m container_engine_accelerators_tpu.cli.generate --tiny \
+      --prompt-ids 1,5,42 --max-new-tokens 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", default=None,
+                   help="HF Llama checkpoint directory")
+    p.add_argument("--tiny", action="store_true",
+                   help="random llama_tiny instead of a checkpoint")
+    p.add_argument("--prompt", default=None, help="text (needs tokenizer)")
+    p.add_argument("--prompt-ids", default=None,
+                   help="comma-separated token ids")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import decode as dec
+    from container_engine_accelerators_tpu.models import (
+        init_params,
+        llama_tiny,
+    )
+
+    tokenizer = None
+    if args.tiny or not args.checkpoint:
+        cfg = llama_tiny()
+        params = init_params(jax.random.key(args.seed), cfg)
+    else:
+        from container_engine_accelerators_tpu.models.convert import (
+            load_hf_checkpoint,
+        )
+        params, cfg = load_hf_checkpoint(args.checkpoint)
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.checkpoint)
+        except Exception:
+            tokenizer = None
+
+    if args.prompt_ids:
+        ids = [int(x) for x in args.prompt_ids.split(",")]
+    elif args.prompt and tokenizer is not None:
+        ids = tokenizer.encode(args.prompt)
+    elif args.prompt:
+        print("no tokenizer available; use --prompt-ids", file=sys.stderr)
+        return 2
+    else:
+        ids = [1]
+    prompt = jnp.asarray([ids], jnp.int32)
+
+    key = jax.random.key(args.seed) if args.temperature > 0 else None
+    t0 = time.perf_counter()
+    out = dec.generate(params, prompt, cfg, args.max_new_tokens,
+                       temperature=args.temperature, key=key)
+    out_ids = [int(t) for t in out[0]]
+    dt = time.perf_counter() - t0
+    print("token ids:", out_ids)
+    if tokenizer is not None:
+        print("text:", tokenizer.decode(out_ids))
+    print(f"# {args.max_new_tokens} tokens in {dt:.2f}s "
+          f"({args.max_new_tokens / dt:.1f} tok/s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
