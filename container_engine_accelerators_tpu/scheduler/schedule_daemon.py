@@ -120,25 +120,37 @@ def free_tpus_by_node(nodes: list[dict], running_pods: list[dict]
 def assign_pods(pods: list[dict], nodes: list[dict],
                 free: dict[str, int]) -> dict[str, str] | None:
     """Map pod name -> node name for the whole group, or None if the gang
-    does not fit. One pod per node (TPU workers are host-exclusive; the
-    multi-pods-per-node case collapses to capacity counting)."""
+    does not fit.
+
+    Uniform per-pod demand (the TPU norm — every worker asks for the same
+    chip count) expands each node into free//demand slots, so several
+    small workers can share one host; mixed demands fall back to one pod
+    per node."""
     demands = [(pod["metadata"]["name"], _pod_tpu_request(pod))
                for pod in sorted(pods, key=pod_sort_key)]
-    topos = []
+    uniform = len({d for _, d in demands}) == 1
+    demand0 = demands[0][1] if demands else 0
+
+    slots: list[tuple[NodeTopology, int]] = []
     for node in nodes:
         name = node["metadata"]["name"]
-        if free.get(name, 0) <= 0:
+        cap = free.get(name, 0)
+        if cap <= 0:
             continue
         labels = node.get("metadata", {}).get("labels", {}) or {}
-        topos.append((NodeTopology.from_labels(name, labels), free[name]))
-    if len(topos) < len(demands):
+        topo = NodeTopology.from_labels(name, labels)
+        if uniform and demand0 > 0:
+            slots.extend((topo, demand0) for _ in range(cap // demand0))
+        else:
+            slots.append((topo, cap))
+    if len(slots) < len(demands):
         return None
-    topos.sort(key=lambda t: topology_sort_key(t[0]))
+    slots.sort(key=lambda t: topology_sort_key(t[0]))
 
     best, best_score = None, None
-    n, k = len(topos), len(demands)
+    n, k = len(slots), len(demands)
     for start in range(n - k + 1):
-        window = topos[start:start + k]
+        window = slots[start:start + k]
         if any(cap < demand for (_, cap), (_, demand)
                in zip(window, demands)):
             continue

@@ -294,3 +294,27 @@ def test_run_once_ignores_terminated_pods(fake_k8s, client):
                                             labels={"job-name": "j"})
     assert sd.run_once(client) == 1
     assert fake_k8s.pods[("default", "j-0")]["spec"]["schedulingGates"] == []
+
+
+def test_assign_multiple_pods_share_a_node():
+    # Two 2-chip workers pack onto one 4-chip host (same-node distance 0
+    # beats spreading across hosts).
+    nodes = [node("n0", tpus=4, labels=slice_labels("s1", "0-0")),
+             node("n1", tpus=4, labels=slice_labels("s2", "0-0",
+                                                    rack="r2"))]
+    pods = [pod("j-0", labels={"job-name": "j"}, tpus=2),
+            pod("j-1", labels={"job-name": "j"}, tpus=2)]
+    free = sd.free_tpus_by_node(nodes, [])
+    got = sd.assign_pods(pods, nodes, free)
+    assert got == {"j-0": "n0", "j-1": "n0"} or \
+        got == {"j-0": "n1", "j-1": "n1"}
+
+
+def test_assign_mixed_demands_one_pod_per_node():
+    nodes = [node("n0", tpus=4), node("n1", tpus=4)]
+    pods = [pod("j-0", labels={"job-name": "j"}, tpus=1),
+            pod("j-1", labels={"job-name": "j"}, tpus=3)]
+    free = sd.free_tpus_by_node(nodes, [])
+    got = sd.assign_pods(pods, nodes, free)
+    assert got is not None
+    assert got["j-0"] != got["j-1"]
