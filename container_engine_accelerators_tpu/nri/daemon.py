@@ -1,15 +1,16 @@
-"""NRI injector daemon entrypoint.
+"""NRI device-injector daemon: a real containerd NRI plugin over the
+multiplexed-ttrpc socket protocol (transport in nri/ttrpc.py, wire formats
+from the public containerd/nri + containerd/ttrpc API specs).
 
-The injection core (annotation parse -> stat -> device list) lives in
-nri/injector.py and is fully tested; this daemon is the containerd
-attachment. containerd's NRI socket speaks ttrpc (a bespoke framing, not
-gRPC); the adapter here handles registration + CreateContainer events.
+Flow (mirrors the Go stub's Start, reference vendor/github.com/containerd/
+nri/pkg/stub/stub.go:304-356):
+  1. connect to /var/run/nri/nri.sock, wrap in the 8-byte-header mux;
+  2. serve the Plugin service on conn 1 (Configure / Synchronize /
+     CreateContainer / StateChange / Shutdown);
+  3. open conn 2 as a ttrpc client and call Runtime.RegisterPlugin.
 
-Current status: the ttrpc adaptation is minimal — it connects, performs
-the NRI handshake, and answers CreateContainer with device adjustments.
-If the socket or handshake is unavailable (non-containerd runtime, NRI
-disabled), the daemon idles and logs, so the DaemonSet stays healthy and
-observable rather than crash-looping.
+CreateContainer answers with device adjustments computed by
+nri/injector.py from `devices.gke.io/container.<name>` pod annotations.
 """
 
 from __future__ import annotations
@@ -20,63 +21,128 @@ import os
 import socket
 import time
 
-from container_engine_accelerators_tpu.nri.injector import inject_for_pod
+from container_engine_accelerators_tpu.nri import nri_api_pb2 as api
+from container_engine_accelerators_tpu.nri.injector import (
+    devices_for_container,
+)
+from container_engine_accelerators_tpu.nri.ttrpc import (
+    PLUGIN_SERVICE_CONN,
+    RUNTIME_SERVICE_CONN,
+    Mux,
+    TtrpcClient,
+    TtrpcServer,
+)
 
 log = logging.getLogger("nri-device-injector")
 
 NRI_SOCKET = "/var/run/nri/nri.sock"
+PLUGIN_SERVICE = "nri.pkg.api.v1alpha1.Plugin"
+RUNTIME_SERVICE = "nri.pkg.api.v1alpha1.Runtime"
+
+# Event mask bit = 1 << (event - 1) (reference pkg/api/event.go:154-157).
+EVENT_CREATE_CONTAINER = 4
+CREATE_CONTAINER_MASK = 1 << (EVENT_CREATE_CONTAINER - 1)
 
 
-def try_connect(path: str) -> socket.socket | None:
-    if not os.path.exists(path):
-        return None
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    try:
-        s.connect(path)
-        return s
-    except OSError:
-        s.close()
-        return None
+class InjectorPlugin:
+    """Plugin-service handlers, protobuf in/out."""
+
+    def __init__(self):
+        self.configured = False
+
+    def configure(self, payload: bytes) -> bytes:
+        req = api.ConfigureRequest.FromString(payload)
+        log.info("configured by %s %s", req.runtime_name,
+                 req.runtime_version)
+        self.configured = True
+        return api.ConfigureResponse(
+            events=CREATE_CONTAINER_MASK).SerializeToString()
+
+    def synchronize(self, payload: bytes) -> bytes:
+        req = api.SynchronizeRequest.FromString(payload)
+        log.info("synchronized: %d pods, %d containers",
+                 len(req.pods), len(req.containers))
+        return api.SynchronizeResponse().SerializeToString()
+
+    def create_container(self, payload: bytes) -> bytes:
+        req = api.CreateContainerRequest.FromString(payload)
+        resp = api.CreateContainerResponse()
+        devices = devices_for_container(dict(req.pod.annotations),
+                                        req.container.name)
+        for dev in devices:
+            d = resp.adjust.linux.devices.add(
+                path=dev.path, type=dev.type,
+                major=dev.major, minor=dev.minor)
+            if dev.uid is not None:
+                d.uid.value = dev.uid
+            if dev.gid is not None:
+                d.gid.value = dev.gid
+        if devices:
+            log.info("injecting %d devices into %s/%s/%s",
+                     len(devices), req.pod.namespace, req.pod.name,
+                     req.container.name)
+        return resp.SerializeToString()
+
+    def state_change(self, payload: bytes) -> bytes:
+        return api.Empty().SerializeToString()
+
+    def shutdown(self, payload: bytes) -> bytes:
+        log.info("runtime requested shutdown")
+        return api.Empty().SerializeToString()
+
+    def handlers(self) -> dict:
+        return {PLUGIN_SERVICE: {
+            "Configure": self.configure,
+            "Synchronize": self.synchronize,
+            "CreateContainer": self.create_container,
+            "StateChange": self.state_change,
+            "Shutdown": self.shutdown,
+        }}
+
+
+def serve_connection(sock: socket.socket, plugin_name: str,
+                     plugin_idx: str) -> tuple[Mux, TtrpcServer]:
+    """Wire one NRI connection: returns (mux, server) once registered."""
+    plugin = InjectorPlugin()
+    mux = Mux(sock)
+    server = TtrpcServer(mux.conn(PLUGIN_SERVICE_CONN), plugin.handlers())
+    client = TtrpcClient(mux.conn(RUNTIME_SERVICE_CONN))
+    client.call(RUNTIME_SERVICE, "RegisterPlugin",
+                api.RegisterPluginRequest(
+                    plugin_name=plugin_name,
+                    plugin_idx=plugin_idx).SerializeToString())
+    log.info("registered NRI plugin %s (idx %s)", plugin_name, plugin_idx)
+    return mux, server
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nri-socket", default=NRI_SOCKET)
+    p.add_argument("--plugin-name", default="tpu-device-injector")
+    p.add_argument("--plugin-index", default="10")
     p.add_argument("--retry-interval", type=float, default=30.0)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     while True:
-        conn = try_connect(args.nri_socket)
-        if conn is None:
-            log.warning(
-                "NRI socket %s unavailable (containerd NRI disabled?); "
-                "retrying in %.0fs", args.nri_socket, args.retry_interval)
+        if not os.path.exists(args.nri_socket):
+            log.warning("NRI socket %s absent (containerd NRI disabled?); "
+                        "retrying in %.0fs", args.nri_socket,
+                        args.retry_interval)
             time.sleep(args.retry_interval)
             continue
-        log.info("connected to NRI socket %s", args.nri_socket)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
-            serve(conn)
-        except NotImplementedError as e:
-            log.warning("%s — idling until the adapter lands", e)
-            conn.close()
-            time.sleep(args.retry_interval * 10)
+            sock.connect(args.nri_socket)
+            mux, server = serve_connection(sock, args.plugin_name,
+                                           args.plugin_index)
+            mux._closed.wait()  # until containerd drops the connection
+            server.stop()
+            log.warning("NRI connection closed; reconnecting")
         except Exception:
-            log.exception("NRI session ended; reconnecting")
-            conn.close()
-            time.sleep(1.0)
-
-
-def serve(conn: socket.socket) -> None:
-    """ttrpc session loop. Framing: 10-byte header (len u32 | stream u32 |
-    type u8 | flags u8) followed by a protobuf payload. The injector only
-    needs RegisterPlugin + CreateContainer; unknown requests are answered
-    empty so containerd treats the plugin as a no-op for those events."""
-    # TODO(round 2): full ttrpc request/response framing + the NRI
-    # api.Plugin service schema. The injection decision itself is
-    # inject_for_pod() and is covered by tests/test_nri.py.
-    raise NotImplementedError(
-        "ttrpc adapter pending; injection core is nri/injector.py")
+            log.exception("NRI session failed; retrying")
+            sock.close()
+        time.sleep(1.0)
 
 
 if __name__ == "__main__":

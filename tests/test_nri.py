@@ -78,3 +78,140 @@ def test_devices_for_container_missing_node(tmp_path):
     ann = {ANNOTATION_PREFIX + "c": f"- path: {tmp_path}/nope\n"}
     with pytest.raises(ValueError):
         devices_for_container(ann, "c")
+
+
+# ---------- ttrpc/mux transport + full plugin loop ----------
+
+def _fake_containerd(sock):
+    """The runtime side of one NRI connection, using the same transport:
+    ttrpc server for Runtime on conn 2, ttrpc client for Plugin on conn 1."""
+    from container_engine_accelerators_tpu.nri import nri_api_pb2 as api
+    from container_engine_accelerators_tpu.nri.ttrpc import (
+        PLUGIN_SERVICE_CONN,
+        RUNTIME_SERVICE_CONN,
+        Mux,
+        TtrpcClient,
+        TtrpcServer,
+    )
+
+    registered = []
+
+    def register_plugin(payload):
+        registered.append(api.RegisterPluginRequest.FromString(payload))
+        return api.Empty().SerializeToString()
+
+    mux = Mux(sock)
+    server = TtrpcServer(mux.conn(RUNTIME_SERVICE_CONN), {
+        "nri.pkg.api.v1alpha1.Runtime": {
+            "RegisterPlugin": register_plugin}})
+    client = TtrpcClient(mux.conn(PLUGIN_SERVICE_CONN))
+    return mux, server, client, registered
+
+
+def test_nri_plugin_end_to_end(tmp_path):
+    import socket
+    import time
+
+    from container_engine_accelerators_tpu.nri import nri_api_pb2 as api
+    from container_engine_accelerators_tpu.nri.daemon import (
+        CREATE_CONTAINER_MASK,
+        PLUGIN_SERVICE,
+        serve_connection,
+    )
+
+    runtime_sock, plugin_sock = socket.socketpair()
+    rt_mux, rt_server, rt_client, registered = _fake_containerd(runtime_sock)
+
+    import threading
+    result = {}
+
+    def plugin_side():
+        result["mux"], result["server"] = serve_connection(
+            plugin_sock, "tpu-device-injector", "10")
+
+    t = threading.Thread(target=plugin_side, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "plugin registration hung"
+    assert registered and registered[0].plugin_name == "tpu-device-injector"
+
+    # Configure: plugin must subscribe to CreateContainer.
+    resp = api.ConfigureResponse.FromString(rt_client.call(
+        PLUGIN_SERVICE, "Configure",
+        api.ConfigureRequest(runtime_name="containerd",
+                             runtime_version="2.0").SerializeToString()))
+    assert resp.events & CREATE_CONTAINER_MASK
+
+    # Synchronize with existing state.
+    sync = api.SynchronizeResponse.FromString(rt_client.call(
+        PLUGIN_SERVICE, "Synchronize",
+        api.SynchronizeRequest().SerializeToString()))
+    assert list(sync.update) == []
+
+    # CreateContainer with a device annotation (root: real mknod).
+    if os.getuid() == 0:
+        node = tmp_path / "accel0"
+        os.mknod(str(node), 0o600 | 0o020000, os.makedev(240, 5))
+        pod = api.PodSandbox(name="train", namespace="ml")
+        pod.annotations[ANNOTATION_PREFIX + "sidecar"] = \
+            f"- path: {node}\n"
+        req = api.CreateContainerRequest(
+            pod=pod, container=api.Container(name="sidecar"))
+        cresp = api.CreateContainerResponse.FromString(rt_client.call(
+            PLUGIN_SERVICE, "CreateContainer", req.SerializeToString()))
+        devs = cresp.adjust.linux.devices
+        assert len(devs) == 1
+        assert devs[0].path == str(node)
+        assert devs[0].type == "c"
+        assert (devs[0].major, devs[0].minor) == (240, 5)
+
+    # Container without annotations: empty adjustment, no error.
+    cresp = api.CreateContainerResponse.FromString(rt_client.call(
+        PLUGIN_SERVICE, "CreateContainer",
+        api.CreateContainerRequest(
+            pod=api.PodSandbox(name="p"),
+            container=api.Container(name="main")).SerializeToString()))
+    assert len(cresp.adjust.linux.devices) == 0
+
+    # Unknown method surfaces an rpc error, not a hang.
+    with pytest.raises(RuntimeError):
+        rt_client.call(PLUGIN_SERVICE, "NoSuchMethod", b"")
+
+    result["server"].stop()
+    rt_server.stop()
+    rt_mux.close()
+    result["mux"].close()
+
+
+def test_nri_malformed_annotation_is_rpc_error(tmp_path):
+    import socket
+    import threading
+
+    from container_engine_accelerators_tpu.nri import nri_api_pb2 as api
+    from container_engine_accelerators_tpu.nri.daemon import (
+        PLUGIN_SERVICE,
+        serve_connection,
+    )
+
+    runtime_sock, plugin_sock = socket.socketpair()
+    rt_mux, rt_server, rt_client, registered = _fake_containerd(runtime_sock)
+    holder = {}
+    t = threading.Thread(
+        target=lambda: holder.update(zip(("mux", "server"), serve_connection(
+            plugin_sock, "x", "10"))), daemon=True)
+    t.start()
+    t.join(timeout=10)
+
+    pod = api.PodSandbox(name="p")
+    pod.annotations[ANNOTATION_PREFIX + "c"] = "not a list"
+    with pytest.raises(RuntimeError) as err:
+        rt_client.call(PLUGIN_SERVICE, "CreateContainer",
+                       api.CreateContainerRequest(
+                           pod=pod,
+                           container=api.Container(name="c"),
+                       ).SerializeToString())
+    assert "rpc error 13" in str(err.value)
+    holder["server"].stop()
+    rt_server.stop()
+    rt_mux.close()
+    holder["mux"].close()
