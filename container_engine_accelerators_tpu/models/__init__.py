@@ -9,6 +9,8 @@ from container_engine_accelerators_tpu.models.llama import (
     LlamaConfig,
     llama3_8b,
     llama3_1b,
+    llama3_70b,
+    llama3_405b,
     llama_tiny,
     init_params,
     forward,
@@ -18,6 +20,8 @@ __all__ = [
     "LlamaConfig",
     "llama3_8b",
     "llama3_1b",
+    "llama3_70b",
+    "llama3_405b",
     "llama_tiny",
     "init_params",
     "forward",

@@ -53,8 +53,8 @@ class LlamaConfig:
     # 0 disables pipelining. Requires n_layers % pp == 0.
     pipeline_microbatches: int = 0
     # Mixture-of-Experts FFN (models/moe.py): 0 experts = dense MLP.
-    # Expert weights shard over the 'ep' mesh axis. Not combinable with
-    # pipeline_microbatches (aux losses don't thread through the pipeline).
+    # Expert weights shard over the 'ep' mesh axis; composes with the
+    # pipeline (router aux losses ride the with_aux channel).
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -99,6 +99,20 @@ def llama3_8b(**overrides) -> LlamaConfig:
 def llama3_1b(**overrides) -> LlamaConfig:
     kw = dict(vocab_size=128256, d_model=2048, n_layers=16, n_heads=32,
               n_kv_heads=8, d_ff=8192)
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def llama3_70b(**overrides) -> LlamaConfig:
+    kw = dict(vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
+              n_kv_heads=8, d_ff=28672)
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def llama3_405b(**overrides) -> LlamaConfig:
+    kw = dict(vocab_size=128256, d_model=16384, n_layers=126, n_heads=128,
+              n_kv_heads=8, d_ff=53248, max_seq_len=16384)
     kw.update(overrides)
     return LlamaConfig(**kw)
 
@@ -242,20 +256,26 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         if cfg.n_layers % pp:
             raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
                              f"pp={pp}")
-        if cfg.n_experts:
-            raise ValueError("MoE + pipeline parallelism not supported "
-                             "yet (aux losses don't cross the pipeline)")
         from container_engine_accelerators_tpu.parallel.pipeline import (
             pipeline,
         )
 
-        def stage_fn(local_layers, x_mb):
-            out, _ = jax.lax.scan(layer_body, x_mb, local_layers)
-            return out
+        if cfg.n_experts:
+            def stage_fn(local_layers, x_mb):
+                out, aux = jax.lax.scan(layer_body, x_mb, local_layers)
+                return out, jnp.sum(aux)
 
-        x = pipeline(stage_fn, params["layers"], x, mesh,
-                     cfg.pipeline_microbatches)
-        aux_total = None
+            x, aux_total = pipeline(stage_fn, params["layers"], x, mesh,
+                                    cfg.pipeline_microbatches,
+                                    with_aux=True)
+        else:
+            def stage_fn(local_layers, x_mb):
+                out, _ = jax.lax.scan(layer_body, x_mb, local_layers)
+                return out
+
+            x = pipeline(stage_fn, params["layers"], x, mesh,
+                         cfg.pipeline_microbatches)
+            aux_total = None
     else:
         x, aux = jax.lax.scan(layer_body, x, params["layers"])
         aux_total = jnp.sum(aux) if aux is not None else None

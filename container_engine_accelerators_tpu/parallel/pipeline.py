@@ -28,15 +28,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
-             axis: str = "pp"):
+             axis: str = "pp", with_aux: bool = False):
     """Run x through P pipeline stages.
 
-    stage_fn(stage_local_params, x_mb) -> x_mb, where stage_local_params
+    stage_fn(stage_local_params, x_mb) -> x_mb (or (x_mb, aux_scalar)
+    when `with_aux` — e.g. MoE router losses), where stage_local_params
     is `params` with the stacked leading axis reduced to L/P local layers.
 
     params: pytree of [L, ...] arrays (sharded P('pp') outside).
     x: [B, S, D] activations. B must divide by n_microbatches.
-    Returns [B, S, D] after all L layers.
+    Returns [B, S, D] (or ([B, S, D], total_aux) with `with_aux`; aux is
+    summed over every stage and microbatch via an f32 psum).
     """
     n_stages = mesh.shape[axis]
     if n_stages == 1:
@@ -64,7 +66,7 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
         send_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_sum = carry
             mb_idx = t - stage
             active = jnp.logical_and(mb_idx >= 0, mb_idx < m)
             first_in = jax.lax.dynamic_index_in_dim(
@@ -74,7 +76,12 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
             # branchless keeps the partitioner happy (lax.cond inside
             # grad-of-shard_map with mixed auto axes trips an XLA SPMD
             # CHECK, "invalid binary instruction opcode copy").
-            out = stage_fn(local_params, inp)
+            if with_aux:
+                out, aux = stage_fn(local_params, inp)
+                aux_sum = aux_sum + jnp.where(active,
+                                              aux.astype(jnp.float32), 0.0)
+            else:
+                out = stage_fn(local_params, inp)
             idx = jnp.clip(mb_idx, 0, m - 1)
             cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0,
                                                keepdims=False)
@@ -82,10 +89,11 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
             outputs = jax.lax.dynamic_update_index_in_dim(outputs, upd,
                                                           idx, 0)
             state = jax.lax.ppermute(out, axis, send_perm)
-            return (state, outputs), None
+            return (state, outputs, aux_sum), None
 
-        init = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all))
-        (_, outputs), _ = jax.lax.scan(
+        init = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all),
+                jnp.zeros((), jnp.float32))
+        (_, outputs, aux_sum), _ = jax.lax.scan(
             tick, init, jnp.arange(m + n_stages - 1))
         # Only the last stage holds the fully-processed activations; a
         # masked psum broadcasts them to every pp rank. The psum runs in
@@ -93,13 +101,20 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
         # ("invalid binary instruction opcode copy") on the CPU backend.
         masked = jnp.where(stage == n_stages - 1,
                            outputs.astype(jnp.float32), 0.0)
-        return jax.lax.psum(masked, axis).astype(outputs.dtype)
+        result = jax.lax.psum(masked, axis).astype(outputs.dtype)
+        if with_aux:
+            return result, jax.lax.psum(aux_sum, axis)
+        return result
 
+    out_specs = (P(), P()) if with_aux else P()
     out = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(axis), P()),
-        out_specs=P(),
+        out_specs=out_specs,
         axis_names={axis},
         check_vma=False,
     )(params, x_mb)
+    if with_aux:
+        y, aux = out
+        return y.reshape(b, *x.shape[1:]), aux
     return out.reshape(b, *x.shape[1:])
