@@ -1,0 +1,192 @@
+"""serve — batched HTTP inference server over the KV-cache decode path
+(the production-shaped backing for demo/serving, replacing the inline toy
+loop; the reference's serving demo fronts TF-Serving the same way,
+reference demo/serving/tensorflow-serving.yaml).
+
+Batching model: requests are bucketed by (prompt_len, max_new_tokens,
+greedy), gathered for a short window, and decoded as one batch — uniform
+shapes keep every step jit-cache-hot (XLA recompiles on new shapes, so
+shape buckets are the TPU-native batching unit).
+
+  POST /generate  {"tokens": [...], "max_new_tokens": 16,
+                   "temperature": 0.0}
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("tpu-serve")
+
+
+class BatchingEngine:
+    def __init__(self, params, cfg, max_batch: int = 8,
+                 window_ms: float = 5.0, max_prompt_len: int = 1024):
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.window = window_ms / 1000.0
+        self.max_prompt_len = max_prompt_len
+        self.queue: queue.SimpleQueue = queue.SimpleQueue()
+        self.batches_run = 0
+        self.requests_served = 0
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._worker, daemon=True,
+                                       name="serve-batcher")
+        self.thread.start()
+
+    def submit(self, tokens: list[int], max_new_tokens: int,
+               temperature: float) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if not tokens or len(tokens) > self.max_prompt_len:
+            fut.set_exception(ValueError(
+                f"prompt length must be in [1, {self.max_prompt_len}]"))
+            return fut
+        if max_new_tokens < 1 or max_new_tokens > 1024:
+            fut.set_exception(ValueError(
+                "max_new_tokens must be in [1, 1024]"))
+            return fut
+        self.queue.put((tuple(tokens), max_new_tokens, temperature, fut))
+        return fut
+
+    def stop(self):
+        self._stop.set()
+
+    # ---------- worker ----------
+
+    @staticmethod
+    def _bucket_key(item):
+        tokens, n_new, temp, _ = item
+        return (len(tokens), n_new, temp <= 0.0)
+
+    def _worker(self):
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.models.decode import generate
+
+        pending: list = []
+        while not self._stop.is_set():
+            try:
+                pending.append(self.queue.get(timeout=0.1))
+            except queue.Empty:
+                continue
+            # Gather same-bucket requests for one window.
+            deadline = time.monotonic() + self.window
+            key = self._bucket_key(pending[0])
+            batch = [pending.pop(0)]
+            while len(batch) < self.max_batch and \
+                    time.monotonic() < deadline:
+                try:
+                    item = self.queue.get(
+                        timeout=max(deadline - time.monotonic(), 0.001))
+                except queue.Empty:
+                    break
+                if self._bucket_key(item) == key:
+                    batch.append(item)
+                else:
+                    pending.append(item)
+
+            tokens = jnp.asarray([item[0] for item in batch], jnp.int32)
+            n_new, temp = batch[0][1], batch[0][2]
+            try:
+                key_arr = (jax.random.key(int(time.time_ns()) & 0xFFFF)
+                           if temp > 0 else None)
+                out = generate(self.params, tokens, self.cfg, n_new,
+                               temperature=temp, key=key_arr)
+                out_host = [[int(t) for t in row] for row in out]
+                for item, row in zip(batch, out_host):
+                    item[3].set_result(row)
+                self.batches_run += 1
+                self.requests_served += len(batch)
+            except Exception as e:
+                log.exception("batch failed")
+                for item in batch:
+                    if not item[3].done():
+                        item[3].set_exception(e)
+
+
+def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, obj, status=200):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send({
+                    "ok": True,
+                    "batches": engine.batches_run,
+                    "requests": engine.requests_served})
+            return self._send({"error": "not found"}, 404)
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._send({"error": "not found"}, 404)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                fut = engine.submit(
+                    [int(t) for t in req["tokens"]],
+                    int(req.get("max_new_tokens", 16)),
+                    float(req.get("temperature", 0.0)))
+                return self._send({"tokens": fut.result(timeout=120)})
+            except (KeyError, ValueError, TypeError) as e:
+                return self._send({"error": str(e)}, 400)
+            except Exception as e:
+                return self._send({"error": str(e)}, 500)
+
+    return ThreadingHTTPServer(("", port), Handler)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--batch-window-ms", type=float, default=5.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+
+    from container_engine_accelerators_tpu.models import (
+        init_params,
+        llama_tiny,
+    )
+
+    if args.tiny or not args.checkpoint:
+        cfg = llama_tiny()
+        params = init_params(jax.random.key(0), cfg)
+    else:
+        from container_engine_accelerators_tpu.models.convert import (
+            load_hf_checkpoint,
+        )
+        params, cfg = load_hf_checkpoint(args.checkpoint)
+
+    engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
+                            window_ms=args.batch_window_ms)
+    server = make_server(engine, args.port)
+    log.info("serving on :%d (/generate, /healthz)", args.port)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
