@@ -89,3 +89,50 @@ def load_hf_checkpoint(path: str):
 
     model = LlamaForCausalLM.from_pretrained(path)
     return params_from_hf(model), config_from_hf(model.config)
+
+
+def params_to_hf(params: dict, cfg: LlamaConfig):
+    """Inverse mapping: our pytree -> a transformers LlamaForCausalLM
+    (so checkpoints trained here export to the HF ecosystem)."""
+    import torch
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+        attention_bias=False, tie_word_embeddings=False)
+    model = LlamaForCausalLM(hf_cfg)
+
+    def t(arr, transpose=False):
+        a = np.asarray(arr, dtype=np.float32)
+        return torch.tensor(a.T.copy() if transpose else a)
+
+    sd = {}
+    layers = params["layers"]
+    sd["model.embed_tokens.weight"] = t(params["embed"])
+    sd["lm_head.weight"] = t(params["lm_head"], transpose=True)
+    sd["model.norm.weight"] = t(params["final_norm"])
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        sd[pre + "input_layernorm.weight"] = t(layers["attn_norm"][i])
+        sd[pre + "post_attention_layernorm.weight"] = t(
+            layers["mlp_norm"][i])
+        sd[pre + "self_attn.q_proj.weight"] = t(layers["wq"][i], True)
+        sd[pre + "self_attn.k_proj.weight"] = t(layers["wk"][i], True)
+        sd[pre + "self_attn.v_proj.weight"] = t(layers["wv"][i], True)
+        sd[pre + "self_attn.o_proj.weight"] = t(layers["wo"][i], True)
+        sd[pre + "mlp.gate_proj.weight"] = t(layers["w_gate"][i], True)
+        sd[pre + "mlp.up_proj.weight"] = t(layers["w_up"][i], True)
+        sd[pre + "mlp.down_proj.weight"] = t(layers["w_down"][i], True)
+    model.load_state_dict(sd, strict=True)
+    model.eval()
+    return model
+
+
+def save_hf_checkpoint(params: dict, cfg: LlamaConfig, path: str) -> None:
+    params_to_hf(params, cfg).save_pretrained(path)

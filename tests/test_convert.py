@@ -69,3 +69,37 @@ def test_tied_embeddings(hf_model):
     got = forward(params, jnp.asarray(tokens), cfg)
     np.testing.assert_allclose(np.asarray(got), ref.numpy(),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_export_roundtrip(tmp_path):
+    # ours -> HF -> save -> load -> ours must be the identity, and the HF
+    # model's own forward must match ours on the exported weights.
+    import jax
+
+    from container_engine_accelerators_tpu.models import (
+        init_params,
+        llama_tiny,
+    )
+    from container_engine_accelerators_tpu.models.convert import (
+        load_hf_checkpoint,
+        save_hf_checkpoint,
+    )
+
+    cfg = llama_tiny(vocab_size=96, d_model=32, n_layers=2, n_heads=2,
+                     n_kv_heads=1, d_ff=64, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    save_hf_checkpoint(params, cfg, str(tmp_path / "export"))
+
+    params2, cfg2 = load_hf_checkpoint(str(tmp_path / "export"))
+    assert cfg2.d_model == cfg.d_model and cfg2.n_layers == cfg.n_layers
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6),
+        params, params2)
+
+    tokens = np.array([[5, 10, 15, 20]], dtype=np.int32)
+    cfg2f = cfg2.__class__(**{**cfg2.__dict__, "dtype": jnp.float32})
+    got = forward(params2, jnp.asarray(tokens), cfg2f)
+    expect = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
