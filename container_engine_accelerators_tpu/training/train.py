@@ -91,14 +91,40 @@ def loss_fn(params, batch, cfg: llama.LlamaConfig, constrain, mesh):
 
 
 def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
-                    optimizer: optax.GradientTransformation):
-    """Returns jitted `step(state, batch) -> (state, metrics)`."""
+                    optimizer: optax.GradientTransformation,
+                    grad_accum: int = 1):
+    """Returns jitted `step(state, batch) -> (state, metrics)`.
+
+    `grad_accum > 1` splits the batch's leading dim into that many
+    microbatches and averages their gradients under one `lax.scan` before
+    a single optimizer update — the standard trick for global batch sizes
+    whose activations exceed HBM (equal-sized microbatches make it
+    numerically the full-batch gradient)."""
     sp = cfg.sequence_parallel
     constrain = shd.make_constrain(mesh, sequence_parallel=sp)
+    grad_fn = jax.value_and_grad(loss_fn)
 
     def step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, batch, cfg, constrain, mesh)
+        if grad_accum > 1:
+            micro = jax.tree.map(
+                lambda x: x.reshape(grad_accum, x.shape[0] // grad_accum,
+                                    *x.shape[1:]), batch)
+
+            def accum(carry, mb):
+                loss_sum, grads_sum = carry
+                loss, grads = grad_fn(state.params, mb, cfg, constrain,
+                                      mesh)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grads_sum, grads)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (loss, grads), _ = jax.lax.scan(
+                accum, (jnp.zeros(()), zeros), micro)
+            loss = loss / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+        else:
+            loss, grads = grad_fn(state.params, batch, cfg, constrain,
+                                  mesh)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)

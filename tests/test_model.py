@@ -103,3 +103,24 @@ def test_train_step_sequence_parallel(mesh_sp):
         state, metrics = step_fn(state, batch)
     assert np.isfinite(float(metrics["loss"]))
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_grad_accumulation_matches_full_batch(mesh8):
+    # One step with grad_accum=2 must equal one step on the full batch
+    # (equal microbatches; all targets valid so per-microbatch means
+    # average to the full-batch mean).
+    cfg = llama_tiny(vocab_size=64, dtype=jnp.float32)
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=1, decay_steps=10)
+    batch = next(synthetic_batches(cfg.vocab_size, batch_size=8, seq_len=32))
+    batch = shard_batch(batch, mesh8)
+
+    s1 = create_train_state(jax.random.key(0), cfg, mesh8, opt)
+    s1, m1 = make_train_step(cfg, mesh8, opt)(s1, batch)
+    s2 = create_train_state(jax.random.key(0), cfg, mesh8, opt)
+    s2, m2 = make_train_step(cfg, mesh8, opt, grad_accum=2)(s2, batch)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(jax.device_get(a), jax.device_get(b),
+                                   rtol=2e-5, atol=2e-5)
