@@ -35,24 +35,16 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from container_engine_accelerators_tpu.models import decode as dec
-    from container_engine_accelerators_tpu.models import (
-        init_params,
-        llama_tiny,
-    )
+    from container_engine_accelerators_tpu.models.convert import load_model
 
+    checkpoint = None if args.tiny else args.checkpoint
+    params, cfg = load_model(checkpoint, seed=args.seed)
     tokenizer = None
-    if args.tiny or not args.checkpoint:
-        cfg = llama_tiny()
-        params = init_params(jax.random.key(args.seed), cfg)
-    else:
-        from container_engine_accelerators_tpu.models.convert import (
-            load_hf_checkpoint,
-        )
-        params, cfg = load_hf_checkpoint(args.checkpoint)
+    if checkpoint:
         try:
             from transformers import AutoTokenizer
 
-            tokenizer = AutoTokenizer.from_pretrained(args.checkpoint)
+            tokenizer = AutoTokenizer.from_pretrained(checkpoint)
         except Exception:
             tokenizer = None
 

@@ -65,7 +65,9 @@ class BatchingEngine:
     @staticmethod
     def _bucket_key(item):
         tokens, n_new, temp, _ = item
-        return (len(tokens), n_new, temp <= 0.0)
+        # Temperature is part of the key: one batch decodes with a single
+        # temperature, so mixing values would silently mis-sample.
+        return (len(tokens), n_new, temp)
 
     def _worker(self):
         import jax
@@ -75,10 +77,14 @@ class BatchingEngine:
 
         pending: list = []
         while not self._stop.is_set():
-            try:
-                pending.append(self.queue.get(timeout=0.1))
-            except queue.Empty:
-                continue
+            # Only block for new traffic when nothing is deferred —
+            # otherwise a bucket-mismatched request parked in `pending`
+            # would starve until unrelated requests arrive.
+            if not pending:
+                try:
+                    pending.append(self.queue.get(timeout=0.1))
+                except queue.Empty:
+                    continue
             # Gather same-bucket requests for one window.
             deadline = time.monotonic() + self.window
             key = self._bucket_key(pending[0])
@@ -164,21 +170,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    import jax
+    from container_engine_accelerators_tpu.models.convert import load_model
 
-    from container_engine_accelerators_tpu.models import (
-        init_params,
-        llama_tiny,
-    )
-
-    if args.tiny or not args.checkpoint:
-        cfg = llama_tiny()
-        params = init_params(jax.random.key(0), cfg)
-    else:
-        from container_engine_accelerators_tpu.models.convert import (
-            load_hf_checkpoint,
-        )
-        params, cfg = load_hf_checkpoint(args.checkpoint)
+    params, cfg = load_model(None if args.tiny else args.checkpoint)
 
     engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
                             window_ms=args.batch_window_ms)

@@ -91,6 +91,22 @@ def load_hf_checkpoint(path: str):
     return params_from_hf(model), config_from_hf(model.config)
 
 
+def load_model(checkpoint: str | None = None, seed: int = 0):
+    """Shared CLI loading policy (serve/generate): an HF checkpoint dir
+    when given, else a randomly-initialised tiny model -> (params, cfg)."""
+    if checkpoint:
+        return load_hf_checkpoint(checkpoint)
+    import jax
+
+    from container_engine_accelerators_tpu.models.llama import (
+        init_params,
+        llama_tiny,
+    )
+
+    cfg = llama_tiny()
+    return init_params(jax.random.key(seed), cfg), cfg
+
+
 def params_to_hf(params: dict, cfg: LlamaConfig):
     """Inverse mapping: our pytree -> a transformers LlamaForCausalLM
     (so checkpoints trained here export to the HF ecosystem)."""

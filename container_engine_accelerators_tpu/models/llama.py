@@ -268,6 +268,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             x, aux_total = pipeline(stage_fn, params["layers"], x, mesh,
                                     cfg.pipeline_microbatches,
                                     with_aux=True)
+            # The router losses are per-token means (batch-size
+            # invariant); the pipeline sums one per microbatch, so
+            # average to match the non-pipelined scale.
+            aux_total = aux_total / cfg.pipeline_microbatches
         else:
             def stage_fn(local_layers, x_mb):
                 out, _ = jax.lax.scan(layer_body, x_mb, local_layers)

@@ -138,6 +138,7 @@ def main(argv=None) -> int:
                                            args.plugin_index)
             mux._closed.wait()  # until containerd drops the connection
             server.stop()
+            mux.close()  # also closes sock — no fd leak per reconnect
             log.warning("NRI connection closed; reconnecting")
         except Exception:
             log.exception("NRI session failed; retrying")

@@ -148,3 +148,21 @@ def test_moe_inside_pipeline():
         state.params,
         jnp.zeros((8, 32), jnp.int32))
     assert float(aux) > 0
+
+
+def test_moe_pipeline_aux_scale_matches_unpipelined():
+    # The router aux term must have the same scale with and without the
+    # pipeline (per-token means; the pipeline averages over microbatches).
+    mesh_pp = make_mesh(MeshAxes(pp=2, ep=2, tp=2), devices=jax.devices())
+    cfg_pp = llama_tiny(vocab_size=64, n_experts=4, pipeline_microbatches=4,
+                        dtype=jnp.float32)
+    cfg_plain = llama_tiny(vocab_size=64, n_experts=4, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg_plain)
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, 64)
+    _, aux_plain = forward(params, tokens, cfg_plain, return_aux=True)
+    _, aux_pp = jax.jit(lambda p, t: forward(
+        p, t, cfg_pp, mesh=mesh_pp, return_aux=True))(params, tokens)
+    # Not bit-identical (microbatched routing differs slightly) but the
+    # scale must match — a missing 1/M shows up as a ~4x ratio.
+    ratio = float(aux_pp) / float(aux_plain)
+    assert 0.7 < ratio < 1.4, ratio

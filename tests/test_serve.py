@@ -92,3 +92,46 @@ def test_healthz_and_errors(served):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_mixed_shape_requests_all_served(served):
+    # Different prompt lengths land in different buckets; the deferred
+    # bucket must still be served promptly (no starvation).
+    engine, params, cfg, url = served
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [8, 9], [1, 2, 3, 4, 5]]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = post(url, {"tokens": prompts[i], "max_new_tokens": 2})
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for prompt, r in zip(prompts, results):
+        assert r is not None, f"request for {prompt} starved"
+        direct = generate(params, jnp.asarray([prompt], jnp.int32), cfg, 2)
+        assert r["tokens"] == [int(t) for t in direct[0]]
+
+
+def test_mixed_temperatures_not_cobatched(served):
+    engine, params, cfg, url = served
+    before = engine.batches_run
+    results = {}
+
+    def worker(temp):
+        results[temp] = post(url, {"tokens": [1, 2, 3, 4],
+                                   "max_new_tokens": 2,
+                                   "temperature": temp})
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in (0.3, 1.5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert set(results) == {0.3, 1.5}
+    # Two distinct temperature buckets -> two batches.
+    assert engine.batches_run - before == 2
