@@ -1,0 +1,67 @@
+"""Worker script for the multi-process (DCN-path) tests: initializes
+jax.distributed from env, runs a cross-process collective probe and a
+dp-over-processes train step, prints one RESULT line. Launched as
+subprocesses by tests/test_multiprocess.py."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from jax.sharding import Mesh
+
+from container_engine_accelerators_tpu.models import llama_tiny
+from container_engine_accelerators_tpu.ops import collectives
+from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+from container_engine_accelerators_tpu.parallel.distributed import (
+    initialize_from_env,
+)
+from container_engine_accelerators_tpu.training import (
+    create_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from container_engine_accelerators_tpu.training.data import synthetic_batches
+from container_engine_accelerators_tpu.training.train import shard_batch
+
+
+def main():
+    assert initialize_from_env(), "distributed init did not activate"
+    devices = jax.devices()
+    n_local = jax.local_device_count()
+    n_proc = len(devices) // n_local
+    assert n_proc == 2, f"expected 2 processes, got {n_proc}"
+
+    # Cross-process collective over the 'dcn' axis (gRPC between
+    # processes — the multislice transport).
+    mesh2 = Mesh(np.array(devices).reshape(n_proc, n_local),
+                 ("dcn", "ici"))
+    res = collectives.probe_collective(mesh2, "dcn", "all_reduce",
+                                       1 << 14, warmup=1, iters=2)
+    assert res.bus_bw_gbps > 0
+
+    # Full train step with dp spanning the two processes.
+    mesh = make_mesh(MeshAxes(dp=2, fsdp=4), devices=devices)
+    cfg = llama_tiny(vocab_size=64)
+    opt = make_optimizer(warmup_steps=2, decay_steps=50)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    step_fn = make_train_step(cfg, mesh, opt)
+    losses = []
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=8,
+                                   seq_len=32, num_batches=2, seed=0):
+        batch = shard_batch(batch, mesh)
+        state, metrics = step_fn(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    print(f"RESULT proc={jax.process_index()} "
+          f"dcn_busbw={res.bus_bw_gbps:.4f} "
+          f"losses={losses[0]:.6f},{losses[1]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Multi-process distributed backend: two real processes joined via
+jax.distributed (gRPC — the DCN transport), running cross-process
+collectives and a dp-over-processes train step. This is the in-one-box
+analog of the reference's 2-host nccl-test pods (SURVEY.md §3.5)."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_dcn_training():
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+        })
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multiproc_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outputs.append(out)
+        assert p.returncode == 0, f"worker failed:\n{out[-2000:]}"
+
+    results = {}
+    for out in outputs:
+        m = re.search(r"RESULT proc=(\d) dcn_busbw=([\d.]+) "
+                      r"losses=([\d.]+),([\d.]+)", out)
+        assert m, f"no RESULT line in:\n{out[-2000:]}"
+        results[int(m.group(1))] = (float(m.group(2)),
+                                    (m.group(3), m.group(4)))
+    assert set(results) == {0, 1}
+    # Both processes observed the identical globally-reduced loss.
+    assert results[0][1] == results[1][1]
+    assert all(bw > 0 for bw, _ in results.values())
