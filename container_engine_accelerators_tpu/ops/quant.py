@@ -1,0 +1,104 @@
+"""Int8 weight quantization for the decode path.
+
+Incremental decoding is HBM-bandwidth-bound on weight reads (one token's
+matmuls stream every parameter); per-channel symmetric int8 halves the
+bytes vs bf16 for <0.5% logit drift on Llama-family weights. The matmul
+keeps bf16 activations and dequantizes the int8 block inside the pallas
+kernel right after its VMEM load, so HBM only ever sees int8.
+
+  q, scales = quantize_weights(w)           # [D,F] -> int8 [D,F], f32 [F]
+  y = int8_matmul(x, q, scales)             # [T,D]@[D,F] -> bf16 [T,F]
+  qparams = quantize_llama_params(params)   # whole-model convenience
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+class QuantWeight(NamedTuple):
+    values: jnp.ndarray   # int8, same shape as the source weight
+    scales: jnp.ndarray   # f32, per output channel (last dim)
+
+
+def quantize_weights(w: jnp.ndarray) -> QuantWeight:
+    """Symmetric per-output-channel int8: scale = absmax/127 reduced over
+    the contraction dim (axis -2) only, so stacked [L, D, F] weights get
+    independent per-(layer, channel) scales."""
+    w_f = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w_f), axis=-2, keepdims=True)
+    scales = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w_f / scales), -127, 127).astype(jnp.int8)
+    return QuantWeight(values=q, scales=jnp.squeeze(scales, axis=-2))
+
+
+def dequantize(qw: QuantWeight, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (qw.values.astype(jnp.float32)
+            * qw.scales[..., None, :]).astype(dtype)
+
+
+def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, *, block_f: int):
+    x = x_ref[:, :]                        # [T, D] bf16
+    q = q_ref[:, :]                        # [D, bf] int8
+    s = s_ref[0, :]                        # [bf] f32
+    w = q.astype(jnp.bfloat16)             # dequant in VMEM
+    acc = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[:, :] = (acc * s[None, :]).astype(o_ref.dtype)
+    del block_f
+
+
+def int8_matmul(x: jnp.ndarray, qw: QuantWeight,
+                block_f: int = 512, interpret: bool = False) -> jnp.ndarray:
+    """x: [T, D] (bf16/f32); qw over [D, F]. Returns [T, F] in x.dtype.
+
+    Grid over output-channel blocks; x stays resident, each int8 weight
+    block is DMA'd once — the HBM traffic is T*D + D*F/2 bytes instead of
+    the bf16 path's D*F."""
+    t, d = x.shape
+    d2, f = qw.values.shape
+    assert d == d2, (d, d2)
+    while f % block_f:
+        block_f //= 2
+    grid = (f // block_f,)
+    return pl.pallas_call(
+        functools.partial(_int8_matmul_kernel, block_f=block_f),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((t, d), lambda j: (0, 0)),
+            pl.BlockSpec((d, block_f), lambda j: (0, j)),
+            pl.BlockSpec((1, block_f), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((t, block_f), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((t, f), x.dtype),
+        interpret=interpret,
+    )(x, qw.values, qw.scales[None, :])
+
+
+def quantize_llama_params(params: dict) -> dict:
+    """Quantize every 2-D+ projection of a Llama param tree (norms and
+    embeddings stay bf16/f32 — the embed gather is already cheap and
+    norms are vectors). Returns a tree of QuantWeight / passthrough
+    leaves consumed by models.decode with quantized=True (round 2 wiring)
+    or manual int8_matmul calls."""
+    quant_keys = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "lm_head"}
+
+    def walk(tree: dict) -> dict:
+        out = {}
+        for key, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[key] = walk(leaf)
+            elif key in quant_keys:
+                out[key] = quantize_weights(leaf)
+            else:
+                out[key] = leaf
+        return out
+
+    return walk(params)

@@ -1,0 +1,74 @@
+"""Int8 quantization: roundtrip error bounds, kernel vs dequantized
+reference, whole-tree quantization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.ops.quant import (
+    QuantWeight,
+    dequantize,
+    int8_matmul,
+    quantize_llama_params,
+    quantize_weights,
+)
+
+
+def test_quantize_roundtrip_error():
+    w = jax.random.normal(jax.random.key(0), (64, 128)) * 0.1
+    qw = quantize_weights(w)
+    assert qw.values.dtype == jnp.int8
+    assert qw.scales.shape == (128,)
+    back = dequantize(qw, jnp.float32)
+    # Per-channel absmax/127 quantization error bound: scale/2 per entry.
+    max_err = np.max(np.abs(np.asarray(back) - np.asarray(w)))
+    assert max_err <= float(np.max(np.asarray(qw.scales))) * 0.51
+
+
+def test_quantize_extreme_channels():
+    # One huge channel must not destroy small channels' precision
+    # (per-channel scales, not per-tensor).
+    w = jnp.ones((8, 2)).at[:, 1].mul(1000.0)
+    qw = quantize_weights(w)
+    back = dequantize(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(back[:, 0]), 1.0, rtol=0.01)
+    np.testing.assert_allclose(np.asarray(back[:, 1]), 1000.0, rtol=0.01)
+
+
+def test_int8_matmul_matches_dequantized_reference():
+    x = jax.random.normal(jax.random.key(0), (8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (64, 256)) * 0.05
+    qw = quantize_weights(w)
+    got = int8_matmul(x, qw, block_f=128, interpret=True)
+    expect = x @ dequantize(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_int8_matmul_nondivisible_block():
+    x = jax.random.normal(jax.random.key(0), (4, 32), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (32, 192)) * 0.05
+    qw = quantize_weights(w)
+    got = int8_matmul(x, qw, block_f=128, interpret=True)  # falls to 64
+    expect = x @ dequantize(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_quantize_llama_params_tree():
+    cfg = llama_tiny()
+    params = init_params(jax.random.key(0), cfg)
+    qp = quantize_llama_params(params)
+    assert isinstance(qp["lm_head"], QuantWeight)
+    assert isinstance(qp["layers"]["wq"], QuantWeight)
+    # Norms/embeddings untouched.
+    assert not isinstance(qp["final_norm"], QuantWeight)
+    assert not isinstance(qp["embed"], QuantWeight)
+    # Stacked layer weights quantize with per-(layer x channel) scales...
+    assert qp["layers"]["wq"].values.shape == params["layers"]["wq"].shape
+    # ...and dequantize near the original.
+    back = dequantize(qp["layers"]["w_down"], jnp.float32)
+    err = np.max(np.abs(np.asarray(back)
+                        - np.asarray(params["layers"]["w_down"])))
+    assert err < 0.01
