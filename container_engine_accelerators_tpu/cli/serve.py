@@ -167,12 +167,21 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--batch-window-ms", type=float, default=5.0)
+    p.add_argument("--quantize-int8", action="store_true",
+                   help="serve int8-quantized weights (halves weight HBM "
+                        "traffic on the decode path)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     from container_engine_accelerators_tpu.models.convert import load_model
 
     params, cfg = load_model(None if args.tiny else args.checkpoint)
+    if args.quantize_int8:
+        from container_engine_accelerators_tpu.ops.quant import (
+            quantize_llama_params,
+        )
+        params = quantize_llama_params(params)
+        log.info("serving int8-quantized weights")
 
     engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
                             window_ms=args.batch_window_ms)

@@ -20,6 +20,10 @@ import jax.numpy as jnp
 
 from container_engine_accelerators_tpu.models.llama import LlamaConfig
 from container_engine_accelerators_tpu.ops import rms_norm, rope_frequencies
+from container_engine_accelerators_tpu.ops.quant import (
+    QuantWeight,
+    int8_matmul,
+)
 from container_engine_accelerators_tpu.ops.rope import apply_rope
 
 
@@ -73,14 +77,24 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
 
     x = params["embed"].astype(dt)[tokens]
 
+    # Int8-quantized weights (ops/quant.quantize_llama_params) route
+    # through the pallas dequant-matmul so HBM reads stay int8; the
+    # kernel runs in interpret mode off-TPU.
+    interpret = jax.default_backend() in ("cpu", "gpu")
+
+    def proj(h, w):
+        n = h.shape[0] * h.shape[1]
+        if isinstance(w, QuantWeight):
+            out = int8_matmul(h.reshape(n, -1), w, interpret=interpret)
+            return out.reshape(h.shape[0], h.shape[1], -1)
+        return h @ w.astype(h.dtype)
+
     def layer_body(x, scanned):
         lp, k_cache_in, v_cache_in = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"].astype(dt)).reshape(b, t, cfg.n_kv_heads,
-                                              cfg.head_dim)
-        v = (h @ lp["wv"].astype(dt)).reshape(b, t, cfg.n_kv_heads,
-                                              cfg.head_dim)
+        q = proj(h, lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = proj(h, lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = proj(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
         k_cache = jax.lax.dynamic_update_slice(
@@ -89,11 +103,11 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             v_cache_in, v.astype(v_cache_in.dtype), (0, cache.length, 0, 0))
         attn = _cached_attention(q.astype(dt), k_cache.astype(dt),
                                  v_cache.astype(dt), cache.length, cfg)
-        x = x + attn.reshape(b, t, -1) @ lp["wo"].astype(dt)
+        x = x + proj(attn.reshape(b, t, -1), lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h2 @ lp["w_gate"].astype(dt))
-        up = h2 @ lp["w_up"].astype(dt)
-        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        gate = jax.nn.silu(proj(h2, lp["w_gate"]))
+        up = proj(h2, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
         return x, (k_cache, v_cache)
 
     # Scan over layers with stacked params + stacked caches as xs — one
@@ -102,8 +116,14 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         layer_body, x, (params["layers"], cache.k, cache.v))
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    if isinstance(params["lm_head"], QuantWeight):
+        n = b * t
+        logits = int8_matmul(
+            x.reshape(n, -1).astype(jnp.float32), params["lm_head"],
+            interpret=interpret).reshape(b, t, -1)
+    else:
+        logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
     return logits, new_cache
 

@@ -72,3 +72,21 @@ def test_quantize_llama_params_tree():
     err = np.max(np.abs(np.asarray(back)
                         - np.asarray(params["layers"]["w_down"])))
     assert err < 0.01
+
+
+def test_quantized_decode_matches_dequantized():
+    # generate() on the quantized tree tracks the dequantized-baseline
+    # model: same greedy tokens on a tiny config.
+    from container_engine_accelerators_tpu.models.decode import generate
+
+    cfg = llama_tiny(dtype=jnp.float32, n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    qp = quantize_llama_params(params)
+    deq = jax.tree.map(
+        lambda x: dequantize(x, jnp.float32) if isinstance(x, QuantWeight)
+        else x, qp, is_leaf=lambda x: isinstance(x, QuantWeight))
+
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    out_q = generate(qp, prompt, cfg, max_new_tokens=4)
+    out_d = generate(deq, prompt, cfg, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out_q), np.asarray(out_d))
