@@ -1,0 +1,57 @@
+"""eval — perplexity over a token corpus (training/train.evaluate behind
+a CLI), completing the train/eval/serve loop.
+
+  python -m container_engine_accelerators_tpu.cli.eval \
+      --checkpoint /models/llama --data corpus.bin --batches 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", default=None,
+                   help="HF Llama checkpoint dir (default: random tiny)")
+    p.add_argument("--data", required=True,
+                   help="token file written by training/dataset.py")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--batches", type=int, default=50)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from container_engine_accelerators_tpu.models.convert import load_model
+    from container_engine_accelerators_tpu.parallel import make_mesh
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_env,
+    )
+    from container_engine_accelerators_tpu.training.dataset import (
+        token_file_batches,
+    )
+    from container_engine_accelerators_tpu.training.train import (
+        TrainState,
+        evaluate,
+    )
+
+    initialize_from_env()
+    params, cfg = load_model(args.checkpoint)
+    mesh = make_mesh()
+    state = TrainState(step=jax.numpy.zeros((), jax.numpy.int32),
+                       params=params, opt_state=None)
+    batches = token_file_batches(
+        args.data, args.batch_size, args.seq_len,
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        num_batches=args.batches)
+    report = evaluate(state, cfg, mesh, batches)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

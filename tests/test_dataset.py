@@ -149,3 +149,16 @@ def test_fit_resume_fast_forwards_stream(tmp_path, mesh8):
     # is indistinguishable from islice; assert training advanced exactly
     # over the remaining 3).
     assert int(jax.device_get(state.step)) == 6
+
+
+def test_eval_cli(tmp_path, capsys, monkeypatch):
+    import json as json_mod
+
+    from container_engine_accelerators_tpu.cli import eval as eval_cli
+    path, _ = make_file(tmp_path, n=8192, vocab=512)
+    rc = eval_cli.main(["--data", path, "--batch-size", "2",
+                        "--seq-len", "32", "--batches", "2"])
+    assert rc == 0
+    report = json_mod.loads(capsys.readouterr().out)
+    assert report["batches"] == 2
+    assert report["perplexity"] > 1
