@@ -328,3 +328,62 @@ def test_e2e_preferred_allocation(tmp_path):
             allocation_size=2)]), None)
     # Same-NUMA, lowest-index chips first.
     assert list(resp.container_responses[0].deviceIDs) == ["accel0", "accel1"]
+
+
+def _serve_with_config(tmp_path, cfg, n_chips=4):
+    dev = make_fake_devfs(tmp_path, n=n_chips)
+    plugin_dir = str(tmp_path / "dp")
+    os.makedirs(plugin_dir)
+    m = TPUManager(cfg, MockDeviceInfo(dev), plugin_dir=plugin_dir,
+                   poll_interval=0.05, chip_check_interval=5.0)
+    m.discover()
+    stub = KubeletStub(plugin_dir)
+    t = threading.Thread(target=m.serve, daemon=True)
+    t.start()
+    stub.wait_for_registration()
+    channel = grpc.insecure_channel(
+        f"unix://{os.path.join(plugin_dir, PLUGIN_SOCKET)}")
+    grpc.channel_ready_future(channel).result(timeout=10)
+    return m, stub, DevicePluginStub(channel), channel, t, dev
+
+
+def test_e2e_allocate_subslice_partition(tmp_path):
+    m, stub, client, channel, t, dev = _serve_with_config(
+        tmp_path, TPUConfig(chips_per_partition=2))
+    try:
+        lw = client.ListAndWatch(pb.Empty())
+        ids = sorted(d.ID for d in next(lw).devices)
+        assert ids == ["tpu-sub0-2", "tpu-sub1-2"]
+        resp = client.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(
+                devicesIDs=["tpu-sub1-2"])]))
+        cresp = resp.container_responses[0]
+        # One subslice request mounts both member chips.
+        assert [d.host_path for d in cresp.devices] == [
+            f"{dev}/accel2", f"{dev}/accel3"]
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "2,3"
+    finally:
+        m.stop(); channel.close(); stub.stop(); t.join(timeout=5)
+
+
+def test_e2e_allocate_time_sharing(tmp_path):
+    m, stub, client, channel, t, dev = _serve_with_config(
+        tmp_path, TPUConfig(sharing=SharingConfig("time-sharing", 2)),
+        n_chips=1)
+    try:
+        lw = client.ListAndWatch(pb.Empty())
+        ids = sorted(d.ID for d in next(lw).devices)
+        assert ids == ["accel0/vtpu0", "accel0/vtpu1"]
+        resp = client.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(
+                devicesIDs=["accel0/vtpu1"])]))
+        cresp = resp.container_responses[0]
+        assert [d.host_path for d in cresp.devices] == [f"{dev}/accel0"]
+        # Two virtual devices in one request is rejected (sharing rule).
+        with pytest.raises(grpc.RpcError) as err:
+            client.Allocate(pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(
+                    devicesIDs=["accel0/vtpu0", "accel0/vtpu1"])]))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        m.stop(); channel.close(); stub.stop(); t.join(timeout=5)
