@@ -13,6 +13,7 @@ attention kernel engages), bf16 activations, remat='dots', adamw.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -51,7 +52,7 @@ def main():
         n_kv_heads=8, d_ff=8192, max_seq_len=2048, remat_policy="dots",
         dtype=jnp.bfloat16)
     batch_size, seq_len = 5, 2048
-    warmup_steps, bench_steps = 2, 8
+    warmup_steps, bench_steps = 3, 16
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
@@ -73,22 +74,39 @@ def main():
         state, metrics = step_fn(state, b)
         float(metrics["loss"])
 
-    t0 = time.perf_counter()
+    # Per-step timing with a median estimator: the tunnel/remote-compile
+    # environment occasionally injects multi-hundred-ms stalls into a
+    # single step, which a single wall-clock window over few steps cannot
+    # distinguish from genuinely slower compute.
+    step_times = []
     for b in batches[warmup_steps:]:
+        t0 = time.perf_counter()
         state, metrics = step_fn(state, b)
         float(metrics["loss"])
-    dt = time.perf_counter() - t0
+        step_times.append(time.perf_counter() - t0)
+    wall_dt = sum(step_times)
+    step_times.sort()
+    median_dt = step_times[len(step_times) // 2]
 
-    tokens = batch_size * seq_len * bench_steps
-    tok_per_sec_per_chip = tokens / dt / n_dev
+    tokens_per_step = batch_size * seq_len
+    tok_per_sec_per_chip = tokens_per_step / median_dt / n_dev
+    wall_tok_per_sec = tokens_per_step * bench_steps / wall_dt / n_dev
     flops_per_token = cfg.train_flops_per_token(seq_len)
-    mfu = tok_per_sec_per_chip * flops_per_token / detect_peak_flops()
+    peak = detect_peak_flops()
+    mfu = tok_per_sec_per_chip * flops_per_token / peak
+    wall_mfu = wall_tok_per_sec * flops_per_token / peak
 
+    print(f"step times (s): min={step_times[0]:.4f} "
+          f"median={median_dt:.4f} max={step_times[-1]:.4f}",
+          file=sys.stderr)
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
         "unit": f"tokens/s/chip (MFU={mfu:.3f})",
         "vs_baseline": round(mfu / 0.40, 3),
+        "estimator": "median-step",
+        "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
+        "wallclock_mfu": round(wall_mfu, 3),
     }))
 
 
