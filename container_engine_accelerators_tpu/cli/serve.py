@@ -89,6 +89,15 @@ class BatchingEngine:
             deadline = time.monotonic() + self.window
             key = self._bucket_key(pending[0])
             batch = [pending.pop(0)]
+            # Drain previously-parked same-bucket requests first: mixed
+            # traffic parks items here, and without this sweep each one
+            # would get its own single-request generate() call.
+            i = 0
+            while i < len(pending) and len(batch) < self.max_batch:
+                if self._bucket_key(pending[i]) == key:
+                    batch.append(pending.pop(i))
+                else:
+                    i += 1
             while len(batch) < self.max_batch and \
                     time.monotonic() < deadline:
                 try:

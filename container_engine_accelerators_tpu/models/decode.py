@@ -128,6 +128,18 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     return logits, new_cache
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_decode_step(cfg: LlamaConfig):
+    """Module-level jit cache keyed by cfg: repeated generate() calls with
+    the same config and shapes reuse the compiled executable instead of
+    re-tracing (serve.py's shape buckets rely on this; a fresh jit wrapper
+    per call would recompile every batch — minutes per compile through the
+    tunnel). One wrapper serves both prefill and single-token decode; jit
+    keeps a separate executable per call shape under it."""
+    return jax.jit(functools.partial(decode_step, cfg=cfg),
+                   donate_argnums=(1,))
+
+
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              max_new_tokens: int, max_len: int | None = None,
              temperature: float = 0.0,
@@ -141,12 +153,8 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     max_len = max_len or (t0 + max_new_tokens)
     cache = init_cache(cfg, b, max_len)
 
-    prefill = jax.jit(functools.partial(decode_step, cfg=cfg),
-                      donate_argnums=(1,))
-    logits, cache = prefill(params, cache, prompt)
-
-    step_fn = jax.jit(functools.partial(decode_step, cfg=cfg),
-                      donate_argnums=(1,))
+    step_fn = _jitted_decode_step(cfg)
+    logits, cache = step_fn(params, cache, prompt)
 
     def pick(logits_1, k):
         last = logits_1[:, -1]
