@@ -52,6 +52,12 @@ class LlamaConfig:
     # GPipe microbatch count for the 'pp' mesh axis (parallel/pipeline.py);
     # 0 disables pipelining. Requires n_layers % pp == 0.
     pipeline_microbatches: int = 0
+    # 'gpipe' | 'circular'. Circular is the interleaved (1F1B-analog)
+    # schedule: each pp rank owns `pipeline_circular_repeats` round-robin
+    # layer chunks, shrinking the bubble from (P-1)/(M+P-1) to
+    # (P-1)/(v*M+P-1). Requires n_layers % (pp*v) == 0 and M >= pp.
+    pipeline_schedule: str = "gpipe"
+    pipeline_circular_repeats: int = 2
     # Mixture-of-Experts FFN (models/moe.py): 0 experts = dense MLP.
     # Expert weights shard over the 'ep' mesh axis; composes with the
     # pipeline (router aux losses ride the with_aux channel).
@@ -253,12 +259,15 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         layer_body = jax.checkpoint(layer_body, policy=policy)
 
     if use_pp:
-        if cfg.n_layers % pp:
+        v = (cfg.pipeline_circular_repeats
+             if cfg.pipeline_schedule == "circular" else 1)
+        if cfg.n_layers % (pp * v):
             raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
-                             f"pp={pp}")
+                             f"pp={pp} x repeats={v}")
         from container_engine_accelerators_tpu.parallel.pipeline import (
             pipeline,
         )
+        pp_kw = dict(schedule=cfg.pipeline_schedule, circular_repeats=v)
 
         if cfg.n_experts:
             def stage_fn(local_layers, x_mb):
@@ -267,7 +276,7 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
             x, aux_total = pipeline(stage_fn, params["layers"], x, mesh,
                                     cfg.pipeline_microbatches,
-                                    with_aux=True)
+                                    with_aux=True, **pp_kw)
             # The router losses are per-token means (batch-size
             # invariant); the pipeline sums one per microbatch, so
             # average to match the non-pipelined scale.
@@ -278,7 +287,7 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                 return out
 
             x = pipeline(stage_fn, params["layers"], x, mesh,
-                         cfg.pipeline_microbatches)
+                         cfg.pipeline_microbatches, **pp_kw)
             aux_total = None
     else:
         x, aux = jax.lax.scan(layer_body, x, params["layers"])

@@ -1,39 +1,69 @@
 """Pipeline parallelism over the 'pp' mesh axis.
 
-GPipe-style schedule expressed SPMD: every pp rank runs the same program;
+Two schedules, both expressed SPMD: every pp rank runs the same program;
 `shard_map(axis_names={'pp'})` makes only the pipeline axis manual, so the
 per-stage computation stays a plain jittable function whose internals
 GSPMD continues to shard over dp/fsdp/tp automatically.
 
-Mechanics:
+  gpipe     M + P - 1 ticks; each rank owns one depth-contiguous stage
+            of L/P layers. Bubble fraction (P-1)/(M+P-1).
+  circular  interleaved schedule (the 1F1B-interleaved analog for an
+            autodiff-derived backward; MaxText's circular pipeline is
+            the TPU precedent): each rank owns `v` round-robin layer
+            chunks of L/(vP) layers — global chunk s lives on rank
+            s mod P — so the pipeline ramp costs P - 1 *chunk* ticks
+            instead of P - 1 full-stage ticks. v*M + P - 1 ticks of
+            1/v-sized work: bubble fraction (P-1)/(v*M + P-1).
+            Activations wrap from the last rank back to rank 0 through
+            an M-slot circular buffer (`circ`), which requires M >= P.
+
+Mechanics shared by both:
   - layer params are stacked [L, ...] and sharded P('pp') on the leading
-    axis — each stage materialises only its L/P layers;
+    axis — each rank materialises only its L/P layers;
   - activations flow stage->stage via `jax.lax.ppermute` (neighbor
     point-to-point, the cheapest collective, DCN-tolerant);
-  - the schedule runs M + P - 1 ticks under `lax.scan`; inactive
-    (bubble) ticks skip compute via `lax.cond`;
+  - bubble ticks run the stage on garbage and mask the result
+    (branchless — see the note in `tick`);
   - the last stage's outputs are broadcast back with a masked psum so
     loss/logits code stays stage-agnostic.
 
-Everything is reverse-differentiable (scan + cond + ppermute), so
-`jax.grad` of a pipelined forward yields the pipelined backward with the
-transposed permutes — no hand-written backward schedule.
+Everything is reverse-differentiable (scan + ppermute), so `jax.grad` of
+a pipelined forward yields the pipelined backward with the transposed
+permutes — no hand-written backward schedule.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def bubble_fraction(schedule: str, n_microbatches: int, n_stages: int,
+                    circular_repeats: int = 1) -> float:
+    """Idle fraction of each rank's timeline, from the schedule's tick
+    structure: ticks where a rank has no microbatch, over total ticks
+    (per-tick work is uniform within a schedule). Forward and the
+    autodiff-transposed backward have the same fraction."""
+    m, p = n_microbatches, n_stages
+    if schedule == "gpipe":
+        return (p - 1) / (m + p - 1)
+    if schedule == "circular":
+        v = circular_repeats
+        return (p - 1) / (v * m + p - 1)
+    raise ValueError(f"unknown schedule {schedule!r}")
 
 
 def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
-             axis: str = "pp", with_aux: bool = False):
+             axis: str = "pp", with_aux: bool = False,
+             schedule: str = "gpipe", circular_repeats: int = 1):
     """Run x through P pipeline stages.
 
     stage_fn(stage_local_params, x_mb) -> x_mb (or (x_mb, aux_scalar)
     when `with_aux` — e.g. MoE router losses), where stage_local_params
-    is `params` with the stacked leading axis reduced to L/P local layers.
+    is `params` with the stacked leading axis reduced to the rank's
+    local layers (L/P for gpipe, L/(P*circular_repeats) per chunk for
+    circular).
 
     params: pytree of [L, ...] arrays (sharded P('pp') outside).
     x: [B, S, D] activations. B must divide by n_microbatches.
@@ -43,21 +73,13 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
     n_stages = mesh.shape[axis]
     if n_stages == 1:
         return stage_fn(params, x)
-    b = x.shape[0]
-    if b % n_microbatches:
-        raise ValueError(f"batch {b} not divisible into "
-                         f"{n_microbatches} microbatches")
-    mb = b // n_microbatches
-    x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
-
-    # XLA's CPU SPMD partitioner CHECK-fails on bf16 psum (the transpose
-    # of the replicated-in x_all is a psum of its cotangent), so the
-    # shard_map boundary runs in f32 there; TPU keeps the native dtype.
-    compute_dtype = x.dtype
-    boundary_f32 = (jax.default_backend() == "cpu"
-                    and x.dtype == jnp.bfloat16)
-    if boundary_f32:
-        x_mb = x_mb.astype(jnp.float32)
+    if schedule == "circular" and circular_repeats > 1:
+        return _pipeline_circular(stage_fn, params, x, mesh,
+                                  n_microbatches, circular_repeats, axis,
+                                  with_aux)
+    if schedule not in ("gpipe", "circular"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    x_mb, compute_dtype = _microbatch_split(x, n_microbatches)
 
     def per_shard(local_params, x_all):
         x_all = x_all.astype(compute_dtype)
@@ -95,22 +117,53 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
                 jnp.zeros((), jnp.float32))
         (_, outputs, aux_sum), _ = jax.lax.scan(
             tick, init, jnp.arange(m + n_stages - 1))
-        # Only the last stage holds the fully-processed activations; a
-        # masked psum broadcasts them to every pp rank. The psum runs in
-        # f32: a bf16 psum here trips an XLA SPMD-partitioner CHECK
-        # ("invalid binary instruction opcode copy") on the CPU backend.
-        masked = jnp.where(stage == n_stages - 1,
-                           outputs.astype(jnp.float32), 0.0)
-        result = jax.lax.psum(masked, axis).astype(outputs.dtype)
-        if with_aux:
-            return result, jax.lax.psum(aux_sum, axis)
-        return result
+        return _broadcast_from_last(outputs, aux_sum, stage, n_stages,
+                                    axis, with_aux)
 
-    out_specs = (P(), P()) if with_aux else P()
+    return _launch(per_shard, params, x_mb, x, mesh, axis, P(axis),
+                   with_aux)
+
+
+def _microbatch_split(x, n_microbatches):
+    """Reshape [B, ...] to [M, B/M, ...] microbatches and apply the CPU
+    boundary-dtype workaround: XLA's CPU SPMD partitioner CHECK-fails on
+    bf16 psum (the transpose of the replicated-in x_all is a psum of its
+    cotangent), so the shard_map boundary runs in f32 there; TPU keeps
+    the native dtype. Returns (x_mb, compute_dtype)."""
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible into "
+                         f"{n_microbatches} microbatches")
+    mb = b // n_microbatches
+    x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
+    if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:
+        x_mb = x_mb.astype(jnp.float32)
+    return x_mb, x.dtype
+
+
+def _broadcast_from_last(outputs, aux_sum, rank, n_stages, axis,
+                         with_aux):
+    """Only the last stage holds the fully-processed activations; a
+    masked psum broadcasts them to every pp rank. The psum runs in f32:
+    a bf16 psum here trips an XLA SPMD-partitioner CHECK ("invalid
+    binary instruction opcode copy") on the CPU backend."""
+    masked = jnp.where(rank == n_stages - 1,
+                       outputs.astype(jnp.float32), 0.0)
+    result = jax.lax.psum(masked, axis).astype(outputs.dtype)
+    if with_aux:
+        return result, jax.lax.psum(aux_sum, axis)
+    return result
+
+
+def _launch(per_shard, params, x_mb, x, mesh, axis, param_spec,
+            with_aux):
+    """Shared shard_map invocation + microbatch re-flatten for both
+    schedules ('pp' manual, every other mesh axis left to GSPMD)."""
+    b = x.shape[0]
     out = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=out_specs,
+        in_specs=(param_spec, P()),
+        out_specs=(P(), P()) if with_aux else P(),
         axis_names={axis},
         check_vma=False,
     )(params, x_mb)
@@ -118,3 +171,111 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
         y, aux = out
         return y.reshape(b, *x.shape[1:]), aux
     return out.reshape(b, *x.shape[1:])
+
+
+def _pipeline_circular(stage_fn, params, x, mesh: Mesh,
+                       n_microbatches: int, repeats: int, axis: str,
+                       with_aux: bool):
+    """Interleaved ('circular') schedule — see the module docstring.
+
+    Chunk-to-rank mapping: global depth chunk s (of S = v*P total) runs
+    on rank s mod P. Depth order therefore visits ranks
+    0,1,...,P-1,0,1,... — a reshape of the depth-stacked [L, ...] params
+    to [v, P, Lc, ...] puts each rank's v chunks at [:, r, :], which is
+    exactly the P(None, 'pp') sharding. The params arrive blocked
+    (P('pp') on the depth axis), so the sharding constraint below incurs
+    one all-to-all over pp per step; storing weights interleaved at
+    creation time would remove it, at the cost of leaking the layout
+    into checkpoint/convert — an acknowledged trade-off.
+    """
+    n_stages = mesh.shape[axis]
+    m, v = n_microbatches, repeats
+    if m < n_stages:
+        raise ValueError(
+            f"circular schedule needs microbatches >= pp "
+            f"({m} < {n_stages}): the wrap buffer slot for a microbatch "
+            f"must be produced before rank 0 consumes it")
+    x_mb, compute_dtype = _microbatch_split(x, m)
+
+    def interleave(a):
+        l = a.shape[0]
+        if l % (v * n_stages):
+            raise ValueError(f"{l} layers not divisible into "
+                             f"{v}x{n_stages} chunks")
+        lc = l // (v * n_stages)
+        a = a.reshape(v, n_stages, lc, *a.shape[1:])
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(None, axis)))
+
+    params_il = jax.tree.map(interleave, params)
+
+    def per_shard(local_params, x_all):
+        # local_params leaves: [v, 1, Lc, ...] — this rank's v chunks.
+        local_params = jax.tree.map(lambda a: a[:, 0], local_params)
+        x_all = x_all.astype(compute_dtype)
+        r = jax.lax.axis_index(axis)
+        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state, circ, outputs, aux_sum = carry
+            k = t - r                      # this rank's local step index
+            active = jnp.logical_and(k >= 0, k < v * m)
+            c = jnp.clip(k // m, 0, v - 1)        # chunk index
+            mi = jnp.clip(k % m, 0, m - 1)        # microbatch index
+
+            first_in = jax.lax.dynamic_index_in_dim(x_all, mi, 0,
+                                                    keepdims=False)
+            circ_in = jax.lax.dynamic_index_in_dim(circ, mi, 0,
+                                                   keepdims=False)
+            # Rank 0 feeds fresh microbatches into chunk 0 and re-feeds
+            # wrapped activations into chunks 1..v-1; other ranks consume
+            # what their left neighbor sent last tick.
+            inp = jnp.where(r == 0,
+                            jnp.where(c == 0, first_in, circ_in), state)
+
+            chunk_params = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0,
+                                                       keepdims=False),
+                local_params)
+            # Bubble ticks run on garbage and mask the result — same
+            # branchless rationale as the gpipe schedule.
+            if with_aux:
+                out, aux = stage_fn(chunk_params, inp)
+                aux_sum = aux_sum + jnp.where(active,
+                                              aux.astype(jnp.float32), 0.0)
+            else:
+                out = stage_fn(chunk_params, inp)
+
+            # Collect final-depth outputs (chunk v-1 lives on rank P-1).
+            is_final = jnp.logical_and(active,
+                                       jnp.logical_and(r == n_stages - 1,
+                                                       k // m == v - 1))
+            cur = jax.lax.dynamic_index_in_dim(outputs, mi, 0,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(is_final, out, cur), mi, 0)
+
+            # Full ring permute: rank P-1's output wraps to rank 0,
+            # where it parks in the circular buffer until rank 0 reaches
+            # the next chunk for that microbatch (M - P + 1 ticks later).
+            sent = jax.lax.ppermute(out, axis, ring)
+            k_last = t - (n_stages - 1)     # rank P-1's local step at t
+            m_last = jnp.clip(k_last % m, 0, m - 1)
+            wrap_valid = jnp.logical_and(
+                r == 0, jnp.logical_and(k_last >= 0,
+                                        k_last < (v - 1) * m))
+            circ_cur = jax.lax.dynamic_index_in_dim(circ, m_last, 0,
+                                                    keepdims=False)
+            circ = jax.lax.dynamic_update_index_in_dim(
+                circ, jnp.where(wrap_valid, sent, circ_cur), m_last, 0)
+            return (sent, circ, outputs, aux_sum), None
+
+        init = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all),
+                jnp.zeros_like(x_all), jnp.zeros((), jnp.float32))
+        (_, _, outputs, aux_sum), _ = jax.lax.scan(
+            tick, init, jnp.arange(v * m + n_stages - 1))
+        return _broadcast_from_last(outputs, aux_sum, r, n_stages, axis,
+                                    with_aux)
+
+    return _launch(per_shard, params_il, x_mb, x, mesh, axis,
+                   P(None, axis), with_aux)
