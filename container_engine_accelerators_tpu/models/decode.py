@@ -41,9 +41,32 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
                    length=jnp.zeros((), jnp.int32))
 
 
+def _kernel_eligible(cfg: LlamaConfig) -> bool:
+    """Platform/config gate for the pallas decode kernel, mirroring
+    multi_head_attention's use_flash semantics: None auto-selects by
+    backend (the interpreter off-TPU is orders of magnitude slower than
+    the XLA fallback, so it needs an explicit use_flash=True — tests)."""
+    if cfg.head_dim % 128:
+        return False
+    if cfg.use_flash is None:
+        return jax.default_backend() not in ("cpu", "gpu")
+    return cfg.use_flash
+
+
 def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
     """q: [B, T, Hq, D] for T new tokens at positions
-    [cache_len, cache_len+T); caches: [B, max_len, Hkv, D]."""
+    [cache_len, cache_len+T); caches: [B, max_len, Hkv, D].
+
+    Routes to the pallas decode kernel (ops/decode_attention.py) when
+    shapes allow: it streams the cache once in its native GQA layout
+    instead of repeating KV heads and materialising [B, Hq, T, max_len]
+    logits — the difference dominates at long max_len."""
+    from container_engine_accelerators_tpu.ops import decode_attention as da
+
+    if _kernel_eligible(cfg) and da.supported(q, k_cache):
+        interpret = jax.default_backend() != "tpu"
+        return da.decode_attention(q, k_cache, v_cache, cache_len,
+                                   interpret=interpret)
     b, t, hq, d = q.shape
     max_len = k_cache.shape[1]
     n_rep = hq // k_cache.shape[2]
@@ -151,6 +174,12 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
         key = jax.random.key(0)
     b, t0 = prompt.shape
     max_len = max_len or (t0 + max_new_tokens)
+    if max_len > 128 and _kernel_eligible(cfg):
+        # Round the cache up to the pallas decode kernel's 128-lane
+        # tiling; the unused slots cost HBM only — the kernel skips
+        # blocks past the live length. (On the XLA fallback path padding
+        # would cost real compute, hence the eligibility gate.)
+        max_len = -(-max_len // 128) * 128
     cache = init_cache(cfg, b, max_len)
 
     step_fn = _jitted_decode_step(cfg)
