@@ -59,6 +59,14 @@ def main(argv=None) -> int:
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id)
+    else:
+        # JobSet/Indexed-Job deployments inject JAX_COORDINATOR_ADDRESS
+        # etc. instead of flags (multislice-test-jobset.yaml); no-op in
+        # a plain single-process run.
+        from container_engine_accelerators_tpu.parallel.distributed import (
+            initialize_from_env,
+        )
+        initialize_from_env()
 
     from jax.sharding import Mesh
 

@@ -71,7 +71,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
                    hkv: int):
     ki = pl.program_id(1)
     num_k = pl.num_programs(1)
-    cache_len = len_ref[0, 0]
+    cache_len = len_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -140,6 +140,7 @@ def decode_attention(q, k_cache, v_cache, cache_len,
     b, t, hq, d = q.shape
     max_len, hkv = k_cache.shape[1], k_cache.shape[2]
     g = hq // hkv
+    block_k = max(128, block_k // 128 * 128)  # lane-tile multiple
     # K + V tiles, double-buffered, must fit the scoped-VMEM budget:
     # 2 (k,v) x 2 (buffers) x block_k x hkv x d x itemsize.
     per_row = 4 * hkv * d * k_cache.dtype.itemsize
@@ -154,33 +155,44 @@ def decode_attention(q, k_cache, v_cache, cache_len,
     if rows != t * g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - t * g), (0, 0)))
 
-    len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1, 1)
+    len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
 
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=d ** -0.5,
-                          block_k=block_k, t=t, g=g, hkv=hkv),
+    def kv_map(bi, ki, len_ref):
+        # Clamp dead blocks to the last live one: Mosaic elides the
+        # HBM->VMEM copy when consecutive grid steps address the same
+        # block, so per-step traffic scales with the LIVE cache length,
+        # not max_len (the splash-attention trick; the compute for those
+        # steps is already predicated off by `run` in the kernel).
+        last_live = (len_ref[0] + t - 1) // block_k
+        return (bi, jnp.minimum(ki, last_live), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b, max_len // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bi, ki: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, hkv, rows, d), lambda bi, ki: (bi, 0, 0, 0)),
-            # K/V tiled in the cache's native layout: the head axis is
-            # taken whole (block dim == array dim keeps Mosaic's last-
-            # two-dims tiling rule satisfied by the [block_k? no] —
-            # trailing (hkv, d) block dims equal the array dims).
-            pl.BlockSpec((1, block_k, hkv, d),
-                         lambda bi, ki: (bi, ki, 0, 0)),
-            pl.BlockSpec((1, block_k, hkv, d),
-                         lambda bi, ki: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, hkv, rows, d),
+                         lambda bi, ki, len_ref: (bi, 0, 0, 0)),
+            # K/V tiled in the cache's native layout: the trailing
+            # (hkv, d) block dims equal the array dims, which satisfies
+            # Mosaic's last-two-dims tiling rule without transposing the
+            # cache.
+            pl.BlockSpec((1, block_k, hkv, d), kv_map),
+            pl.BlockSpec((1, block_k, hkv, d), kv_map),
         ],
         out_specs=pl.BlockSpec((1, hkv, rows, d),
-                               lambda bi, ki: (bi, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+                               lambda bi, ki, len_ref: (bi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((hkv, rows, d), jnp.float32),
             pltpu.VMEM((hkv, rows, 128), jnp.float32),
             pltpu.VMEM((hkv, rows, 128), jnp.float32),
         ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=d ** -0.5,
+                          block_k=block_k, t=t, g=g, hkv=hkv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         interpret=interpret,
     )(len_arr, qg, k_cache, v_cache)
 
