@@ -41,6 +41,11 @@ def parse_args(argv=None):
     p.add_argument("--metrics-port", type=int, default=2112)
     p.add_argument("--enable-health-monitoring", action="store_true",
                    help="run the chip health checker / Node conditions")
+    p.add_argument("--runtime-log", default="",
+                   help="scrape this raw libtpu/runtime log as a third "
+                        "health source (regex->class table from config's "
+                        "runtimeLogScraper block, built-in default rules "
+                        "otherwise); overrides the config path")
     p.add_argument("--publish-version-annotations", action="store_true",
                    help="publish libtpu/runtime versions as node annotations")
     p.add_argument("--wait-for-devices-timeout", type=float, default=0.0,
@@ -91,6 +96,10 @@ def main(argv=None) -> int:
         from container_engine_accelerators_tpu.metrics.sampler import make_sampler
         MetricServer(manager, sampler=make_sampler(sysfs_root),
                      port=args.metrics_port).start_background()
+    if args.runtime_log and not args.enable_health_monitoring:
+        # A scrape target without the checker would be silently inert.
+        log.info("--runtime-log implies --enable-health-monitoring")
+        args.enable_health_monitoring = True
     if args.enable_health_monitoring:
         from container_engine_accelerators_tpu.healthcheck.health_checker import (
             TPUHealthChecker,
@@ -104,6 +113,8 @@ def main(argv=None) -> int:
         except Exception as e:
             log.warning("no in-cluster K8s API (%s); health checker will "
                         "only flip device health, not Node conditions", e)
+        if args.runtime_log:
+            cfg.runtime_log_path = args.runtime_log
         checker = TPUHealthChecker(manager, cfg, k8s=k8s)
         threading.Thread(target=checker.run, daemon=True,
                          name="health-checker").start()

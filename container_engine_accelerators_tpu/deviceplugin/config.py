@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 
 TIME_SHARING = "time-sharing"
 VALID_STRATEGIES = (TIME_SHARING,)
@@ -47,8 +48,17 @@ class TPUConfig:
     chips_per_partition: int = 0          # 0 = no subslice partitioning
     sharing: SharingConfig = dataclasses.field(default_factory=SharingConfig)
     health_critical_errors: tuple[str, ...] = DEFAULT_CRITICAL
+    # Raw runtime-log scraping ("" = disabled). Rules are
+    # (regex, error_class) pairs replacing the built-in table
+    # (healthcheck DEFAULT_SCRAPE_RULES) when non-empty.
+    runtime_log_path: str = ""
+    runtime_log_rules: tuple[tuple[str, str], ...] = ()
 
     def validate(self) -> None:
+        for pat, cls in self.runtime_log_rules:
+            re.compile(pat)
+            if cls not in KNOWN_ERROR_CLASSES:
+                raise ValueError(f"unknown scrape rule class {cls!r}")
         if self.chips_per_partition < 0:
             raise ValueError("chips_per_partition must be >= 0")
         if self.chips_per_partition and self.sharing.strategy:
@@ -76,6 +86,7 @@ def load(path: str | None = None) -> TPUConfig:
         with open(path) as f:
             raw = json.load(f)
         sharing = raw.get("chipSharingConfig", {})
+        scraper = raw.get("runtimeLogScraper", {})
         cfg = TPUConfig(
             chips_per_partition=int(raw.get("chipsPerPartition", 0)),
             sharing=SharingConfig(
@@ -84,6 +95,10 @@ def load(path: str | None = None) -> TPUConfig:
                     sharing.get("maxSharedClientsPerChip", 0))),
             health_critical_errors=tuple(
                 raw.get("healthCriticalErrors", DEFAULT_CRITICAL)),
+            runtime_log_path=str(scraper.get("path", "")),
+            runtime_log_rules=tuple(
+                (str(r["pattern"]), str(r["class"]))
+                for r in scraper.get("rules", [])),
         )
     env = os.environ.get("TPU_HEALTH_CONFIG")
     if env:

@@ -7,6 +7,7 @@ from container_engine_accelerators_tpu.healthcheck.health_checker import (
     DevfsPresenceSource,
     ErrorEvent,
     LogFileErrorSource,
+    RuntimeLogScraperSource,
     TPUHealthChecker,
 )
 
@@ -14,5 +15,6 @@ __all__ = [
     "DevfsPresenceSource",
     "ErrorEvent",
     "LogFileErrorSource",
+    "RuntimeLogScraperSource",
     "TPUHealthChecker",
 ]

@@ -12,6 +12,11 @@ TPUs expose no event API — health is *polled* (SURVEY.md §7 hard part b):
   - LogFileErrorSource tails a JSONL error feed (the contract the TPU
     runtime/driver writes on GKE nodes; also the fault-injection hook used
     by demo/tpu-error)
+  - RuntimeLogScraperSource tails the raw libtpu/runtime text log and
+    maps lines to error classes via a configurable regex table — the
+    source that exists on every fleet even without the JSONL contract
+    (the reference's equivalent is consuming raw driver events,
+    health_check/health_checker.go:452-467)
   - DevfsPresenceSource reports CHIP_LOST when a chip node vanishes
 
 De-flapping: a device only transitions Healthy -> Unhealthy here; recovery
@@ -25,6 +30,7 @@ import dataclasses
 import json
 import logging
 import os
+import re
 import time
 
 from container_engine_accelerators_tpu.deviceplugin.manager import UNHEALTHY
@@ -44,15 +50,16 @@ class ErrorEvent:
     message: str = ""
 
 
-class LogFileErrorSource:
-    """Tail a JSONL file of {"chip": N, "class": "...", "message": "..."}
-    records, tolerating rotation/truncation."""
+class _TailReader:
+    """Incremental line tailer tolerating rotation/truncation: shrinking
+    size resets the offset, a trailing partial write is re-read on the
+    next poll."""
 
-    def __init__(self, path: str = DEFAULT_ERROR_LOG):
+    def __init__(self, path: str):
         self.path = path
         self._offset = 0
 
-    def poll(self) -> list[ErrorEvent]:
+    def read_lines(self) -> list[str]:
         try:
             size = os.path.getsize(self.path)
         except OSError:
@@ -61,24 +68,98 @@ class LogFileErrorSource:
             self._offset = 0
         if size == self._offset:
             return []
-        events = []
-        with open(self.path) as f:
+        lines = []
+        # Binary mode: the offset must count RAW bytes — decoding first
+        # and re-encoding drifts when the log holds non-UTF-8 bytes
+        # (stray bytes are a fact of life in raw runtime logs), which
+        # would silently corrupt the tail position.
+        with open(self.path, "rb") as f:
             f.seek(self._offset)
-            for line in f:
-                if not line.endswith("\n"):
+            for raw in f:
+                if not raw.endswith(b"\n"):
                     break  # partial write; re-read next poll
-                self._offset += len(line.encode())
-                line = line.strip()
-                if not line:
+                self._offset += len(raw)
+                line = raw.decode(errors="replace").strip()
+                if line:
+                    lines.append(line)
+        return lines
+
+
+class LogFileErrorSource:
+    """Tail a JSONL file of {"chip": N, "class": "...", "message": "..."}
+    records, tolerating rotation/truncation."""
+
+    def __init__(self, path: str = DEFAULT_ERROR_LOG):
+        self._tail = _TailReader(path)
+
+    @property
+    def path(self):
+        return self._tail.path
+
+    def poll(self) -> list[ErrorEvent]:
+        events = []
+        for line in self._tail.read_lines():
+            try:
+                rec = json.loads(line)
+                events.append(ErrorEvent(
+                    chip_index=int(rec.get("chip", -1)),
+                    error_class=str(rec["class"]),
+                    message=str(rec.get("message", ""))))
+            except (ValueError, KeyError):
+                log.warning("malformed error record: %r", line)
+        return events
+
+
+# Default regex -> error-class table for the raw runtime log. Patterns
+# are matched case-insensitively with re.search; a named group `chip`
+# (here or in _CHIP_RE as fallback) attributes the error to one chip,
+# else it counts against the whole host. Fleets override the table via
+# the runtimeLogScraper config block.
+DEFAULT_SCRAPE_RULES = (
+    (r"uncorrectable\s+(?:hbm\s+)?ecc|hbm.*uncorrectable",
+     "HBM_ECC_UNCORRECTABLE"),
+    (r"correctable\s+(?:hbm\s+)?ecc\s+error", "HBM_ECC_CORRECTABLE"),
+    (r"ici\s+link.*(?:down|failed)|link\s+layer\s+down", "ICI_LINK_DOWN"),
+    (r"ici.*crc\s+error", "ICI_CRC_ERROR"),
+    (r"thermal\s+(?:trip|shutdown|throttl)", "THERMAL_TRIP"),
+    (r"(?:watchdog|heartbeat)\s+timeout|runtime\s+(?:hang|stuck)"
+     r"|tpu\s+core\s+halted", "RUNTIME_HANG"),
+)
+
+_CHIP_RE = re.compile(r"(?:chip|core|accel|device)[ _#:]*(?P<chip>\d+)",
+                      re.IGNORECASE)
+
+
+class RuntimeLogScraperSource:
+    """Tail the raw libtpu/runtime text log and classify lines via the
+    regex table — the health source that exists on every fleet, with or
+    without the structured JSONL contract."""
+
+    def __init__(self, path: str, rules=None):
+        self._tail = _TailReader(path)
+        self.rules = [(re.compile(pat, re.IGNORECASE), cls)
+                      for pat, cls in (rules or DEFAULT_SCRAPE_RULES)]
+
+    @property
+    def path(self):
+        return self._tail.path
+
+    def poll(self) -> list[ErrorEvent]:
+        events = []
+        for line in self._tail.read_lines():
+            for pat, cls in self.rules:
+                m = pat.search(line)
+                if not m:
                     continue
-                try:
-                    rec = json.loads(line)
-                    events.append(ErrorEvent(
-                        chip_index=int(rec.get("chip", -1)),
-                        error_class=str(rec["class"]),
-                        message=str(rec.get("message", ""))))
-                except (ValueError, KeyError):
-                    log.warning("malformed error record: %r", line)
+                chip = m.groupdict().get("chip")
+                if chip is None:
+                    cm = _CHIP_RE.search(line)
+                    chip = cm.group("chip") if cm else None
+                events.append(ErrorEvent(
+                    chip_index=int(chip) if chip is not None else -1,
+                    error_class=cls,
+                    message=line[:512]))
+                break  # first matching rule wins
         return events
 
 
@@ -109,10 +190,19 @@ class TPUHealthChecker:
                  error_log_path: str = DEFAULT_ERROR_LOG):
         self.manager = manager
         self.config = config
-        self.sources = sources if sources is not None else [
-            LogFileErrorSource(error_log_path),
-            DevfsPresenceSource(manager.device_info),
-        ]
+        if sources is not None:
+            self.sources = sources
+        else:
+            self.sources = [
+                LogFileErrorSource(error_log_path),
+                DevfsPresenceSource(manager.device_info),
+            ]
+            # Third source, flag-gated via config: raw runtime-log
+            # scraping for fleets without the JSONL contract.
+            if getattr(config, "runtime_log_path", ""):
+                self.sources.append(RuntimeLogScraperSource(
+                    config.runtime_log_path,
+                    rules=getattr(config, "runtime_log_rules", None)))
         self.k8s = k8s
         self.node_name = node_name or os.environ.get("NODE_NAME", "")
         self.poll_interval = poll_interval

@@ -109,6 +109,112 @@ def test_devfs_presence_source(tmp_path):
     assert src.poll() == []  # reported once
 
 
+def test_runtime_log_scraper_rules_and_rotation(tmp_path):
+    from container_engine_accelerators_tpu.healthcheck.health_checker import (
+        RuntimeLogScraperSource,
+    )
+    path = tmp_path / "runtime.log"
+    src = RuntimeLogScraperSource(str(path))
+    assert src.poll() == []
+    path.write_text(
+        "I0729 libtpu: chip 2: uncorrectable HBM ECC error at 0xdead\n"
+        "I0729 all quiet on the interconnect\n"
+        "W0729 ICI link 3 down on chip 1\n")
+    events = src.poll()
+    assert events == [
+        ErrorEvent(2, "HBM_ECC_UNCORRECTABLE",
+                   "I0729 libtpu: chip 2: uncorrectable HBM ECC error "
+                   "at 0xdead"),
+        ErrorEvent(1, "ICI_LINK_DOWN", "W0729 ICI link 3 down on chip 1"),
+    ]
+    assert src.poll() == []  # no re-delivery
+    # Partial write held back until the newline lands.
+    with path.open("a") as f:
+        f.write("E0729 watchdog timeout")
+    assert src.poll() == []
+    with path.open("a") as f:
+        f.write(" on host\n")
+    assert src.poll() == [ErrorEvent(-1, "RUNTIME_HANG",
+                                     "E0729 watchdog timeout on host")]
+    # Rotation: smaller file re-read from zero.
+    path.write_text("E0729 thermal shutdown imminent, device 0\n")
+    assert src.poll() == [ErrorEvent(0, "THERMAL_TRIP",
+                                     "E0729 thermal shutdown imminent, "
+                                     "device 0")]
+
+
+def test_runtime_log_scraper_non_utf8_bytes(tmp_path):
+    # Raw runtime logs carry stray bytes; the tail offset must count
+    # raw bytes or it drifts and swallows the next (critical) line.
+    from container_engine_accelerators_tpu.healthcheck.health_checker import (
+        RuntimeLogScraperSource,
+    )
+    path = tmp_path / "runtime.log"
+    path.write_bytes(b"caf\xe9 uncorrectable HBM ECC on chip 1\n")
+    src = RuntimeLogScraperSource(str(path))
+    assert [e.error_class for e in src.poll()] == ["HBM_ECC_UNCORRECTABLE"]
+    with path.open("ab") as f:
+        f.write(b"ICI link down on chip 2\n")
+    events = src.poll()
+    assert [(e.error_class, e.chip_index) for e in events] == [
+        ("ICI_LINK_DOWN", 2)]
+
+
+def test_runtime_log_scraper_custom_rules(tmp_path):
+    from container_engine_accelerators_tpu.healthcheck.health_checker import (
+        RuntimeLogScraperSource,
+    )
+    path = tmp_path / "runtime.log"
+    path.write_text("FATAL frobnicator melted on accel 3\n"
+                    "uncorrectable ECC\n")
+    src = RuntimeLogScraperSource(
+        str(path), rules=((r"frobnicator melted", "THERMAL_TRIP"),))
+    # Custom table REPLACES the defaults: the ECC line must not match.
+    assert src.poll() == [ErrorEvent(3, "THERMAL_TRIP",
+                                     "FATAL frobnicator melted on accel 3")]
+
+
+def test_runtime_log_source_via_config(tmp_path, fake_k8s, client):
+    path = tmp_path / "runtime.log"
+    cfg = TPUConfig(runtime_log_path=str(path))
+    cfg.validate()
+    m, dev = make_manager(tmp_path, cfg=cfg)
+    checker, _, _ = make_checker(tmp_path, m, client, sources=None)
+    names = [type(s).__name__ for s in checker.sources]
+    assert names == ["LogFileErrorSource", "DevfsPresenceSource",
+                     "RuntimeLogScraperSource"]
+    # Critical class scraped from the raw log flips the chip unhealthy.
+    path.write_text("chip 1 uncorrectable HBM ECC\n")
+    checker.poll_once()
+    assert m.devices["accel1"].health == "Unhealthy"
+    assert m.devices["accel0"].health != "Unhealthy"
+
+
+def test_config_scraper_block_parsing(tmp_path):
+    from container_engine_accelerators_tpu.deviceplugin import config as cfgmod
+    p = tmp_path / "tpu_config.json"
+    p.write_text(json.dumps({
+        "runtimeLogScraper": {
+            "path": "/var/log/tpu/runtime.log",
+            "rules": [{"pattern": "melted", "class": "THERMAL_TRIP"}],
+        }}))
+    cfg = cfgmod.load(str(p))
+    assert cfg.runtime_log_path == "/var/log/tpu/runtime.log"
+    assert cfg.runtime_log_rules == (("melted", "THERMAL_TRIP"),)
+    p.write_text(json.dumps({
+        "runtimeLogScraper": {
+            "path": "x", "rules": [{"pattern": "(", "class": "THERMAL_TRIP"}],
+        }}))
+    with pytest.raises(Exception):
+        cfgmod.load(str(p))
+    p.write_text(json.dumps({
+        "runtimeLogScraper": {
+            "path": "x", "rules": [{"pattern": "ok", "class": "NOPE"}],
+        }}))
+    with pytest.raises(ValueError):
+        cfgmod.load(str(p))
+
+
 # ---------- checker pipeline ----------
 
 def test_critical_error_marks_device_unhealthy(tmp_path, fake_k8s, client):
