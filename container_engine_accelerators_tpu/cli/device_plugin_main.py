@@ -96,9 +96,12 @@ def main(argv=None) -> int:
         from container_engine_accelerators_tpu.metrics.sampler import make_sampler
         MetricServer(manager, sampler=make_sampler(sysfs_root),
                      port=args.metrics_port).start_background()
-    if args.runtime_log and not args.enable_health_monitoring:
-        # A scrape target without the checker would be silently inert.
-        log.info("--runtime-log implies --enable-health-monitoring")
+    if (args.runtime_log or cfg.runtime_log_path) \
+            and not args.enable_health_monitoring:
+        # A scrape target (flag or config) without the checker would be
+        # silently inert.
+        log.info("runtime-log scrape target implies "
+                 "--enable-health-monitoring")
         args.enable_health_monitoring = True
     if args.enable_health_monitoring:
         from container_engine_accelerators_tpu.healthcheck.health_checker import (

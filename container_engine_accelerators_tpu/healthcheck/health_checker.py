@@ -116,18 +116,30 @@ class LogFileErrorSource:
 # else it counts against the whole host. Fleets override the table via
 # the runtimeLogScraper config block.
 DEFAULT_SCRAPE_RULES = (
-    (r"uncorrectable\s+(?:hbm\s+)?ecc|hbm.*uncorrectable",
+    # "(?<!\b0 )" keeps zero-count scrub summaries ("hbm scrub: 0
+    # uncorrectable ecc errors") from evicting a healthy host; requiring
+    # the word "error(s)" keeps config echoes and headers out. These
+    # classes are critical by default, so false positives are sticky —
+    # the rules err tight, and fleets widen them via config.
+    (r"(?<!\b0 )uncorrectable\s+(?:hbm\s+)?ecc\s+error",
      "HBM_ECC_UNCORRECTABLE"),
-    (r"correctable\s+(?:hbm\s+)?ecc\s+error", "HBM_ECC_CORRECTABLE"),
+    (r"(?<!\b0 )(?<!un)correctable\s+(?:hbm\s+)?ecc\s+error",
+     "HBM_ECC_CORRECTABLE"),
     (r"ici\s+link.*(?:down|failed)|link\s+layer\s+down", "ICI_LINK_DOWN"),
     (r"ici.*crc\s+error", "ICI_CRC_ERROR"),
-    (r"thermal\s+(?:trip|shutdown|throttl)", "THERMAL_TRIP"),
+    # Routine throttling is NOT a trip: only trip/shutdown lines count.
+    (r"thermal\s+(?:trip|shutdown)", "THERMAL_TRIP"),
     (r"(?:watchdog|heartbeat)\s+timeout|runtime\s+(?:hang|stuck)"
      r"|tpu\s+core\s+halted", "RUNTIME_HANG"),
 )
 
-_CHIP_RE = re.compile(r"(?:chip|core|accel|device)[ _#:]*(?P<chip>\d+)",
-                      re.IGNORECASE)
+# Digits after the keyword must end at a token boundary: 'device
+# 0000:04:00.0' (a PCI address) or '0xdead' must not read as chip 0.
+# A trailing colon is fine ('chip 2: ...') unless more digits follow
+# (that's an address segment).
+_CHIP_RE = re.compile(
+    r"(?:chip|core|accel|device)[ _#:]*(?P<chip>\d+)(?![\w.]|:\d)",
+    re.IGNORECASE)
 
 
 class RuntimeLogScraperSource:
@@ -155,6 +167,11 @@ class RuntimeLogScraperSource:
                 if chip is None:
                     cm = _CHIP_RE.search(line)
                     chip = cm.group("chip") if cm else None
+                # Guard custom rules whose `chip` group is non-numeric:
+                # a ValueError here would drop the whole (already
+                # consumed) poll batch.
+                if chip is not None and not str(chip).isdigit():
+                    chip = None
                 events.append(ErrorEvent(
                     chip_index=int(chip) if chip is not None else -1,
                     error_class=cls,

@@ -117,14 +117,18 @@ def test_runtime_log_scraper_rules_and_rotation(tmp_path):
     src = RuntimeLogScraperSource(str(path))
     assert src.poll() == []
     path.write_text(
-        "I0729 libtpu: chip 2: uncorrectable HBM ECC error at 0xdead\n"
+        "I0729 libtpu: chip 2: uncorrectable HBM ECC error detected\n"
+        "I0729 hbm scrub: 0 uncorrectable ecc errors\n"
+        "I0729 thermal throttling engaged\n"
         "I0729 all quiet on the interconnect\n"
         "W0729 ICI link 3 down on chip 1\n")
     events = src.poll()
+    # The zero-count scrub summary and routine throttling lines must NOT
+    # alert (both map to critical-by-default, sticky classes).
     assert events == [
         ErrorEvent(2, "HBM_ECC_UNCORRECTABLE",
                    "I0729 libtpu: chip 2: uncorrectable HBM ECC error "
-                   "at 0xdead"),
+                   "detected"),
         ErrorEvent(1, "ICI_LINK_DOWN", "W0729 ICI link 3 down on chip 1"),
     ]
     assert src.poll() == []  # no re-delivery
@@ -143,6 +147,27 @@ def test_runtime_log_scraper_rules_and_rotation(tmp_path):
                                      "device 0")]
 
 
+def test_runtime_log_scraper_chip_attribution_guards(tmp_path):
+    from container_engine_accelerators_tpu.healthcheck.health_checker import (
+        RuntimeLogScraperSource,
+    )
+    path = tmp_path / "runtime.log"
+    # PCI addresses / hex tokens after a device keyword must not read as
+    # chip 0 — these lines attribute to the whole host (-1).
+    path.write_text("ICI link down on device 0000:04:00.0\n"
+                    "watchdog timeout at device 0xdead0000\n")
+    src = RuntimeLogScraperSource(str(path))
+    assert [(e.error_class, e.chip_index) for e in src.poll()] == [
+        ("ICI_LINK_DOWN", -1), ("RUNTIME_HANG", -1)]
+    # A custom rule whose chip group is non-numeric degrades to -1
+    # instead of raising (which would drop the consumed batch).
+    path2 = tmp_path / "r2.log"
+    path2.write_text("hang on hostA\n")
+    src2 = RuntimeLogScraperSource(
+        str(path2), rules=((r"hang on (?P<chip>\w+)", "RUNTIME_HANG"),))
+    assert src2.poll() == [ErrorEvent(-1, "RUNTIME_HANG", "hang on hostA")]
+
+
 def test_runtime_log_scraper_non_utf8_bytes(tmp_path):
     # Raw runtime logs carry stray bytes; the tail offset must count
     # raw bytes or it drifts and swallows the next (critical) line.
@@ -150,7 +175,7 @@ def test_runtime_log_scraper_non_utf8_bytes(tmp_path):
         RuntimeLogScraperSource,
     )
     path = tmp_path / "runtime.log"
-    path.write_bytes(b"caf\xe9 uncorrectable HBM ECC on chip 1\n")
+    path.write_bytes(b"caf\xe9 uncorrectable HBM ECC error on chip 1\n")
     src = RuntimeLogScraperSource(str(path))
     assert [e.error_class for e in src.poll()] == ["HBM_ECC_UNCORRECTABLE"]
     with path.open("ab") as f:
@@ -184,7 +209,7 @@ def test_runtime_log_source_via_config(tmp_path, fake_k8s, client):
     assert names == ["LogFileErrorSource", "DevfsPresenceSource",
                      "RuntimeLogScraperSource"]
     # Critical class scraped from the raw log flips the chip unhealthy.
-    path.write_text("chip 1 uncorrectable HBM ECC\n")
+    path.write_text("chip 1 uncorrectable HBM ECC error\n")
     checker.poll_once()
     assert m.devices["accel1"].health == "Unhealthy"
     assert m.devices["accel0"].health != "Unhealthy"

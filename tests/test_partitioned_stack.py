@@ -1,0 +1,75 @@
+"""End-to-end test of the one-file partitioned node stack: the sequence
+`kubectl apply -f libtpu-installer/cos/daemonset-partitioned.yaml`
+drives on a real node — ConfigMap config -> partition-tpu init container
+-> device plugin — executed here against a fake devfs, asserting the
+advertised units are chip groups of the configured size (reference
+analog: daemonset-nvidia-mig.yaml wiring partition-gpus before the
+plugin)."""
+
+import json
+import pathlib
+
+import yaml
+
+from container_engine_accelerators_tpu.cli.partition_tpu import main as partition_main
+from container_engine_accelerators_tpu.deviceplugin import (
+    MockDeviceInfo,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.deviceplugin import config as tpu_config
+from tests.test_deviceplugin import make_fake_devfs
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+MANIFEST = REPO / "libtpu-installer" / "cos" / "daemonset-partitioned.yaml"
+
+
+def load_docs():
+    return list(yaml.safe_load_all(MANIFEST.read_text()))
+
+
+def test_manifest_wires_partitioner_before_plugin():
+    cm, ds = load_docs()
+    assert cm["kind"] == "ConfigMap"
+    spec = ds["spec"]["template"]["spec"]
+    init_names = [c["name"] for c in spec["initContainers"]]
+    assert init_names == ["libtpu-installer", "partition-tpu"]
+    plugin = spec["containers"][0]
+    # Plugin and partitioner must read the SAME config file.
+    assert "--config-file=/etc/tpu/tpu_config.json" in plugin["command"]
+    part_cmd = " ".join(spec["initContainers"][1]["command"])
+    assert "--config-file /etc/tpu/tpu_config.json" in part_cmd
+
+
+def test_partitioned_stack_end_to_end(tmp_path):
+    cm, ds = load_docs()
+    # Step 1 (ConfigMap -> /etc/tpu): the partition init container copies
+    # the mounted ConfigMap payload into the shared emptyDir.
+    cfg_json = cm["data"]["tpu_config.json"]
+    size = json.loads(cfg_json)["chipsPerPartition"]
+    cfg_path = tmp_path / "etc-tpu" / "tpu_config.json"
+    cfg_path.parent.mkdir()
+    cfg_path.write_text(cfg_json)
+
+    # Step 2: partition-tpu validates against the discovered chips and
+    # rewrites the config (idempotent desired-state apply).
+    dev = make_fake_devfs(tmp_path, n=4)
+    rc = partition_main(["--config-file", str(cfg_path),
+                         "--dev-root", dev])
+    assert rc == 0
+
+    # Step 3: the device plugin loads the same file and advertises
+    # partitioned units spanning `size` chips each.
+    cfg = tpu_config.load(str(cfg_path))
+    assert cfg.chips_per_partition == size
+    mgr = TPUManager(cfg, MockDeviceInfo(dev))
+    mgr.discover()
+    assert len(mgr.devices) == 4 // size
+    for dev_id in mgr.devices:
+        specs = mgr.device_specs([dev_id])
+        assert len(specs) == size  # each unit mounts its member chips
+
+    # Re-running the partitioner is a no-op (rerun-safe init container).
+    rc = partition_main(["--config-file", str(cfg_path),
+                         "--dev-root", dev])
+    assert rc == 0
+    assert tpu_config.load(str(cfg_path)).chips_per_partition == size
