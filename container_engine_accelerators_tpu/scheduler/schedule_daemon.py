@@ -22,6 +22,7 @@ filling one TPU slice before spilling over DCN.
 from __future__ import annotations
 
 import argparse
+import calendar
 import logging
 import re
 import time
@@ -38,6 +39,13 @@ log = logging.getLogger("topology-scheduler")
 
 GATE_PREFIX = "gke.io/topology-aware-auto-"
 INDEX_ANNOTATION = "batch.kubernetes.io/job-completion-index"
+# Stamped when we ungate a pod; marks it as placed by this scheduler so
+# the node-failure repair path can find (and safely delete) it later.
+PLACED_ANNOTATION = "topology.tpu.gke.io/placed-gate"
+# A node must be NotReady this long before its gang is torn down —
+# kubelet restarts and upgrades flap Ready for well under a minute, and
+# each premature teardown costs the Job a pod-failure count.
+NODE_LOST_GRACE_SECONDS = 60.0
 
 
 # ---------- pod grouping ----------
@@ -118,14 +126,20 @@ def free_tpus_by_node(nodes: list[dict], running_pods: list[dict]
 # ---------- assignment search ----------
 
 def assign_pods(pods: list[dict], nodes: list[dict],
-                free: dict[str, int]) -> dict[str, str] | None:
+                free: dict[str, int],
+                anchors: list[NodeTopology] = ()) -> dict[str, str] | None:
     """Map pod name -> node name for the whole group, or None if the gang
     does not fit.
 
     Uniform per-pod demand (the TPU norm — every worker asks for the same
     chip count) expands each node into free//demand slots, so several
     small workers can share one host; mixed demands fall back to one pod
-    per node."""
+    per node.
+
+    `anchors` are topologies of gang members already Running (survivors
+    of a partial node failure): they join the window's distance score so
+    the recreated members land near the survivors instead of forming a
+    cross-rack gang."""
     demands = [(pod["metadata"]["name"], _pod_tpu_request(pod))
                for pod in sorted(pods, key=pod_sort_key)]
     uniform = len({d for _, d in demands}) == 1
@@ -154,7 +168,7 @@ def assign_pods(pods: list[dict], nodes: list[dict],
         if any(cap < demand for (_, cap), (_, demand)
                in zip(window, demands)):
             continue
-        score = pairwise_distance([t for t, _ in window])
+        score = pairwise_distance([t for t, _ in window] + list(anchors))
         if best_score is None or score < best_score:
             best, best_score = window, score
     if best is None:
@@ -181,20 +195,135 @@ def schedule_pod_on_node(k8s, namespace: str, name: str, node: str,
     spec["schedulingGates"] = [
         g for g in spec.get("schedulingGates", [])
         if g.get("name") != gate]
+    pod.setdefault("metadata", {}).setdefault("annotations", {})[
+        PLACED_ANNOTATION] = gate
     k8s.replace_pod(namespace, name, pod)
     log.info("scheduled %s/%s -> %s", namespace, name, node)
+
+
+# ---------- node-failure repair ----------
+
+def assigned_node(pod: dict) -> str | None:
+    """The hostname this scheduler pinned via nodeAffinity, if any."""
+    terms = (pod.get("spec", {}).get("affinity", {})
+             .get("nodeAffinity", {})
+             .get("requiredDuringSchedulingIgnoredDuringExecution", {})
+             .get("nodeSelectorTerms", []) or [])
+    for term in terms:
+        for expr in term.get("matchExpressions", []) or []:
+            if expr.get("key") == "kubernetes.io/hostname" \
+                    and expr.get("operator") == "In":
+                values = expr.get("values") or []
+                if len(values) == 1:
+                    return values[0]
+    return None
+
+
+def _ready_condition(node: dict) -> dict | None:
+    conds = (node.get("status", {}) or {}).get("conditions", []) or []
+    return next((c for c in conds if c.get("type") == "Ready"), None)
+
+
+def _not_ready(node: dict) -> bool:
+    """Currently NotReady — excluded from placement immediately (placing
+    onto a flapping node just queues a future repair)."""
+    ready = _ready_condition(node)
+    return ready is not None and ready.get("status") != "True"
+
+
+def _node_lost(node: dict, now: float | None = None) -> bool:
+    """NotReady for longer than the grace period -> gang teardown."""
+    ready = _ready_condition(node)
+    if ready is None or ready.get("status") == "True":
+        return False
+    ltt = ready.get("lastTransitionTime")
+    if not ltt:
+        return True  # no timestamp: cannot prove it's a fresh flap
+    try:
+        t = calendar.timegm(time.strptime(ltt, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return True
+    now = time.time() if now is None else now
+    return now - t >= NODE_LOST_GRACE_SECONDS
+
+
+def repair_lost_gangs(k8s, pending: list[dict], nodes: list[dict]) -> int:
+    """Re-place gangs whose assigned node died after ungating.
+
+    The K8s API forbids both re-adding a schedulingGate and mutating
+    nodeAffinity on an ungated pod, so 're-gate' is implemented the only
+    legal way: delete the orphaned Pending members — their controller
+    (Job/JobSet) recreates them gated — and delete their Pending
+    gang-mates too, so the recreated gang is placed together instead of
+    half of it holding stale capacity on healthy nodes. Running members
+    are untouched. Only pods this scheduler placed (PLACED_ANNOTATION)
+    and that have a controller ownerReference are eligible; a bare pod
+    would not come back. (ROADMAP item 6; the reference relies wholly on
+    Job recreation here.)
+    """
+    node_names = {n["metadata"]["name"] for n in nodes}
+    lost = {n["metadata"]["name"] for n in nodes if _node_lost(n)}
+
+    def placed_gate(pod):
+        return (pod.get("metadata", {}).get("annotations", {}) or {}).get(
+            PLACED_ANNOTATION)
+
+    def controller_owned(pod):
+        return any(ref.get("controller")
+                   for ref in pod.get("metadata", {}).get(
+                       "ownerReferences", []) or [])
+
+    orphaned_groups = set()
+    for pod in pending:
+        if find_gate(pod) or not placed_gate(pod):
+            continue
+        node = assigned_node(pod)
+        if node and (node not in node_names or node in lost):
+            orphaned_groups.add(job_key(pod))
+
+    deleted = 0
+    for pod in pending:
+        if job_key(pod) not in orphaned_groups:
+            continue
+        if find_gate(pod) or not placed_gate(pod):
+            continue
+        if not controller_owned(pod):
+            log.warning("orphaned pod %s has no controller; leaving it",
+                        pod["metadata"].get("name"))
+            continue
+        ns = pod["metadata"].get("namespace", "default")
+        name = pod["metadata"]["name"]
+        try:
+            k8s.delete_pod(ns, name)
+            deleted += 1
+            log.info("deleted %s/%s (gang member of a lost node; "
+                     "controller will recreate it gated)", ns, name)
+        except Exception:
+            log.exception("failed to delete orphaned pod %s/%s", ns, name)
+    return deleted
 
 
 # ---------- main loop ----------
 
 def run_once(k8s) -> int:
-    """One scheduling pass; returns number of pods scheduled."""
+    """One scheduling pass; returns the pods scheduled plus orphans
+    repaired (so the main loop keeps the fast interval while a gang
+    recovery is in flight)."""
     pending = k8s.list_pods(field_selector="status.phase=Pending")["items"]
+    nodes = k8s.list_nodes()["items"]
+    repaired = repair_lost_gangs(k8s, pending, nodes)
+    if repaired:
+        # Deleted members will reappear gated; pick the gang up whole on
+        # the next pass rather than placing a partial group now.
+        pending = k8s.list_pods(
+            field_selector="status.phase=Pending")["items"]
     gated = [p for p in pending if find_gate(p)]
     if not gated:
-        return 0
-
-    nodes = k8s.list_nodes()["items"]
+        return repaired
+    # NotReady nodes never receive placements — placing there would just
+    # queue the same gang for repair (delete/recreate churn, and each
+    # cycle costs the Job a pod-failure count).
+    ready_nodes = [n for n in nodes if not _not_ready(n)]
     running = k8s.list_pods()["items"]
     # Terminated pods keep spec.nodeName until garbage-collected but hold
     # no devices — counting them would leak capacity forever.
@@ -202,14 +331,24 @@ def run_once(k8s) -> int:
                 if p.get("spec", {}).get("nodeName")
                 and p.get("status", {}).get("phase")
                 not in ("Succeeded", "Failed")]
-    free = free_tpus_by_node(nodes, assigned)
+    free = free_tpus_by_node(ready_nodes, assigned)
+    node_topo = {n["metadata"]["name"]: NodeTopology.from_labels(
+        n["metadata"]["name"],
+        n.get("metadata", {}).get("labels", {}) or {}) for n in nodes}
 
     scheduled = 0
     groups = defaultdict(list)
     for pod in gated:
         groups[job_key(pod)].append(pod)
     for key, pods in sorted(groups.items()):
-        assignment = assign_pods(pods, nodes, dict(free))
+        # Gang members already Running (survivors of a partial failure)
+        # anchor the placement so recreated members land near them.
+        anchors = [node_topo[p["spec"]["nodeName"]]
+                   for p in assigned
+                   if job_key(p) == key
+                   and p["spec"]["nodeName"] in node_topo]
+        assignment = assign_pods(pods, ready_nodes, dict(free),
+                                 anchors=anchors)
         if assignment is None:
             log.info("group %s (%d pods) does not fit; waiting",
                      key, len(pods))
@@ -222,7 +361,7 @@ def run_once(k8s) -> int:
             free[node] -= _pod_tpu_request(pod)
             scheduled += 1
         log.info("group %s: scheduled %d pods", key, len(pods))
-    return scheduled
+    return scheduled + repaired
 
 
 def main(argv=None):

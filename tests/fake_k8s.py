@@ -107,6 +107,18 @@ class FakeK8s:
                     return self._send(body)
                 return self._send({"message": "not found"}, 404)
 
+            def do_DELETE(self):
+                fake.requests.append(("DELETE", self.path))
+                path = self.path.split("?")[0]
+                m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)",
+                                 path)
+                if m:
+                    pod = fake.pods.pop((m.group(1), m.group(2)), None)
+                    if pod is None:
+                        return self._send({"message": "not found"}, 404)
+                    return self._send(pod)
+                return self._send({"message": "not found"}, 404)
+
             def do_PATCH(self):
                 fake.requests.append(("PATCH", self.path))
                 path = self.path.split("?")[0]

@@ -215,6 +215,145 @@ def test_run_once_ignores_ungated(fake_k8s, client):
     assert sd.run_once(client) == 0
 
 
+# ---------- node-failure repair (re-gate via controller recreation) ----
+
+
+def test_node_deletion_triggers_gang_reassignment(fake_k8s, client):
+    """A placed gang member whose node vanishes: both Pending members are
+    deleted (controller recreates them gated), and the recreated gang is
+    placed together on surviving nodes."""
+    for n in [node("s1-0", labels=slice_labels("s1", "0-0")),
+              node("s1-1", labels=slice_labels("s1", "1-0")),
+              node("s2-0", labels=slice_labels("s2", "0-0", rack="r2")),
+              node("s2-1", labels=slice_labels("s2", "1-0", rack="r2"))]:
+        fake_k8s.nodes[n["metadata"]["name"]] = n
+    for p in [pod("j-0", labels={"job-name": "j"}, owner="u1"),
+              pod("j-1", labels={"job-name": "j"}, owner="u1")]:
+        fake_k8s.pods[("default", p["metadata"]["name"])] = p
+    assert sd.run_once(client) == 2
+    placed_on = {sd.assigned_node(fake_k8s.pods[("default", n)])
+                 for n in ("j-0", "j-1")}
+    assert placed_on == {"s1-0", "s1-1"}
+
+    # The slice dies before the pods bind. Repair counts as activity so
+    # the daemon keeps its fast interval during recovery.
+    del fake_k8s.nodes["s1-0"]
+    del fake_k8s.nodes["s1-1"]
+    assert sd.run_once(client) == 2
+    # Whole gang deleted, not just the orphaned member.
+    assert ("default", "j-0") not in fake_k8s.pods
+    assert ("default", "j-1") not in fake_k8s.pods
+
+    # Controller recreates the pods gated; next pass places them on the
+    # surviving slice.
+    for p in [pod("j-0-r", labels={"job-name": "j"}, owner="u1"),
+              pod("j-1-r", labels={"job-name": "j"}, owner="u1")]:
+        fake_k8s.pods[("default", p["metadata"]["name"])] = p
+    assert sd.run_once(client) == 2
+    chosen = {sd.assigned_node(fake_k8s.pods[("default", n)])
+              for n in ("j-0-r", "j-1-r")}
+    assert chosen == {"s2-0", "s2-1"}
+
+
+def test_not_ready_node_triggers_repair(fake_k8s, client):
+    for n in [node("s1-0", labels=slice_labels("s1", "0-0")),
+              node("s2-0", labels=slice_labels("s2", "0-0", rack="r2"))]:
+        fake_k8s.nodes[n["metadata"]["name"]] = n
+    fake_k8s.pods[("default", "j-0")] = pod(
+        "j-0", labels={"job-name": "j"}, owner="u1")
+    assert sd.run_once(client) == 1
+    assert sd.assigned_node(fake_k8s.pods[("default", "j-0")]) == "s1-0"
+
+    fake_k8s.nodes["s1-0"]["status"]["conditions"] = [
+        {"type": "Ready", "status": "False"}]
+    sd.run_once(client)
+    assert ("default", "j-0") not in fake_k8s.pods
+
+
+def test_fresh_notready_flap_is_not_torn_down(fake_k8s, client):
+    # A NotReady transition younger than the grace period (kubelet
+    # restart, upgrade) must not cost the gang a teardown.
+    import time as _time
+    fake_k8s.nodes["s1-0"] = node("s1-0",
+                                  labels=slice_labels("s1", "0-0"))
+    fake_k8s.pods[("default", "j-0")] = pod(
+        "j-0", labels={"job-name": "j"}, owner="u1")
+    assert sd.run_once(client) == 1
+    now = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    fake_k8s.nodes["s1-0"]["status"]["conditions"] = [
+        {"type": "Ready", "status": "False", "lastTransitionTime": now}]
+    assert sd.run_once(client) == 0
+    assert ("default", "j-0") in fake_k8s.pods  # spared
+    old = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(
+        _time.time() - 2 * sd.NODE_LOST_GRACE_SECONDS))
+    fake_k8s.nodes["s1-0"]["status"]["conditions"][0][
+        "lastTransitionTime"] = old
+    assert sd.run_once(client) == 1
+    assert ("default", "j-0") not in fake_k8s.pods  # now genuinely lost
+
+
+def test_notready_node_excluded_from_placement(fake_k8s, client):
+    # The only fitting node is NotReady: the gang must stay gated (not
+    # placed onto it, which would start a delete/recreate churn loop).
+    fake_k8s.nodes["s1-0"] = node("s1-0",
+                                  labels=slice_labels("s1", "0-0"))
+    fake_k8s.nodes["s1-0"]["status"]["conditions"] = [
+        {"type": "Ready", "status": "False"}]
+    fake_k8s.pods[("default", "j-0")] = pod(
+        "j-0", labels={"job-name": "j"}, owner="u1")
+    assert sd.run_once(client) == 0
+    assert fake_k8s.pods[("default", "j-0")]["spec"]["schedulingGates"]
+
+
+def test_recreated_member_anchors_to_running_survivor(fake_k8s, client):
+    # Gang of 2: j-0 Running in rack r2; the recreated j-1 must land in
+    # r2 too, not on the topologically-first node of another rack.
+    for n in [node("r1-0", labels=slice_labels("s1", "0-0", rack="r1")),
+              node("r2-0", labels=slice_labels("s2", "0-0", rack="r2")),
+              node("r2-1", labels=slice_labels("s2", "1-0", rack="r2"))]:
+        fake_k8s.nodes[n["metadata"]["name"]] = n
+    running = pod("j-0", labels={"job-name": "j"}, owner="u1",
+                  node="r2-0", phase="Running", gates=(),
+                  annotations={sd.PLACED_ANNOTATION: "g"})
+    fake_k8s.pods[("default", "j-0")] = running
+    fake_k8s.pods[("default", "j-1")] = pod(
+        "j-1", labels={"job-name": "j"}, owner="u1")
+    assert sd.run_once(client) == 1
+    assert sd.assigned_node(fake_k8s.pods[("default", "j-1")]) == "r2-1"
+
+
+def test_repair_spares_running_and_unowned(fake_k8s, client):
+    fake_k8s.nodes["s2-0"] = node("s2-0",
+                                  labels=slice_labels("s2", "0-0"))
+    # Running gang member on a healthy node: untouched.
+    running = pod("j-0", labels={"job-name": "j"}, owner="u1",
+                  node="s2-0", phase="Running", gates=(),
+                  annotations={sd.PLACED_ANNOTATION: "g"})
+    # Orphaned Pending member pinned to a node that no longer exists.
+    orphan = pod("j-1", labels={"job-name": "j"}, owner="u1", gates=(),
+                 annotations={sd.PLACED_ANNOTATION: "g"})
+    orphan["spec"]["affinity"] = {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [{
+                "key": "kubernetes.io/hostname", "operator": "In",
+                "values": ["gone-node"]}]}]}}}
+    # Pod WE never placed (no annotation): repair must not touch it even
+    # though its affinity points nowhere.
+    foreign = pod("alien", labels={"job-name": "z"}, gates=())
+    foreign["spec"]["affinity"] = {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [{
+                "key": "kubernetes.io/hostname", "operator": "In",
+                "values": ["gone-node"]}]}]}}}
+    for p in (running, orphan, foreign):
+        fake_k8s.pods[("default", p["metadata"]["name"])] = p
+
+    sd.run_once(client)
+    assert ("default", "j-0") in fake_k8s.pods   # running: spared
+    assert ("default", "j-1") not in fake_k8s.pods  # orphan: deleted
+    assert ("default", "alien") in fake_k8s.pods    # foreign: spared
+
+
 # ---------- node labeler ----------
 
 class FakeMetadata:
