@@ -64,6 +64,11 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Dropless grouped-matmul MoE (models/moe.py moe_mlp_dropless):
+    # every routed token is computed — no capacity, dropped_fraction 0.
+    # Requires mesh ep == 1 (the ragged group axis cannot be GSPMD-
+    # partitioned); the capacity path remains the ep-sharded form.
+    moe_dropless: bool = False
     moe_aux_weight: float = 0.01
     moe_z_weight: float = 0.001
 
@@ -205,9 +210,13 @@ def _mlp(x, lp, cfg: LlamaConfig, constrain):
     dt = cfg.dtype
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
-        from container_engine_accelerators_tpu.models.moe import moe_mlp
+        from container_engine_accelerators_tpu.models.moe import (
+            moe_mlp,
+            moe_mlp_dropless,
+        )
 
-        out, metrics = moe_mlp(h, lp, cfg, constrain)
+        mlp_fn = moe_mlp_dropless if cfg.moe_dropless else moe_mlp
+        out, metrics = mlp_fn(h, lp, cfg, constrain)
         aux = (cfg.moe_aux_weight * metrics.aux_loss
                + cfg.moe_z_weight * metrics.router_z_loss)
         return x + constrain(out, "resid"), aux
@@ -242,6 +251,12 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     use_pp = bool(cfg.pipeline_microbatches) and pp > 1
+    if cfg.n_experts and cfg.moe_dropless and mesh is not None \
+            and mesh.shape.get("ep", 1) > 1:
+        raise ValueError(
+            "moe_dropless requires ep == 1 (the ragged group axis "
+            "cannot be GSPMD-partitioned); use the capacity path for "
+            "expert-parallel meshes")
     # Inside the pipelined shard_map region ('pp' manual, others auto),
     # with_sharding_constraint over auto axes trips the XLA partitioner;
     # GSPMD still shards the stage internals from the param shardings.

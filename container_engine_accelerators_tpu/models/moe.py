@@ -1,5 +1,5 @@
-"""Mixture-of-Experts FFN with capacity-based top-k routing, expert
-weights sharded over the 'ep' mesh axis.
+"""Mixture-of-Experts FFN: capacity-based top-k routing (expert weights
+sharded over the 'ep' mesh axis) plus a dropless grouped-matmul variant.
 
 TPU-first formulation: routing is expressed as one-hot dispatch/combine
 einsums (no gather/scatter — everything is MXU-shaped contractions with
@@ -15,6 +15,15 @@ batch row; overflow tokens are dropped (their combine weights are zero,
 so they pass through the residual unchanged — standard Switch behavior).
 The router adds the Switch load-balancing aux loss (E * mean(f_i * P_i))
 and router z-loss.
+
+Dropless variant (`moe_mlp_dropless`, cfg.moe_dropless): tokens are
+sorted by their routed expert and the three FFN matmuls run as
+`jax.lax.ragged_dot` grouped contractions over the expert-contiguous
+rows — the megablocks formulation in the form XLA:TPU supports natively.
+No capacity, no overflow, dropped_fraction is identically 0. Scope: the
+ragged group axis cannot be partitioned by GSPMD, so this path targets
+meshes with ep == 1 (fsdp/tp/sp/pp still apply); the capacity/einsum
+path remains the ep-sharded formulation.
 """
 
 from __future__ import annotations
@@ -47,10 +56,7 @@ def route(router_logits: jnp.ndarray, n_experts: int, top_k: int,
     expert; rank-0 (highest-probability) choices claim before rank-1.
     """
     b, s, e = router_logits.shape
-    probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E]
-    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)     # [B,S,k]
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    probs, gate_vals, expert_idx = _gating(router_logits, top_k)
 
     # One-hot per routing rank: [B,S,k,E].
     onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
@@ -75,12 +81,80 @@ def route(router_logits: jnp.ndarray, n_experts: int, top_k: int,
 
     # Switch aux loss: fraction of tokens per expert (rank-0 routing) vs
     # mean router probability per expert.
-    frac_tokens = jnp.mean(onehot[:, :, 0, :], axis=(0, 1))  # [E]
-    mean_probs = jnp.mean(probs, axis=(0, 1))                # [E]
-    aux = e * jnp.sum(frac_tokens * mean_probs)
-    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    aux, z = _aux_losses(router_logits, probs, expert_idx, e)
     dropped = 1.0 - jnp.sum(dispatch) / (b * s * top_k)
     return dispatch, combine, MoeMetrics(aux, z, dropped)
+
+
+def _router_logits(h, lp):
+    return jnp.einsum("bsd,de->bse", h.astype(jnp.float32),
+                      lp["w_router"].astype(jnp.float32))
+
+
+def _gating(router_logits, top_k):
+    """Softmax + top-k + gate renormalization — the single source both
+    the capacity and dropless paths route through."""
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    return probs, gate_vals, expert_idx
+
+
+def _router(h, lp, top_k):
+    """Router head: returns (logits [B,S,E] f32, probs, normalized gate
+    values [B,S,k], expert indices [B,S,k])."""
+    router_logits = _router_logits(h, lp)
+    return (router_logits, *_gating(router_logits, top_k))
+
+
+def _aux_losses(router_logits, probs, expert_idx, n_experts):
+    onehot0 = jax.nn.one_hot(expert_idx[..., 0], n_experts,
+                             dtype=jnp.float32)
+    frac_tokens = jnp.mean(onehot0, axis=(0, 1))
+    mean_probs = jnp.mean(probs, axis=(0, 1))
+    aux = n_experts * jnp.sum(frac_tokens * mean_probs)
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    return aux, z
+
+
+def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None):
+    """Dropless token-choice MoE via grouped matmul. Same weights and
+    router as moe_mlp; every routed (token, expert) pair is computed.
+
+    [B*S*k] rows sorted by expert -> ragged_dot against [E, D, F]
+    weights (expert-contiguous groups) -> combine by scatter-add with
+    the gate weights. All shapes static; only group_sizes is data-
+    dependent, which ragged_dot is built for."""
+    b, s, d = h.shape
+    e, k = cfg.n_experts, cfg.moe_top_k
+    dt = h.dtype
+    router_logits, probs, gate_vals, expert_idx = _router(h, lp, k)
+
+    n_tok = b * s
+    x = h.reshape(n_tok, d)
+    expert_flat = expert_idx.reshape(-1)          # [n_tok * k]
+    gates_flat = gate_vals.reshape(-1)
+    # Stable sort keeps token order within each expert group.
+    order = jnp.argsort(expert_flat, stable=True)
+    token_of_row = order // k
+    rows = x[token_of_row].astype(dt)             # [N, D] expert-sorted
+    # bincount, not a [N, E] one-hot reduce: at training scale the
+    # intermediate would cost real HBM bandwidth every step.
+    group_sizes = jnp.bincount(expert_flat, length=e).astype(jnp.int32)
+
+    gate_p = jax.lax.ragged_dot(rows, lp["w_gate"].astype(dt),
+                                group_sizes)
+    up_p = jax.lax.ragged_dot(rows, lp["w_up"].astype(dt), group_sizes)
+    down = jax.lax.ragged_dot(jax.nn.silu(gate_p) * up_p,
+                              lp["w_down"].astype(dt), group_sizes)
+
+    weighted = down * gates_flat[order][:, None].astype(dt)
+    out = jnp.zeros((n_tok, d), dt).at[token_of_row].add(weighted)
+
+    aux, z = _aux_losses(router_logits, probs, expert_idx, e)
+    return out.reshape(b, s, d), MoeMetrics(aux, z,
+                                            jnp.zeros((), jnp.float32))
 
 
 def moe_mlp(h: jnp.ndarray, lp: dict, cfg, constrain=None):
@@ -93,9 +167,7 @@ def moe_mlp(h: jnp.ndarray, lp: dict, cfg, constrain=None):
     dt = h.dtype
     cap = capacity(s, e, cfg.moe_top_k, cfg.moe_capacity_factor)
 
-    router_logits = jnp.einsum(
-        "bsd,de->bse", h.astype(jnp.float32),
-        lp["w_router"].astype(jnp.float32))
+    router_logits = _router_logits(h, lp)
     dispatch, combine, metrics = route(router_logits, e, cfg.moe_top_k, cap)
 
     expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), h)

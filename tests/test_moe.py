@@ -166,3 +166,94 @@ def test_moe_pipeline_aux_scale_matches_unpipelined():
     # scale must match — a missing 1/M shows up as a ~4x ratio.
     ratio = float(aux_pp) / float(aux_plain)
     assert 0.7 < ratio < 1.4, ratio
+
+
+# ---------- dropless (grouped-matmul) variant ----------
+
+def test_dropless_single_expert_equals_dense():
+    from container_engine_accelerators_tpu.models.moe import moe_mlp_dropless
+    cfg = llama_tiny(n_experts=1, moe_top_k=1, moe_dropless=True,
+                     dtype=jnp.float32)
+    b, s, d = 2, 8, cfg.d_model
+    h = jax.random.normal(jax.random.key(0), (b, s, d))
+    w_gate = jax.random.normal(jax.random.key(1), (1, d, cfg.d_ff)) * 0.05
+    w_up = jax.random.normal(jax.random.key(2), (1, d, cfg.d_ff)) * 0.05
+    w_down = jax.random.normal(jax.random.key(3), (1, cfg.d_ff, d)) * 0.05
+    lp = {"w_router": jnp.zeros((d, 1)), "w_gate": w_gate, "w_up": w_up,
+          "w_down": w_down}
+    out, metrics = moe_mlp_dropless(h, lp, cfg)
+    gate = jax.nn.silu(h @ w_gate[0])
+    dense = (gate * (h @ w_up[0])) @ w_down[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-4, atol=1e-4)
+    assert float(metrics.dropped_fraction) == 0.0
+
+
+def test_dropless_matches_capacity_when_nothing_drops():
+    # With capacity ample enough that the einsum path drops nothing, both
+    # formulations compute the identical function.
+    from container_engine_accelerators_tpu.models.moe import moe_mlp_dropless
+    cfg_cap = llama_tiny(n_experts=4, moe_top_k=2,
+                         moe_capacity_factor=4.0, dtype=jnp.float32)
+    cfg_dl = llama_tiny(n_experts=4, moe_top_k=2, moe_dropless=True,
+                        dtype=jnp.float32)
+    d = cfg_cap.d_model
+    h = jax.random.normal(jax.random.key(0), (2, 16, d))
+    k1, k2, k3, k4 = jax.random.split(jax.random.key(1), 4)
+    lp = {"w_router": jax.random.normal(k1, (d, 4)) * 0.1,
+          "w_gate": jax.random.normal(k2, (4, d, cfg_cap.d_ff)) * 0.05,
+          "w_up": jax.random.normal(k3, (4, d, cfg_cap.d_ff)) * 0.05,
+          "w_down": jax.random.normal(k4, (4, cfg_cap.d_ff, d)) * 0.05}
+    out_cap, m_cap = moe_mlp(h, lp, cfg_cap)
+    out_dl, m_dl = moe_mlp_dropless(h, lp, cfg_dl)
+    assert float(m_cap.dropped_fraction) == pytest.approx(0.0, abs=1e-6)
+    np.testing.assert_allclose(np.asarray(out_dl), np.asarray(out_cap),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(m_dl.aux_loss), float(m_cap.aux_loss),
+                               rtol=1e-5)
+
+
+def test_dropless_never_drops_under_imbalance():
+    # Adversarial router: every token picks expert 0. The capacity path
+    # drops most of them; the dropless path computes them all.
+    from container_engine_accelerators_tpu.models.moe import moe_mlp_dropless
+    cfg_cap = llama_tiny(n_experts=4, moe_top_k=1,
+                         moe_capacity_factor=1.0, dtype=jnp.float32)
+    cfg_dl = llama_tiny(n_experts=4, moe_top_k=1, moe_dropless=True,
+                        dtype=jnp.float32)
+    d = cfg_cap.d_model
+    h = jax.random.normal(jax.random.key(0), (2, 16, d))
+    w_router = jnp.zeros((d, 4)).at[:, 0].set(1.0)
+    k2, k3, k4 = jax.random.split(jax.random.key(1), 3)
+    lp = {"w_router": w_router,
+          "w_gate": jax.random.normal(k2, (4, d, cfg_cap.d_ff)) * 0.05,
+          "w_up": jax.random.normal(k3, (4, d, cfg_cap.d_ff)) * 0.05,
+          "w_down": jax.random.normal(k4, (4, cfg_cap.d_ff, d)) * 0.05}
+    _, m_cap = moe_mlp(h, lp, cfg_cap)
+    _, m_dl = moe_mlp_dropless(h, lp, cfg_dl)
+    assert float(m_cap.dropped_fraction) >= 0.5  # capacity path drops
+    assert float(m_dl.dropped_fraction) == 0.0   # dropless never does
+
+
+def test_dropless_train_step(mesh8):
+    cfg = llama_tiny(vocab_size=64, n_experts=4, moe_dropless=True)
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2,
+                         decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh8, opt)
+    step_fn = make_train_step(cfg, mesh8, opt)
+    losses = []
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=8,
+                                   seq_len=32, num_batches=8, seed=0):
+        batch = shard_batch(batch, mesh8)
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_dropless_rejects_expert_parallel_mesh(mesh_ep):
+    cfg = llama_tiny(n_experts=4, moe_dropless=True)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(ValueError, match="ep == 1"):
+        forward(params, tokens, cfg, mesh=mesh_ep)
