@@ -1,0 +1,166 @@
+"""Fabric (ICI/DCN) metrics exporter — the analog of the reference's
+vendor fabric-metrics DaemonSet (reference
+gpudirect-tcpx/tcpx-metrics-server.yaml), which exports NIC datapath
+counters so fabric regressions are visible without running a collective
+test. Chip duty-cycle (metrics/metrics.py) says the MXU is busy; only
+fabric counters say the *interconnect* is healthy.
+
+Two sources:
+  - DCN: per-interface byte/packet/drop counters from
+    /sys/class/net/<if>/statistics (multislice traffic rides host
+    NICs), exported raw plus a derived throughput gauge over the poll
+    window.
+  - ICI: an optional low-rate loopback probe via the dcn-prober's TCP
+    echo port (native/dcn_prober) — RTT as a liveness/latency gauge.
+    True ICI link counters need libtpu telemetry; when
+    /sys/class/accel/<chip>/ici_errors exists it is exported as-is.
+
+Serves Prometheus on :2113/metrics (the chip exporter owns :2112).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
+from container_engine_accelerators_tpu.metrics.serving import ExporterBase
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SYSFS_NET = "/sys/class/net"
+DEFAULT_SYSFS_ACCEL = "/sys/class/accel"
+STAT_FILES = ("tx_bytes", "rx_bytes", "tx_packets", "rx_packets",
+              "tx_dropped", "rx_dropped")
+
+
+def _read_int(path: str) -> int | None:
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+class FabricMetricServer(ExporterBase):
+    name = "fabric-metrics"
+
+    def __init__(self, interfaces: list[str] | None = None,
+                 sysfs_net: str = DEFAULT_SYSFS_NET,
+                 sysfs_accel: str = DEFAULT_SYSFS_ACCEL,
+                 probe_addr: tuple[str, int] | None = None,
+                 port: int = 2113, interval: float = 10.0):
+        self.sysfs_net = sysfs_net
+        self.sysfs_accel = sysfs_accel
+        self.interfaces = interfaces  # None = all non-loopback
+        self.probe_addr = probe_addr
+        self.port = port
+        self.interval = interval
+        self._stop = threading.Event()
+        self._last: dict[tuple[str, str], tuple[int, float]] = {}
+
+        self.registry = CollectorRegistry()
+        self.nic_counter = Gauge(
+            "tpu_dcn_nic_stat",
+            "Raw NIC counter from /sys/class/net (DCN datapath)",
+            ["interface", "stat"], registry=self.registry)
+        self.nic_throughput = Gauge(
+            "tpu_dcn_throughput_bytes_per_sec",
+            "Derived NIC throughput over the poll window",
+            ["interface", "direction"], registry=self.registry)
+        self.ici_errors = Gauge(
+            "tpu_ici_error_count",
+            "ICI error counter per chip (sysfs, when exposed)",
+            ["tpu_chip"], registry=self.registry)
+        self.probe_rtt = Gauge(
+            "tpu_dcn_probe_rtt_seconds",
+            "TCP RTT to the dcn-prober echo port (datapath liveness)",
+            [], registry=self.registry)
+        self.scrapes = Counter(
+            "tpu_fabric_poll_total", "Fabric poll iterations",
+            [], registry=self.registry)
+
+    # ---------- collection ----------
+
+    def _iter_interfaces(self) -> list[str]:
+        if self.interfaces is not None:
+            return self.interfaces
+        try:
+            names = sorted(os.listdir(self.sysfs_net))
+        except OSError:
+            return []
+        return [n for n in names if n != "lo"]
+
+    def poll_once(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        for iface in self._iter_interfaces():
+            stats_dir = os.path.join(self.sysfs_net, iface, "statistics")
+            for stat in STAT_FILES:
+                val = _read_int(os.path.join(stats_dir, stat))
+                if val is None:
+                    continue
+                self.nic_counter.labels(interface=iface, stat=stat).set(val)
+                if stat in ("tx_bytes", "rx_bytes"):
+                    key = (iface, stat)
+                    prev = self._last.get(key)
+                    if prev is not None and now > prev[1]:
+                        rate = max(0.0, (val - prev[0]) / (now - prev[1]))
+                        self.nic_throughput.labels(
+                            interface=iface,
+                            direction=stat.split("_")[0]).set(rate)
+                    self._last[key] = (val, now)
+        try:
+            chips = sorted(os.listdir(self.sysfs_accel))
+        except OSError:
+            chips = []
+        for chip in chips:
+            val = _read_int(os.path.join(self.sysfs_accel, chip,
+                                         "ici_errors"))
+            if val is not None:
+                self.ici_errors.labels(tpu_chip=chip).set(val)
+        if self.probe_addr:
+            self._probe()
+        self.scrapes.inc()
+
+    def _probe(self) -> None:
+        t0 = time.monotonic()
+        try:
+            with socket.create_connection(self.probe_addr, timeout=2.0):
+                self.probe_rtt.set(time.monotonic() - t0)
+        except OSError:
+            self.probe_rtt.set(-1.0)  # unreachable sentinel
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, default=2113)
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--interfaces", default="",
+                   help="comma list; empty = all non-loopback")
+    p.add_argument("--probe", default="",
+                   help="host:port of a dcn-prober echo to RTT-probe")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    probe = None
+    if args.probe:
+        host, _, port = args.probe.rpartition(":")
+        probe = (host, int(port))
+    srv = FabricMetricServer(
+        interfaces=[i for i in args.interfaces.split(",") if i] or None,
+        probe_addr=probe, port=args.port, interval=args.interval)
+    srv.start_background()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

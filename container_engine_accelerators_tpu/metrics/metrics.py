@@ -11,11 +11,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import wsgiref.simple_server
 
-from prometheus_client import CollectorRegistry, Gauge, make_wsgi_app
+from prometheus_client import CollectorRegistry, Gauge
 
 from container_engine_accelerators_tpu.deviceplugin import sharing
+from container_engine_accelerators_tpu.metrics.serving import ExporterBase
 
 log = logging.getLogger(__name__)
 
@@ -23,7 +23,8 @@ CONTAINER_LABELS = ["namespace", "pod", "container", "tpu_chip", "model"]
 NODE_LABELS = ["tpu_chip", "model"]
 
 
-class MetricServer:
+class MetricServer(ExporterBase):
+    name = "metrics"
     def __init__(self, manager, sampler=None, pod_resources=None,
                  port: int = 2112, interval: float = 10.0):
         from container_engine_accelerators_tpu.metrics.devices import (
@@ -127,33 +128,7 @@ class MetricServer:
                 self.memory_used.labels(**labels).set(s.memory_used_bytes)
                 self.memory_total.labels(**labels).set(s.memory_total_bytes)
 
-    # ---------- serving ----------
-
-    def start_background(self):
-        app = make_wsgi_app(self.registry)
-        self._httpd = wsgiref.simple_server.make_server(
-            "", self.port, app,
-            handler_class=_QuietHandler)
-        threading.Thread(target=self._httpd.serve_forever, daemon=True,
-                         name="metrics-http").start()
-        threading.Thread(target=self._update_loop, daemon=True,
-                         name="metrics-update").start()
-        log.info("metrics serving on :%d/metrics", self.port)
-
-    def _update_loop(self):
-        while not self._stop.is_set():
-            try:
-                self.update_once()
-            except Exception:
-                log.exception("metrics update failed")
-            self._stop.wait(self.interval)
-
-    def stop(self):
-        self._stop.set()
-        if hasattr(self, "_httpd"):
-            self._httpd.shutdown()
-
-
-class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
-    def log_message(self, *args):
-        pass
+    # Serving scaffold (HTTP thread + poll loop + stop) lives in
+    # metrics/serving.py, shared with the fabric exporter.
+    def poll_once(self) -> None:
+        self.update_once()

@@ -1,0 +1,53 @@
+"""Shared Prometheus-exporter scaffold: WSGI server + poll thread +
+Event-based stop, used by both the chip exporter (metrics.py) and the
+fabric exporter (fabric.py) so serving fixes land in one place."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import wsgiref.simple_server
+
+from prometheus_client import make_wsgi_app
+
+log = logging.getLogger(__name__)
+
+
+class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, *args):
+        pass
+
+
+class ExporterBase:
+    """Subclasses provide self.registry, self.port, self.interval, and
+    poll_once(); this base owns the HTTP thread + poll loop + stop."""
+
+    _stop: threading.Event
+    name = "exporter"
+
+    def poll_once(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def start_background(self) -> None:
+        app = make_wsgi_app(self.registry)
+        self._httpd = wsgiref.simple_server.make_server(
+            "", self.port, app, handler_class=_QuietHandler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=f"{self.name}-http").start()
+        threading.Thread(target=self._poll_loop, daemon=True,
+                         name=f"{self.name}-poll").start()
+        log.info("%s serving on :%d/metrics", self.name,
+                 self._httpd.server_address[1])
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("%s poll failed", self.name)
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if getattr(self, "_httpd", None):
+            self._httpd.shutdown()

@@ -1,0 +1,128 @@
+"""Fabric metrics exporter: NIC counters + derived throughput from a
+fake sysfs tree, ICI error counters, and the dcn-prober RTT probe."""
+
+import socket
+import threading
+
+from prometheus_client import generate_latest
+
+from container_engine_accelerators_tpu.metrics.fabric import (
+    FabricMetricServer,
+)
+
+
+def make_fake_net(tmp_path, stats):
+    net = tmp_path / "net"
+    for iface, values in stats.items():
+        d = net / iface / "statistics"
+        d.mkdir(parents=True)
+        for stat, val in values.items():
+            (d / stat).write_text(f"{val}\n")
+    (net / "lo" / "statistics").mkdir(parents=True)
+    (net / "lo" / "statistics" / "tx_bytes").write_text("1\n")
+    return str(net)
+
+
+def scrape(srv) -> str:
+    return generate_latest(srv.registry).decode()
+
+
+def test_nic_counters_and_throughput(tmp_path):
+    net = make_fake_net(tmp_path, {
+        "eth0": {"tx_bytes": 1000, "rx_bytes": 500, "tx_packets": 10,
+                 "rx_packets": 5, "tx_dropped": 0, "rx_dropped": 1}})
+    srv = FabricMetricServer(sysfs_net=net,
+                             sysfs_accel=str(tmp_path / "accel"))
+    srv.poll_once(now=100.0)
+    text = scrape(srv)
+    assert 'tpu_dcn_nic_stat{interface="eth0",stat="tx_bytes"} 1000.0' \
+        in text
+    assert 'stat="rx_dropped"} 1.0' in text
+    assert "lo" not in text  # loopback excluded
+
+    # 4000 more tx bytes over 2 seconds -> 2000 B/s.
+    (tmp_path / "net" / "eth0" / "statistics" / "tx_bytes").write_text(
+        "5000\n")
+    srv.poll_once(now=102.0)
+    text = scrape(srv)
+    assert ('tpu_dcn_throughput_bytes_per_sec{direction="tx",'
+            'interface="eth0"} 2000.0') in text
+
+
+def test_counter_reset_clamps_to_zero(tmp_path):
+    # NIC reset (driver reload): counter goes backwards; rate must clamp
+    # to 0 rather than exporting a huge negative.
+    net = make_fake_net(tmp_path, {"eth0": {"tx_bytes": 9000}})
+    srv = FabricMetricServer(sysfs_net=net,
+                             sysfs_accel=str(tmp_path / "accel"))
+    srv.poll_once(now=1.0)
+    (tmp_path / "net" / "eth0" / "statistics" / "tx_bytes").write_text(
+        "100\n")
+    srv.poll_once(now=2.0)
+    assert ('tpu_dcn_throughput_bytes_per_sec{direction="tx",'
+            'interface="eth0"} 0.0') in scrape(srv)
+
+
+def test_ici_error_counters(tmp_path):
+    accel = tmp_path / "accel"
+    (accel / "accel0").mkdir(parents=True)
+    (accel / "accel0" / "ici_errors").write_text("7\n")
+    (accel / "accel1").mkdir()  # no counter file: skipped, not exported
+    srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(accel))
+    srv.poll_once(now=1.0)
+    text = scrape(srv)
+    assert 'tpu_ici_error_count{tpu_chip="accel0"} 7.0' in text
+    assert "accel1" not in text
+
+
+def test_probe_rtt(tmp_path):
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+
+    def accept_one():
+        try:
+            conn, _ = listener.accept()
+            conn.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=accept_one)
+    t.start()
+    srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(tmp_path / "accel"),
+                             probe_addr=listener.getsockname())
+    srv.poll_once(now=1.0)
+    text = scrape(srv)
+    rtt = float(next(l for l in text.splitlines()
+                     if l.startswith("tpu_dcn_probe_rtt_seconds")
+                     ).split()[-1])
+    assert 0.0 <= rtt < 1.0
+    t.join(timeout=5)  # accept completed before the listener goes away
+    listener.close()
+
+    # Unreachable target -> -1 sentinel.
+    srv2 = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                              sysfs_accel=str(tmp_path / "accel"),
+                              probe_addr=("127.0.0.1", 1))
+    srv2.poll_once(now=1.0)
+    assert "tpu_dcn_probe_rtt_seconds -1.0" in scrape(srv2)
+
+
+def test_http_server_serves_metrics(tmp_path):
+    import urllib.request
+    net = make_fake_net(tmp_path, {"eth0": {"tx_bytes": 42}})
+    srv = FabricMetricServer(sysfs_net=net,
+                             sysfs_accel=str(tmp_path / "accel"),
+                             port=0, interval=3600)
+    srv.start_background()
+    try:
+        srv.poll_once(now=1.0)
+        port = srv._httpd.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "tpu_dcn_nic_stat" in body
+        assert "tpu_fabric_poll_total" in body
+    finally:
+        srv.stop()

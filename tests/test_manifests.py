@@ -126,6 +126,24 @@ def test_llama_demo_memory_budget():
         f"on {n_chips} chips leaves no room for activations on v5e")
 
 
+def test_health_config_manifest_validates(tmp_path, monkeypatch):
+    """The tpu_config.json embedded in test/tpu/health-config.yaml must
+    load through the real config parser (regex + class validation) —
+    a bad pattern shipped in the ConfigMap would crash the plugin."""
+    from container_engine_accelerators_tpu.deviceplugin import config as cfgmod
+
+    monkeypatch.delenv("TPU_HEALTH_CONFIG", raising=False)
+    (doc,) = _docs(REPO / "test" / "tpu" / "health-config.yaml")
+    p = tmp_path / "tpu_config.json"
+    p.write_text(doc["data"]["tpu_config.json"])
+    cfg = cfgmod.load(str(p))
+    assert cfg.runtime_log_path == "/var/log/tpu/runtime.log"
+    assert len(cfg.runtime_log_rules) == 2
+    classes = doc["data"]["critical-errors"].split(",")
+    for c in classes:
+        assert c in cfgmod.KNOWN_ERROR_CLASSES
+
+
 def test_llama_8b_jobset_memory_budget():
     """The multi-host JobSet variant: 8B at f32 adam sharded over the
     whole v5p-64 slice must fit each chip's 95 GB HBM with margin."""
