@@ -100,9 +100,26 @@ class InjectorPlugin:
         }}
 
 
+def update_containers(runtime_client: TtrpcClient,
+                      updates) -> list:
+    """Plugin-initiated Runtime.UpdateContainers (the client path of
+    reference vendor/github.com/containerd/nri/pkg/stub/stub.go): push
+    container resource updates OUTSIDE an event response — e.g. retune
+    cgroup limits of running workers after a repartition. Returns the
+    updates the runtime reports as failed."""
+    req = api.UpdateContainersRequest(update=updates)
+    payload = runtime_client.call(RUNTIME_SERVICE, "UpdateContainers",
+                                  req.SerializeToString())
+    resp = api.UpdateContainersResponse.FromString(payload)
+    return list(resp.failed)
+
+
 def serve_connection(sock: socket.socket, plugin_name: str,
-                     plugin_idx: str) -> tuple[Mux, TtrpcServer]:
-    """Wire one NRI connection: returns (mux, server) once registered."""
+                     plugin_idx: str
+                     ) -> tuple[Mux, TtrpcServer, TtrpcClient]:
+    """Wire one NRI connection: returns (mux, server, runtime_client)
+    once registered. The client stays usable for plugin-initiated
+    Runtime calls (update_containers)."""
     plugin = InjectorPlugin()
     mux = Mux(sock)
     server = TtrpcServer(mux.conn(PLUGIN_SERVICE_CONN), plugin.handlers())
@@ -112,7 +129,7 @@ def serve_connection(sock: socket.socket, plugin_name: str,
                     plugin_name=plugin_name,
                     plugin_idx=plugin_idx).SerializeToString())
     log.info("registered NRI plugin %s (idx %s)", plugin_name, plugin_idx)
-    return mux, server
+    return mux, server, client
 
 
 def main(argv=None) -> int:
@@ -134,8 +151,8 @@ def main(argv=None) -> int:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             sock.connect(args.nri_socket)
-            mux, server = serve_connection(sock, args.plugin_name,
-                                           args.plugin_index)
+            mux, server, _ = serve_connection(sock, args.plugin_name,
+                                              args.plugin_index)
             mux._closed.wait()  # until containerd drops the connection
             server.stop()
             mux.close()  # also closes sock — no fd leak per reconnect

@@ -95,17 +95,29 @@ def _fake_containerd(sock):
     )
 
     registered = []
+    updates_seen = []
 
     def register_plugin(payload):
         registered.append(api.RegisterPluginRequest.FromString(payload))
         return api.Empty().SerializeToString()
 
+    def update_containers(payload):
+        req = api.UpdateContainersRequest.FromString(payload)
+        updates_seen.extend(req.update)
+        resp = api.UpdateContainersResponse()
+        # Contract: un-appliable updates are echoed back as failed.
+        for u in req.update:
+            if u.container_id == "gone":
+                resp.failed.add().CopyFrom(u)
+        return resp.SerializeToString()
+
     mux = Mux(sock)
     server = TtrpcServer(mux.conn(RUNTIME_SERVICE_CONN), {
         "nri.pkg.api.v1alpha1.Runtime": {
-            "RegisterPlugin": register_plugin}})
+            "RegisterPlugin": register_plugin,
+            "UpdateContainers": update_containers}})
     client = TtrpcClient(mux.conn(PLUGIN_SERVICE_CONN))
-    return mux, server, client, registered
+    return mux, server, client, (registered, updates_seen)
 
 
 def test_nri_plugin_end_to_end(tmp_path):
@@ -120,14 +132,15 @@ def test_nri_plugin_end_to_end(tmp_path):
     )
 
     runtime_sock, plugin_sock = socket.socketpair()
-    rt_mux, rt_server, rt_client, registered = _fake_containerd(runtime_sock)
+    rt_mux, rt_server, rt_client, (registered, updates_seen) = \
+        _fake_containerd(runtime_sock)
 
     import threading
     result = {}
 
     def plugin_side():
-        result["mux"], result["server"] = serve_connection(
-            plugin_sock, "tpu-device-injector", "10")
+        result["mux"], result["server"], result["client"] = \
+            serve_connection(plugin_sock, "tpu-device-injector", "10")
 
     t = threading.Thread(target=plugin_side, daemon=True)
     t.start()
@@ -176,6 +189,23 @@ def test_nri_plugin_end_to_end(tmp_path):
     # Unknown method surfaces an rpc error, not a hang.
     with pytest.raises(RuntimeError):
         rt_client.call(PLUGIN_SERVICE, "NoSuchMethod", b"")
+
+    # Plugin-initiated UpdateContainers (the stub.go client path): push
+    # resource updates outside an event response; runtime echoes back
+    # the one it could not apply.
+    from container_engine_accelerators_tpu.nri.daemon import (
+        update_containers,
+    )
+    good = api.ContainerUpdate(container_id="c1")
+    good.linux.resources.cpu.shares.value = 2048
+    good.linux.resources.cpu.quota.value = -1  # int64: unlimited sentinel
+    good.linux.resources.memory.limit.value = 1 << 30
+    gone = api.ContainerUpdate(container_id="gone", ignore_failure=False)
+    failed = update_containers(result["client"], [good, gone])
+    assert [u.container_id for u in updates_seen] == ["c1", "gone"]
+    assert [u.container_id for u in failed] == ["gone"]
+    assert updates_seen[0].linux.resources.cpu.shares.value == 2048
+    assert updates_seen[0].linux.resources.cpu.quota.value == -1
 
     result["server"].stop()
     rt_server.stop()
