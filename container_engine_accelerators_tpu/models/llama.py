@@ -46,9 +46,14 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32     # master weights
     remat_policy: str = "dots"         # 'none' | 'dots' | 'full'
     use_flash: bool | None = None      # None = auto by platform
-    # Ring attention over the 'sp' mesh axis (parallel/ring_attention.py);
-    # enabled by the training layer when the mesh has sp > 1.
+    # Sequence/context parallelism over the 'sp' mesh axis; enabled by
+    # the training layer when the mesh has sp > 1. Mode 'ring' rotates
+    # KV blocks via ppermute (parallel/ring_attention.py); 'ulysses'
+    # re-shards sequence<->heads with one all-to-all each way
+    # (parallel/ulysses.py; needs n_heads and n_kv_heads divisible by
+    # sp*tp).
     sequence_parallel: bool = False
+    sequence_parallel_mode: str = "ring"
     # GPipe microbatch count for the 'pp' mesh axis (parallel/pipeline.py);
     # 0 disables pipelining. Requires n_layers % pp == 0.
     pipeline_microbatches: int = 0
@@ -197,8 +202,22 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
     k = constrain(k, "qkv")
     v = constrain(v, "qkv")
     if cfg.sequence_parallel:
-        from container_engine_accelerators_tpu.parallel import ring_attention as ra
-        attn = ra.ring_attention(q, k, v, axis_name="sp", mesh=mesh)
+        if cfg.sequence_parallel_mode == "ulysses":
+            from container_engine_accelerators_tpu.parallel import (
+                ulysses as ul,
+            )
+            attn = ul.ulysses_attention(q, k, v, axis_name="sp",
+                                        mesh=mesh,
+                                        use_flash=cfg.use_flash)
+        elif cfg.sequence_parallel_mode == "ring":
+            from container_engine_accelerators_tpu.parallel import (
+                ring_attention as ra,
+            )
+            attn = ra.ring_attention(q, k, v, axis_name="sp", mesh=mesh)
+        else:
+            raise ValueError(
+                f"unknown sequence_parallel_mode "
+                f"{cfg.sequence_parallel_mode!r}; valid: ring, ulysses")
     else:
         attn = multi_head_attention(q, k, v, causal=True,
                                     use_flash=cfg.use_flash)

@@ -20,7 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 NEG_INF = -1e30
 
@@ -128,16 +128,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
     n_chunks = mesh.shape[axis_name]
     chunk_len = q.shape[1] // n_chunks
-    spec = P(("dp", "fsdp"), axis_name, "tp", None)
     body = functools.partial(_ring_body, axis_name=axis_name,
                              n_chunks=n_chunks, chunk_len=chunk_len,
                              causal=causal)
-    # Nested inside another shard_map (e.g. the 'pp' pipeline region) the
-    # context is an AbstractMesh with some axes already Manual; shard_map
-    # then requires that context mesh, not the concrete one.
-    from jax.sharding import get_abstract_mesh
-
-    ctx = get_abstract_mesh()
-    use_mesh = ctx if not ctx.empty else mesh
-    return jax.shard_map(body, mesh=use_mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        sp_shard_map,
+    )
+    return sp_shard_map(body, mesh, axis_name, 3)(q, k, v)

@@ -1,0 +1,23 @@
+"""Shared shard_map wrapping for sequence-parallel attention bodies
+(ring and ulysses use the identical layout contract)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def sp_shard_map(body, mesh: Mesh, axis_name: str, n_args: int):
+    """Wrap `body` in shard_map with the [batch=(dp,fsdp), seq=sp,
+    heads=tp, head_dim] spec on every arg and the output.
+
+    Nested inside another shard_map (e.g. the 'pp' pipeline region) the
+    context is an AbstractMesh with some axes already Manual; shard_map
+    then requires that context mesh, not the concrete one."""
+    from jax.sharding import get_abstract_mesh
+
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    ctx = get_abstract_mesh()
+    use_mesh = ctx if not ctx.empty else mesh
+    return jax.shard_map(body, mesh=use_mesh, in_specs=(spec,) * n_args,
+                         out_specs=spec, check_vma=False)

@@ -1,0 +1,78 @@
+"""Ulysses-style all-to-all sequence parallelism over the 'sp' axis —
+the second of the two long-context strategies (goal doc: "ring attention
+or all-to-all sequence/context parallelism"; DeepSpeed-Ulysses is the
+public lineage, expressed here as two `jax.lax.all_to_all`s under
+shard_map, which XLA lowers to ICI all-to-alls).
+
+Versus ring attention (parallel/ring_attention.py):
+  - ring keeps sequence sharded and rotates KV blocks P times
+    (P ppermutes, overlap-friendly, KV repeated to Hq before the ring);
+  - ulysses re-shards sequence->heads with ONE all-to-all each way, then
+    runs full-sequence attention locally — the pallas flash kernel
+    applies unchanged to the local head group, and GQA KV heads transfer
+    WITHOUT repetition (each shard keeps Hkv/sp true KV heads), so the
+    bytes moved are 2 x (Hq + 2*Hkv)/sp per token instead of P rotations
+    of repeated KV.
+
+Constraints: n_heads % sp == 0 and n_kv_heads % sp == 0 (heads are the
+scatter axis), and S % sp == 0 as with ring.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh
+
+from container_engine_accelerators_tpu.ops import multi_head_attention
+
+
+def _ulysses_body(q, k, v, *, axis_name: str, causal: bool,
+                  use_flash: bool | None):
+    """Per-shard body. q: [B, S/sp, Hq, D]; k/v: [B, S/sp, Hkv, D]."""
+    sp = int(jax.lax.psum(1, axis_name))  # static axis size
+    for name, arr in (("q heads", q), ("kv heads", k)):
+        if arr.shape[2] % sp:
+            raise ValueError(
+                f"ulysses needs local {name} ({arr.shape[2]}) divisible "
+                f"by {axis_name}={sp} for the head-scatter all-to-all")
+    # Scatter heads, gather sequence: [B, S, H/sp, D] per shard.
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    # Full-sequence attention on the local head group; GQA ratio is
+    # preserved ((Hq/sp) / (Hkv/sp) == Hq/Hkv), and the flash kernel
+    # gate sees the full sequence length.
+    out = multi_head_attention(qg, kg, vg, causal=causal,
+                               use_flash=use_flash)
+    # Gather heads back, scatter sequence: [B, S/sp, Hq, D].
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp",
+                      causal: bool = True, mesh: Mesh | None = None,
+                      use_flash: bool | None = None):
+    """q: [B, S, Hq, D] (globally shaped, sequence sharded on
+    `axis_name`); k/v: [B, S, Hkv, D]. Call inside an existing shard_map
+    context (mesh=None) or at jit level with `mesh` given — the same
+    calling contract as ring_attention."""
+    body = functools.partial(_ulysses_body, axis_name=axis_name,
+                             causal=causal, use_flash=use_flash)
+    if mesh is None:
+        return body(q, k, v)
+
+    sp = mesh.shape[axis_name]
+    tp = mesh.shape.get("tp", 1)
+    # The head axis is already tp-sharded inside the region, so each
+    # shard's H/tp local heads must split sp ways for the all-to-all.
+    for name, arr in (("n_heads", q), ("n_kv_heads", k)):
+        if arr.shape[2] % (sp * tp):
+            raise ValueError(
+                f"ulysses needs {name} ({arr.shape[2]}) divisible by "
+                f"{axis_name}*tp={sp * tp}")
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        sp_shard_map,
+    )
+    return sp_shard_map(body, mesh, axis_name, 3)(q, k, v)
