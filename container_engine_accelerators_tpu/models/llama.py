@@ -69,6 +69,10 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # 'token_choice' (tokens pick top-k experts, capacity-dropped) or
+    # 'expert_choice' (experts pick top-C tokens — dropless AND
+    # ep-shardable; non-causal routing, see models/moe.py caveat).
+    moe_router: str = "token_choice"
     # Dropless grouped-matmul MoE (models/moe.py moe_mlp_dropless):
     # every routed token is computed — no capacity, dropped_fraction 0.
     # Requires mesh ep == 1 (the ragged group axis cannot be GSPMD-
@@ -274,8 +278,14 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             and mesh.shape.get("ep", 1) > 1:
         raise ValueError(
             "moe_dropless requires ep == 1 (the ragged group axis "
-            "cannot be GSPMD-partitioned); use the capacity path for "
-            "expert-parallel meshes")
+            "cannot be GSPMD-partitioned); use moe_router="
+            "'expert_choice' for dropless expert-parallel meshes")
+    if cfg.n_experts and cfg.moe_dropless \
+            and cfg.moe_router != "token_choice":
+        raise ValueError(
+            "moe_dropless implements token-choice routing; it cannot "
+            "combine with moe_router='expert_choice' (which is already "
+            "dropless — drop the moe_dropless flag)")
     # Inside the pipelined shard_map region ('pp' manual, others auto),
     # with_sharding_constraint over auto axes trips the XLA partitioner;
     # GSPMD still shards the stage internals from the param shardings.

@@ -24,6 +24,17 @@ No capacity, no overflow, dropped_fraction is identically 0. Scope: the
 ragged group axis cannot be partitioned by GSPMD, so this path targets
 meshes with ep == 1 (fsdp/tp/sp/pp still apply); the capacity/einsum
 path remains the ep-sharded formulation.
+
+Expert-choice routing (cfg.moe_router="expert_choice"): experts pick
+their top-C tokens instead of tokens picking experts (Zhou et al.) —
+capacity is exactly filled by construction (no overflow, perfect load
+balance, no balancing aux loss needed), and the dispatch stays the same
+ep-shardable one-hot einsum as the capacity path, so this is the
+dropless formulation that DOES compose with expert parallelism.
+Honest caveat for causal LMs: an expert's token choices depend on the
+whole sequence, so routing leaks non-causal information across
+positions during training — standard for encoder/prefix models, use
+deliberately for decoder pretraining.
 """
 
 from __future__ import annotations
@@ -118,6 +129,35 @@ def _aux_losses(router_logits, probs, expert_idx, n_experts):
     return aux, z
 
 
+def route_expert_choice(router_logits: jnp.ndarray, cap: int):
+    """Expert-choice routing: each expert takes its top-`cap` tokens.
+    router_logits: [B, S, E] (float32). Returns (dispatch, combine,
+    metrics) with dispatch/combine [B, S, E, C] — the same shapes the
+    capacity router produces, so the expert-FFN einsum pipeline is
+    shared unchanged."""
+    b, s, e = router_logits.shape
+    # top_k demands k <= axis size; capacity() can exceed S (e.g. few
+    # experts with capacity_factor > 1) — an expert can never hold more
+    # tokens than exist anyway.
+    cap = min(cap, s)
+    probs = jax.nn.softmax(router_logits, axis=-1)           # [B,S,E]
+    scores = jnp.swapaxes(probs, 1, 2)                       # [B,E,S]
+    gate_vals, token_idx = jax.lax.top_k(scores, cap)        # [B,E,C]
+    # dispatch[b,s,e,c] = 1 iff expert e's slot c holds token s.
+    slot_token = jax.nn.one_hot(token_idx, s, dtype=jnp.float32)
+    dispatch = jnp.einsum("becs->bsec", slot_token)
+    combine = jnp.einsum("becs,bec->bsec", slot_token, gate_vals)
+
+    # No balancing loss: every expert is exactly full by construction.
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    # Informational: fraction of tokens no expert selected (they pass
+    # through the residual — distinct from capacity-overflow dropping).
+    picked = jnp.clip(jnp.sum(dispatch, axis=(2, 3)), 0.0, 1.0)  # [B,S]
+    unrouted = 1.0 - jnp.mean(picked)
+    return dispatch, combine, MoeMetrics(jnp.zeros((), jnp.float32), z,
+                                         unrouted)
+
+
 def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None):
     """Dropless token-choice MoE via grouped matmul. Same weights and
     router as moe_mlp; every routed (token, expert) pair is computed.
@@ -168,7 +208,16 @@ def moe_mlp(h: jnp.ndarray, lp: dict, cfg, constrain=None):
     cap = capacity(s, e, cfg.moe_top_k, cfg.moe_capacity_factor)
 
     router_logits = _router_logits(h, lp)
-    dispatch, combine, metrics = route(router_logits, e, cfg.moe_top_k, cap)
+    router = getattr(cfg, "moe_router", "token_choice")
+    if router == "expert_choice":
+        dispatch, combine, metrics = route_expert_choice(router_logits,
+                                                         cap)
+    elif router == "token_choice":
+        dispatch, combine, metrics = route(router_logits, e,
+                                           cfg.moe_top_k, cap)
+    else:
+        raise ValueError(f"unknown moe_router {router!r}; valid: "
+                         f"token_choice, expert_choice")
 
     expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), h)
     gate = jax.nn.silu(jnp.einsum("becd,edf->becf", expert_in,

@@ -257,3 +257,113 @@ def test_dropless_rejects_expert_parallel_mesh(mesh_ep):
     tokens = jnp.zeros((2, 8), jnp.int32)
     with pytest.raises(ValueError, match="ep == 1"):
         forward(params, tokens, cfg, mesh=mesh_ep)
+
+
+# ---------- expert-choice routing ----------
+
+def test_expert_choice_exactly_fills_experts():
+    from container_engine_accelerators_tpu.models.moe import (
+        route_expert_choice,
+    )
+    b, s, e, cap = 2, 16, 4, 8
+    logits = jax.random.normal(jax.random.key(0), (b, s, e))
+    dispatch, combine, metrics = route_expert_choice(logits, cap)
+    # Every expert holds exactly `cap` tokens — perfect balance by
+    # construction, even under an adversarial router.
+    per_expert = jnp.sum(dispatch, axis=(1, 3))  # [B, E]
+    np.testing.assert_allclose(np.asarray(per_expert), cap)
+    assert float(metrics.aux_loss) == 0.0
+
+
+def test_expert_choice_single_expert_full_capacity_equals_dense():
+    from container_engine_accelerators_tpu.models.moe import moe_mlp
+    # E=1 with capacity covering the whole sequence: every token goes to
+    # the one expert with gate 1 (softmax over one logit), so the MoE
+    # must equal the dense FFN.
+    cfg = llama_tiny(n_experts=1, moe_top_k=1, moe_capacity_factor=1.0,
+                     moe_router="expert_choice", dtype=jnp.float32)
+    b, s, d = 2, 8, cfg.d_model
+    h = jax.random.normal(jax.random.key(0), (b, s, d))
+    w_gate = jax.random.normal(jax.random.key(1), (1, d, cfg.d_ff)) * 0.05
+    w_up = jax.random.normal(jax.random.key(2), (1, d, cfg.d_ff)) * 0.05
+    w_down = jax.random.normal(jax.random.key(3), (1, cfg.d_ff, d)) * 0.05
+    lp = {"w_router": jnp.zeros((d, 1)), "w_gate": w_gate, "w_up": w_up,
+          "w_down": w_down}
+    out, metrics = moe_mlp(h, lp, cfg)
+    gate = jax.nn.silu(h @ w_gate[0])
+    dense = (gate * (h @ w_up[0])) @ w_down[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-4, atol=1e-4)
+    assert float(metrics.dropped_fraction) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_expert_choice_balanced_under_adversarial_router():
+    from container_engine_accelerators_tpu.models.moe import (
+        route,
+        route_expert_choice,
+    )
+    # All tokens prefer expert 0: token-choice overflows and drops;
+    # expert-choice keeps every expert exactly full.
+    b, s, e, k = 2, 16, 4, 1
+    logits = jnp.zeros((b, s, e)).at[..., 0].set(10.0)
+    cap = 4  # s*k/e
+    _, _, tc = route(logits, e, top_k=k, cap=cap)
+    assert float(tc.dropped_fraction) >= 0.5
+    dispatch, _, ec = route_expert_choice(logits, cap)
+    per_expert = jnp.sum(dispatch, axis=(1, 3))
+    np.testing.assert_allclose(np.asarray(per_expert), cap)
+
+
+def test_expert_choice_train_step_on_ep_mesh(mesh_ep):
+    # The whole point: dropless routing that composes with expert
+    # parallelism (the ragged_dot path cannot).
+    cfg = llama_tiny(vocab_size=64, n_experts=4,
+                     moe_router="expert_choice")
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2,
+                         decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh_ep, opt)
+    step_fn = make_train_step(cfg, mesh_ep, opt)
+    losses = []
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=8,
+                                   seq_len=32, num_batches=8, seed=0):
+        batch = shard_batch(batch, mesh_ep)
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_expert_choice_capacity_clamped_to_sequence():
+    from container_engine_accelerators_tpu.models.moe import moe_mlp
+    # capacity() can exceed S (few experts, factor > 1); the EC router
+    # must clamp instead of crashing top_k.
+    cfg = llama_tiny(n_experts=2, moe_top_k=2, moe_capacity_factor=1.25,
+                     moe_router="expert_choice", dtype=jnp.float32)
+    d = cfg.d_model
+    h = jax.random.normal(jax.random.key(0), (2, 8, d))
+    k1, k2, k3, k4 = jax.random.split(jax.random.key(1), 4)
+    lp = {"w_router": jax.random.normal(k1, (d, 2)) * 0.1,
+          "w_gate": jax.random.normal(k2, (2, d, cfg.d_ff)) * 0.05,
+          "w_up": jax.random.normal(k3, (2, d, cfg.d_ff)) * 0.05,
+          "w_down": jax.random.normal(k4, (2, cfg.d_ff, d)) * 0.05}
+    out, metrics = moe_mlp(h, lp, cfg)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_moe_router_config_validation():
+    from container_engine_accelerators_tpu.models.moe import moe_mlp
+    cfg = llama_tiny(n_experts=2, moe_router="expert-choice",
+                     dtype=jnp.float32)
+    lp = {"w_router": jnp.zeros((cfg.d_model, 2)),
+          "w_gate": jnp.zeros((2, cfg.d_model, cfg.d_ff)),
+          "w_up": jnp.zeros((2, cfg.d_model, cfg.d_ff)),
+          "w_down": jnp.zeros((2, cfg.d_ff, cfg.d_model))}
+    with pytest.raises(ValueError, match="unknown moe_router"):
+        moe_mlp(jnp.zeros((1, 4, cfg.d_model)), lp, cfg)
+
+    # Conflicting dropless + expert_choice is rejected up front.
+    cfg2 = llama_tiny(n_experts=2, moe_dropless=True,
+                      moe_router="expert_choice")
+    params = init_params(jax.random.key(0), cfg2)
+    with pytest.raises(ValueError, match="already dropless"):
+        forward(params, jnp.zeros((2, 8), jnp.int32), cfg2)
