@@ -63,6 +63,12 @@ class LlamaConfig:
     # (P-1)/(v*M+P-1). Requires n_layers % (pp*v) == 0 and M >= pp.
     pipeline_schedule: str = "gpipe"
     pipeline_circular_repeats: int = 2
+    # Store layer weights in the circular schedule's round-robin order
+    # (training/train.py interleaves at init): removes the schedule's
+    # per-step layer-axis all-to-all. Forward then REQUIRES the
+    # circular pipeline to be active — depth-ordered consumers
+    # (inference, pp=1 eval, HF export) must deinterleave_layers first.
+    pipeline_interleave_weights: bool = False
     # Mixture-of-Experts FFN (models/moe.py): 0 experts = dense MLP.
     # Expert weights shard over the 'ep' mesh axis; composes with the
     # pipeline (router aux losses ride the with_aux channel).
@@ -274,6 +280,16 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     use_pp = bool(cfg.pipeline_microbatches) and pp > 1
+    if cfg.pipeline_interleave_weights \
+            and not (use_pp and cfg.pipeline_schedule == "circular"):
+        # Interleaved storage outside the circular pipeline (including
+        # the gpipe schedule) would scan layers in the wrong depth
+        # order and silently corrupt outputs.
+        raise ValueError(
+            "pipeline_interleave_weights requires the CIRCULAR pipeline "
+            "to be active (pp > 1, microbatches, "
+            "pipeline_schedule='circular'); deinterleave_layers the "
+            "stacked params for depth-ordered use")
     if cfg.n_experts and cfg.moe_dropless and mesh is not None \
             and mesh.shape.get("ep", 1) > 1:
         raise ValueError(
@@ -311,7 +327,9 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         from container_engine_accelerators_tpu.parallel.pipeline import (
             pipeline,
         )
-        pp_kw = dict(schedule=cfg.pipeline_schedule, circular_repeats=v)
+        pp_kw = dict(schedule=cfg.pipeline_schedule, circular_repeats=v,
+                     weights_interleaved=cfg.pipeline_interleave_weights
+                     and cfg.pipeline_schedule == "circular")
 
         if cfg.n_experts:
             def stage_fn(local_layers, x_mb):

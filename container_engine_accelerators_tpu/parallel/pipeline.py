@@ -39,6 +39,48 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _storage_perm_indices(l: int, n_stages: int, repeats: int):
+    """Gather indices mapping depth order -> the circular schedule's
+    storage order: storage position (r*v + c)*Lc + i holds depth chunk
+    c*P + r, layer i."""
+    import numpy as np
+    if l % (repeats * n_stages):
+        raise ValueError(f"{l} layers not divisible into "
+                         f"{repeats}x{n_stages} chunks")
+    lc = l // (repeats * n_stages)
+    idx = np.empty(l, dtype=np.int32)
+    for r in range(n_stages):
+        for c in range(repeats):
+            for i in range(lc):
+                idx[(r * repeats + c) * lc + i] = \
+                    (c * n_stages + r) * lc + i
+    return idx
+
+
+def interleave_layers(params, n_stages: int, repeats: int):
+    """Permute depth-stacked [L, ...] layer arrays into the circular
+    schedule's storage order. With this layout a plain P('pp') blocked
+    sharding already gives rank r its v round-robin chunks, so the
+    circular pipeline needs NO per-step layer-axis all-to-all. Use
+    `deinterleave_layers` to get depth order back (checkpoint export,
+    inference, pp=1 evaluation)."""
+    def perm(a):
+        idx = _storage_perm_indices(a.shape[0], n_stages, repeats)
+        return jnp.take(a, jnp.asarray(idx), axis=0)
+    return jax.tree.map(perm, params)
+
+
+def deinterleave_layers(params, n_stages: int, repeats: int):
+    """Inverse of interleave_layers: storage order back to depth order
+    (inverse by construction — the same index table, inverted)."""
+    import numpy as np
+
+    def perm(a):
+        idx = _storage_perm_indices(a.shape[0], n_stages, repeats)
+        return jnp.take(a, jnp.asarray(np.argsort(idx)), axis=0)
+    return jax.tree.map(perm, params)
+
+
 def bubble_fraction(schedule: str, n_microbatches: int, n_stages: int,
                     circular_repeats: int = 1) -> float:
     """Idle fraction of each rank's timeline, from the schedule's tick
@@ -56,7 +98,8 @@ def bubble_fraction(schedule: str, n_microbatches: int, n_stages: int,
 
 def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
              axis: str = "pp", with_aux: bool = False,
-             schedule: str = "gpipe", circular_repeats: int = 1):
+             schedule: str = "gpipe", circular_repeats: int = 1,
+             weights_interleaved: bool = False):
     """Run x through P pipeline stages.
 
     stage_fn(stage_local_params, x_mb) -> x_mb (or (x_mb, aux_scalar)
@@ -76,7 +119,7 @@ def pipeline(stage_fn, params, x, mesh: Mesh, n_microbatches: int,
     if schedule == "circular" and circular_repeats > 1:
         return _pipeline_circular(stage_fn, params, x, mesh,
                                   n_microbatches, circular_repeats, axis,
-                                  with_aux)
+                                  with_aux, weights_interleaved)
     if schedule not in ("gpipe", "circular"):
         raise ValueError(f"unknown schedule {schedule!r}")
     x_mb, compute_dtype = _microbatch_split(x, n_microbatches)
@@ -175,18 +218,21 @@ def _launch(per_shard, params, x_mb, x, mesh, axis, param_spec,
 
 def _pipeline_circular(stage_fn, params, x, mesh: Mesh,
                        n_microbatches: int, repeats: int, axis: str,
-                       with_aux: bool):
+                       with_aux: bool, weights_interleaved: bool = False):
     """Interleaved ('circular') schedule — see the module docstring.
 
     Chunk-to-rank mapping: global depth chunk s (of S = v*P total) runs
-    on rank s mod P. Depth order therefore visits ranks
-    0,1,...,P-1,0,1,... — a reshape of the depth-stacked [L, ...] params
-    to [v, P, Lc, ...] puts each rank's v chunks at [:, r, :], which is
-    exactly the P(None, 'pp') sharding. The params arrive blocked
-    (P('pp') on the depth axis), so the sharding constraint below incurs
-    one all-to-all over pp per step; storing weights interleaved at
-    creation time would remove it, at the cost of leaking the layout
-    into checkpoint/convert — an acknowledged trade-off.
+    on rank s mod P. Two weight layouts are supported:
+
+      weights_interleaved=False  params arrive depth-ordered, blocked
+        P('pp'); a reshape to [v, P, Lc, ...] + sharding constraint to
+        P(None, 'pp') redistributes them — one layer-axis all-to-all
+        per step.
+      weights_interleaved=True   params were stored in schedule order
+        (interleave_layers) at creation: the blocked P('pp') shard of
+        the flat depth axis IS each rank's v chunks — zero resharding.
+        The layout leaks into checkpoints (see deinterleave_layers for
+        depth-ordered consumers).
     """
     n_stages = mesh.shape[axis]
     m, v = n_microbatches, repeats
@@ -197,21 +243,40 @@ def _pipeline_circular(stage_fn, params, x, mesh: Mesh,
             f"must be produced before rank 0 consumes it")
     x_mb, compute_dtype = _microbatch_split(x, m)
 
-    def interleave(a):
-        l = a.shape[0]
-        if l % (v * n_stages):
-            raise ValueError(f"{l} layers not divisible into "
+    for a in jax.tree.leaves(params):
+        if a.shape[0] % (v * n_stages):
+            raise ValueError(f"{a.shape[0]} layers not divisible into "
                              f"{v}x{n_stages} chunks")
-        lc = l // (v * n_stages)
-        a = a.reshape(v, n_stages, lc, *a.shape[1:])
-        return jax.lax.with_sharding_constraint(
-            a, NamedSharding(mesh, P(None, axis)))
 
-    params_il = jax.tree.map(interleave, params)
+    if weights_interleaved:
+        # Params already stored in the schedule's order
+        # (interleave_layers): a plain blocked P('pp') shard of the flat
+        # depth axis hands rank r its v chunks — zero resharding.
+        params_il = params
+        param_spec = P(axis)
+
+        def localize(a):
+            lc = a.shape[0] // v
+            return a.reshape(v, lc, *a.shape[1:])
+    else:
+        # Depth-ordered storage: reshape to [v, P, Lc] and constrain to
+        # P(None, 'pp') — one layer-axis all-to-all per step (the
+        # interleaved layout exists to avoid exactly this).
+        def interleave(a):
+            lc = a.shape[0] // (v * n_stages)
+            a = a.reshape(v, n_stages, lc, *a.shape[1:])
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, axis)))
+
+        params_il = jax.tree.map(interleave, params)
+        param_spec = P(None, axis)
+
+        def localize(a):
+            return a[:, 0]
 
     def per_shard(local_params, x_all):
-        # local_params leaves: [v, 1, Lc, ...] — this rank's v chunks.
-        local_params = jax.tree.map(lambda a: a[:, 0], local_params)
+        # local leaves -> [v, Lc, ...]: this rank's v chunks.
+        local_params = jax.tree.map(localize, local_params)
         x_all = x_all.astype(compute_dtype)
         r = jax.lax.axis_index(axis)
         ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -278,4 +343,4 @@ def _pipeline_circular(stage_fn, params, x, mesh: Mesh,
                                     with_aux)
 
     return _launch(per_shard, params_il, x_mb, x, mesh, axis,
-                   P(None, axis), with_aux)
+                   param_spec, with_aux)

@@ -51,8 +51,23 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     pipeline = bool(cfg.pipeline_microbatches) and mesh.shape.get("pp", 1) > 1
     pshard = shd.param_shardings(mesh, pipeline=pipeline,
                                  moe=bool(cfg.n_experts))
-    init = jax.jit(functools.partial(llama.init_params, cfg=cfg),
-                   out_shardings=pshard)
+    def init_fn(key):
+        params = llama.init_params(key, cfg=cfg)
+        if pipeline and cfg.pipeline_schedule == "circular" \
+                and cfg.pipeline_interleave_weights:
+            # Store layers in the circular schedule's round-robin order
+            # so the blocked P('pp') shard needs no per-step all-to-all
+            # (parallel/pipeline.py interleave_layers; deinterleave
+            # before exporting depth-ordered checkpoints).
+            from container_engine_accelerators_tpu.parallel.pipeline import (
+                interleave_layers,
+            )
+            params["layers"] = interleave_layers(
+                params["layers"], mesh.shape["pp"],
+                cfg.pipeline_circular_repeats)
+        return params
+
+    init = jax.jit(init_fn, out_shardings=pshard)
     params = init(key)
     opt_state = jax.jit(optimizer.init)(params)
 
