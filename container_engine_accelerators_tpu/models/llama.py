@@ -275,7 +275,11 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         constrain = lambda x, kind: x
     b, s = tokens.shape
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # Reshard the bf16 table to the gather-safe spec (vocab over tp
+    # only) before lookup: indices are batch/sequence-sharded, so any
+    # shared mesh axis between table and indices would force an SPMD
+    # full-rematerialization fallback (see parallel/sharding.py).
+    x = constrain(params["embed"].astype(cfg.dtype), "embed_table")[tokens]
     x = constrain(x, "resid")
 
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1

@@ -38,10 +38,18 @@ def llama_param_specs(pipeline: bool = False, moe: bool = False) -> dict:
             "w_down": P(layer_axis, "tp", "fsdp"),
         }
     return {
-        # Vocab dim replicated: a vocab-sharded table turns the token gather
-        # into an SPMD full-remat (XLA warns "involuntary full
-        # rematerialization"); d_model on fsdp keeps memory bounded.
-        "embed": P(None, "fsdp"),
+        # Storage: vocab over tp, d_model over fsdp — master weights and
+        # optimizer state stay ZeRO-sharded. The token gather must NOT see
+        # fsdp/sp on the table: token indices are batch-sharded over
+        # (dp, fsdp) and sequence-sharded over sp, and a mesh axis
+        # appearing on both gather operand and indices forces the SPMD
+        # "involuntary full rematerialization" fallback. forward()
+        # therefore reshards the bf16 compute copy to the gather-safe
+        # 'embed_table' spec (vocab over tp only): one all-gather over
+        # fsdp of the bf16 table per step (the ZeRO-3 treatment), then
+        # the Megatron-style vocab-partitioned lookup (masked local
+        # gather + psum over tp) which GSPMD lowers natively.
+        "embed": P("tp", "fsdp"),
         "layers": {
             "attn_norm": P(layer_axis, None),
             "wq": P(layer_axis, "fsdp", "tp"),
@@ -71,6 +79,14 @@ def batch_spec(sequence_parallel: bool = False) -> P:
 # Activation-sharding hints, keyed by the `kind` strings models/llama.py
 # passes to its `constrain` hook.
 _ACTIVATION_SPECS = {
+    # Gather-safe compute copy of the embedding table: tp is the only mesh
+    # axis that never shards token indices (dp/fsdp shard batch, sp shards
+    # sequence), so a vocab-over-tp-only table partitions the lookup the
+    # Megatron way — masked local gather + psum over tp — with no operand/
+    # index axis conflict. (d_model over tp also avoids the conflict but
+    # trips an XLA CPU partitioner miscompile when the gather sits inside
+    # a scan body, e.g. under gradient accumulation.)
+    "embed_table": lambda sp: P("tp", None),
     "resid": lambda sp: P(BATCH_AXES, "sp" if sp else None, None),
     "qkv": lambda sp: P(BATCH_AXES, "sp" if sp else None, "tp", None),
     "ff": lambda sp: P(BATCH_AXES, "sp" if sp else None, "tp"),

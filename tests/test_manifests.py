@@ -110,7 +110,8 @@ def test_llama_demo_memory_budget():
     script = _inline_python(doc)[0]
 
     # The demo must pin an explicit fsdp mesh (auto-factoring 4 devices
-    # picks tp=4 and replicates the embed table's optimizer moments).
+    # picks tp=4, which replicates every layer weight's d_model/ZeRO dim
+    # — fsdp is what keeps per-chip optimizer state bounded).
     assert "MeshAxes(fsdp=" in script
 
     preset = next(name for name in ("llama3_405b", "llama3_70b",
