@@ -13,6 +13,18 @@ native:
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+# Iteration loop: the infra suites (no XLA compiles) finish in well under
+# a minute, vs >10 min for the full suite on the CPU backend where
+# compile time dominates. Full `make test` remains the CI gate.
+QUICK_TESTS = tests/test_deviceplugin.py tests/test_healthcheck.py \
+    tests/test_metrics.py tests/test_fabric_metrics.py \
+    tests/test_scheduler.py tests/test_partition_tpu.py \
+    tests/test_partitioned_stack.py tests/test_manifests.py \
+    tests/test_nri.py tests/test_native.py tests/test_dataset.py
+
+test-quick:
+	$(PYTHON) -m pytest $(QUICK_TESTS) -q
+
 # Root-gated NRI device-node tests (mknod), split out like the
 # reference's `make device-injector-test`.
 device-injector-test:
@@ -34,4 +46,5 @@ dryrun:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test device-injector-test presubmit bench dryrun clean
+.PHONY: all native test test-quick device-injector-test presubmit bench \
+    dryrun clean

@@ -106,11 +106,17 @@ def main():
     print(f"step times (s): min={step_times[0]:.4f} "
           f"median={median_dt:.4f} max={step_times[-1]:.4f}",
           file=sys.stderr)
+    # vs_baseline keys on the WALL-CLOCK estimator: the 0.40-MFU north
+    # star predates the median-step metric, and wall clock is the
+    # conservative one (median systematically reads a bit higher), so
+    # cross-round comparisons stay apples-to-apples. The median stays as
+    # a robustness diagnostic in `value`/`unit`.
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
         "unit": f"tokens/s/chip (MFU={mfu:.3f})",
-        "vs_baseline": round(mfu / 0.40, 3),
+        "vs_baseline": round(wall_mfu / 0.40, 3),
+        "vs_baseline_estimator": "wallclock",
         "estimator": "median-step",
         "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
         "wallclock_mfu": round(wall_mfu, 3),

@@ -8,6 +8,19 @@ Virtual IDs look like 'accel0/vtpu2'. Request rules match the reference:
 with sharing on, a container gets exactly one virtual device (asking for
 more chips means asking for more *physical* parallelism, which sharing
 cannot provide).
+
+Per-client enforcement — a deliberate non-feature. The reference's MPS
+mode caps each client's SM fraction and pinned memory via
+CUDA_MPS_ACTIVE_THREAD_PERCENTAGE / PINNED_DEVICE_MEM_LIMIT and
+health-probes the MPS control daemon (reference
+pkg/gpu/nvidia/manager.go:307-348). TPU time-sharing has no analog to
+enforce: there is no per-process hardware partitioner below the chip —
+libtpu/XLA owns the whole chip per process, and concurrent clients are
+time-sliced whole-program by the runtime. The closest knobs are
+cooperative, not enforced: TPU_MEM_FRACTION-style HBM env caps that a
+container can override, and subslice partitioning (subslice.py) when
+hard isolation is actually required. Operators who need enforced
+fractions should partition, not share.
 """
 
 from __future__ import annotations

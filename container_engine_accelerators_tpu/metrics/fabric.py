@@ -76,9 +76,17 @@ class FabricMetricServer(ExporterBase):
             "tpu_ici_error_count",
             "ICI error counter per chip (sysfs, when exposed)",
             ["tpu_chip"], registry=self.registry)
-        self.probe_rtt = Gauge(
-            "tpu_dcn_probe_rtt_seconds",
-            "TCP RTT to the dcn-prober echo port (datapath liveness)",
+        # The RTT gauge is created lazily on the first SUCCESSFUL probe:
+        # a registered-but-never-set prometheus_client Gauge exports 0.0,
+        # which would read as a fabricated perfect RTT while the target
+        # is down. Until then the metric is simply absent.
+        self.probe_rtt: Gauge | None = None
+        # Reachability is a separate 0/1 gauge, Prometheus-style: a
+        # negative RTT sentinel would skew avg/percentile aggregations,
+        # so on failure the RTT gauge goes stale (or absent) instead.
+        self.probe_up = Gauge(
+            "tpu_dcn_probe_up",
+            "1 if the last dcn-prober TCP probe succeeded, else 0",
             [], registry=self.registry)
         self.scrapes = Counter(
             "tpu_fabric_poll_total", "Fabric poll iterations",
@@ -130,9 +138,16 @@ class FabricMetricServer(ExporterBase):
         t0 = time.monotonic()
         try:
             with socket.create_connection(self.probe_addr, timeout=2.0):
-                self.probe_rtt.set(time.monotonic() - t0)
+                rtt = time.monotonic() - t0
+            if self.probe_rtt is None:
+                self.probe_rtt = Gauge(
+                    "tpu_dcn_probe_rtt_seconds",
+                    "TCP RTT to the dcn-prober echo port (last "
+                    "successful probe)", [], registry=self.registry)
+            self.probe_rtt.set(rtt)
+            self.probe_up.set(1)
         except OSError:
-            self.probe_rtt.set(-1.0)  # unreachable sentinel
+            self.probe_up.set(0)   # RTT gauge left stale, not sentineled
 
 
 def main(argv=None) -> int:

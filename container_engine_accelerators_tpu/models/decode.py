@@ -177,8 +177,12 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
     if max_len > 128 and _kernel_eligible(cfg):
         # Round the cache up to the pallas decode kernel's 128-lane
         # tiling; the unused slots cost HBM only — the kernel skips
-        # blocks past the live length. (On the XLA fallback path padding
-        # would cost real compute, hence the eligibility gate.)
+        # blocks past the live length. Padding always wins here even
+        # when a long prefill's per-shape supported() check rejects the
+        # kernel for that one call (T*G scratch over the VMEM bound):
+        # the XLA-fallback prefill then overpays on at most 127 padded
+        # slots ONCE, whereas an unpadded max_len (% 128 != 0) would
+        # disqualify the kernel for every subsequent decode step.
         max_len = -(-max_len // 128) * 128
     cache = init_cache(cfg, b, max_len)
 

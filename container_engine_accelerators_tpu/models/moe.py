@@ -210,6 +210,19 @@ def moe_mlp(h: jnp.ndarray, lp: dict, cfg, constrain=None):
     router_logits = _router_logits(h, lp)
     router = getattr(cfg, "moe_router", "token_choice")
     if router == "expert_choice":
+        if s > 1:
+            # Expert-choice top-C runs over the whole sequence axis: an
+            # expert's picks for position t depend on positions > t, so a
+            # causal LM trained this way leaks future information and
+            # skews against incremental (s == 1) decoding. Surfaced at
+            # trace time — the module docstring alone proved too quiet.
+            import warnings
+            warnings.warn(
+                "moe_router='expert_choice' routes non-causally over the "
+                "sequence: training a causal LM with it leaks future "
+                "positions into the router and creates train/decode skew. "
+                "Use token_choice (optionally moe_dropless) for causal "
+                "training.", stacklevel=2)
         dispatch, combine, metrics = route_expert_choice(router_logits,
                                                          cap)
     elif router == "token_choice":

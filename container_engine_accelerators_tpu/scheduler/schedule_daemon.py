@@ -340,12 +340,17 @@ def run_once(k8s) -> int:
     groups = defaultdict(list)
     for pod in gated:
         groups[job_key(pod)].append(pod)
+    ready_names = {n["metadata"]["name"] for n in ready_nodes}
     for key, pods in sorted(groups.items()):
         # Gang members already Running (survivors of a partial failure)
-        # anchor the placement so recreated members land near them.
+        # anchor the placement so recreated members land near them. Only
+        # pods on currently-Ready nodes anchor: a pod still reporting
+        # Running on a NotReady/lost node is about to be repaired itself,
+        # and its topology would pull the gang toward a dead node.
         anchors = [node_topo[p["spec"]["nodeName"]]
                    for p in assigned
                    if job_key(p) == key
+                   and p["spec"]["nodeName"] in ready_names
                    and p["spec"]["nodeName"] in node_topo]
         assignment = assign_pods(pods, ready_nodes, dict(free),
                                  anchors=anchors)

@@ -99,15 +99,20 @@ def test_probe_rtt(tmp_path):
                      if l.startswith("tpu_dcn_probe_rtt_seconds")
                      ).split()[-1])
     assert 0.0 <= rtt < 1.0
+    assert "tpu_dcn_probe_up 1.0" in text
     t.join(timeout=5)  # accept completed before the listener goes away
     listener.close()
 
-    # Unreachable target -> -1 sentinel.
+    # Unreachable target -> up gauge 0 and NO RTT metric at all: neither
+    # a negative sentinel nor prometheus_client's fabricated 0.0 default
+    # may appear (both would skew avg/percentile aggregations).
     srv2 = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
                               sysfs_accel=str(tmp_path / "accel"),
                               probe_addr=("127.0.0.1", 1))
     srv2.poll_once(now=1.0)
-    assert "tpu_dcn_probe_rtt_seconds -1.0" in scrape(srv2)
+    text2 = scrape(srv2)
+    assert "tpu_dcn_probe_up 0.0" in text2
+    assert "tpu_dcn_probe_rtt_seconds" not in text2
 
 
 def test_http_server_serves_metrics(tmp_path):
