@@ -80,10 +80,16 @@ class LlamaConfig:
     # ep-shardable; non-causal routing, see models/moe.py caveat).
     moe_router: str = "token_choice"
     # Dropless grouped-matmul MoE (models/moe.py moe_mlp_dropless):
-    # every routed token is computed — no capacity, dropped_fraction 0.
-    # Requires mesh ep == 1 (the ragged group axis cannot be GSPMD-
-    # partitioned); the capacity path remains the ep-sharded form.
+    # every routed token is computed — no capacity-factor dropping.
+    # With mesh ep > 1 the dispatch runs as a shard_map all-to-all to
+    # the expert-owner ranks (models/moe.py _moe_dropless_ep); that
+    # path cannot nest inside the pipeline (pp > 1 + ep > 1 rejected).
     moe_dropless: bool = False
+    # Per-(src, dst)-rank row-bucket slack for the ep-dropless dispatch:
+    # buckets hold factor/ep of a rank's routed rows. factor >= ep can
+    # never drop; smaller factors trade buffer memory/compute for a
+    # dropped_fraction > 0 only under extreme router imbalance.
+    moe_ep_buffer_factor: float = 2.0
     moe_aux_weight: float = 0.01
     moe_z_weight: float = 0.001
 
@@ -235,7 +241,7 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
     return x + constrain(attn @ lp["wo"].astype(dt), "resid")
 
 
-def _mlp(x, lp, cfg: LlamaConfig, constrain):
+def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None):
     dt = cfg.dtype
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
@@ -244,8 +250,11 @@ def _mlp(x, lp, cfg: LlamaConfig, constrain):
             moe_mlp_dropless,
         )
 
-        mlp_fn = moe_mlp_dropless if cfg.moe_dropless else moe_mlp
-        out, metrics = mlp_fn(h, lp, cfg, constrain)
+        if cfg.moe_dropless:
+            out, metrics = moe_mlp_dropless(h, lp, cfg, constrain,
+                                            mesh=mesh)
+        else:
+            out, metrics = moe_mlp(h, lp, cfg, constrain)
         aux = (cfg.moe_aux_weight * metrics.aux_loss
                + cfg.moe_z_weight * metrics.router_z_loss)
         return x + constrain(out, "resid"), aux
@@ -294,12 +303,15 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             "to be active (pp > 1, microbatches, "
             "pipeline_schedule='circular'); deinterleave_layers the "
             "stacked params for depth-ordered use")
-    if cfg.n_experts and cfg.moe_dropless and mesh is not None \
-            and mesh.shape.get("ep", 1) > 1:
+    if cfg.n_experts and cfg.moe_dropless and use_pp \
+            and mesh is not None and mesh.shape.get("ep", 1) > 1:
+        # The ep-dropless dispatch is its own shard_map; nesting it
+        # inside the pipeline's 'pp'-manual region would stack partial-
+        # manual regions, which the partitioner does not support.
         raise ValueError(
-            "moe_dropless requires ep == 1 (the ragged group axis "
-            "cannot be GSPMD-partitioned); use moe_router="
-            "'expert_choice' for dropless expert-parallel meshes")
+            "moe_dropless with ep > 1 cannot run inside the pipeline "
+            "(nested shard_map); use pp=1, the capacity path, or "
+            "moe_router='expert_choice'")
     if cfg.n_experts and cfg.moe_dropless \
             and cfg.moe_router != "token_choice":
         raise ValueError(
@@ -313,7 +325,11 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     def layer_body(x, lp):
         x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
-        x, aux = _mlp(x, lp, cfg, layer_constrain)
+        # mesh reaches _mlp only outside the pipeline: the ep-dropless
+        # path opens its own shard_map, which must not nest inside the
+        # pipeline's 'pp'-manual region.
+        x, aux = _mlp(x, lp, cfg, layer_constrain,
+                      mesh=None if use_pp else mesh)
         return x, aux
 
     if cfg.remat_policy != "none":

@@ -20,10 +20,23 @@ Dropless variant (`moe_mlp_dropless`, cfg.moe_dropless): tokens are
 sorted by their routed expert and the three FFN matmuls run as
 `jax.lax.ragged_dot` grouped contractions over the expert-contiguous
 rows — the megablocks formulation in the form XLA:TPU supports natively.
-No capacity, no overflow, dropped_fraction is identically 0. Scope: the
-ragged group axis cannot be partitioned by GSPMD, so this path targets
-meshes with ep == 1 (fsdp/tp/sp/pp still apply); the capacity/einsum
-path remains the ep-sharded formulation.
+No capacity, no overflow, dropped_fraction is identically 0 (with
+ep == 1; see below).
+
+Expert-parallel dropless (`_moe_dropless_ep`, taken automatically when
+the mesh has ep > 1): the ragged group axis cannot be partitioned by
+GSPMD, so the dispatch is written manually in `shard_map` over 'ep'
+(other axes stay automatic, the parallel/pipeline.py pattern). Each ep
+rank routes its 1/ep slice of the tokens, sorts rows by expert, and
+exchanges them with the owning ranks via one static `jax.lax.all_to_all`
+each way around the local `ragged_dot` stack. Static shapes force a
+per-(src, dst)-rank bucket bound: `moe_ep_buffer_factor` (default 2.0)
+sizes buckets at factor/ep of a rank's rows — rank-level aggregation
+over E/ep experts makes overflow far rarer than per-expert capacity,
+any overflow is counted in dropped_fraction, and factor >= ep is the
+provably-never-drops bound (at ep=2 the 2.0 default IS that bound).
+(`jax.lax.ragged_all_to_all` would remove the bound entirely; it is
+unimplemented on XLA:CPU, where this framework's mesh tests run.)
 
 Expert-choice routing (cfg.moe_router="expert_choice"): experts pick
 their top-C tokens instead of tokens picking experts (Zhou et al.) —
@@ -158,14 +171,151 @@ def route_expert_choice(router_logits: jnp.ndarray, cap: int):
                                          unrouted)
 
 
-def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None):
+def _ragged_ffn(rows, lp, group_sizes, dt, pad_group: bool = False):
+    """The silu-gated FFN as three ragged_dot grouped matmuls over
+    expert-sorted rows. With `pad_group`, a zero-weighted trailing group
+    absorbs buffer-padding rows (group_sizes then has E_local+1 entries,
+    the last counting pads)."""
+    w_gate = lp["w_gate"].astype(dt)
+    w_up = lp["w_up"].astype(dt)
+    w_down = lp["w_down"].astype(dt)
+    if pad_group:
+        zg = jnp.zeros_like(w_gate[:1])
+        zd = jnp.zeros_like(w_down[:1])
+        w_gate = jnp.concatenate([w_gate, zg])
+        w_up = jnp.concatenate([w_up, zg])
+        w_down = jnp.concatenate([w_down, zd])
+    gate_p = jax.lax.ragged_dot(rows, w_gate, group_sizes)
+    up_p = jax.lax.ragged_dot(rows, w_up, group_sizes)
+    return jax.lax.ragged_dot(jax.nn.silu(gate_p) * up_p, w_down,
+                              group_sizes)
+
+
+def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
+    """Expert-parallel dropless path — see the module docstring.
+
+    shard_map region: 'ep' manual, every other axis automatic. Token
+    rows move to their expert's owner rank and back with one static
+    all_to_all each way; the FFN itself is the same ragged_dot stack as
+    the single-rank path, over a zero-expert-padded trailing group."""
+    b, s, d = h.shape
+    e, k = cfg.n_experts, cfg.moe_top_k
+    if e % ep:
+        raise ValueError(f"n_experts {e} not divisible by ep={ep}")
+    n_tok = b * s
+    if n_tok % ep:
+        raise ValueError(f"B*S {n_tok} not divisible by ep={ep}")
+    e_local, n_loc = e // ep, n_tok // ep
+    n_rows = n_loc * k                      # rows a rank originates
+    factor = getattr(cfg, "moe_ep_buffer_factor", 2.0)
+    c_pair = min(n_rows, max(k, int(-(-n_rows * factor // ep))))
+    dt = h.dtype
+    if jax.default_backend() == "cpu" and dt == jnp.bfloat16:
+        # The XLA:CPU partitioner CHECK-crashes ("invalid binary
+        # instruction opcode copy") on bf16 collectives at partial-
+        # manual shard_map boundaries — same quirk pipeline.py works
+        # around. Run the whole dispatch in f32 there; TPU stays bf16.
+        out, metrics = _moe_dropless_ep(h.astype(jnp.float32), lp, cfg,
+                                        mesh, ep)
+        return out.astype(dt), metrics
+
+    def per_shard(h_full, w_router, w_gate, w_up, w_down):
+        lp_loc = {"w_gate": w_gate, "w_up": w_up, "w_down": w_down}
+        r = jax.lax.axis_index("ep")
+        x = h_full.reshape(n_tok, d)
+        # This rank routes its own 1/ep slice of the (ep-replicated)
+        # tokens — ep acts as an extra data split for the dispatch.
+        x_loc = jax.lax.dynamic_slice_in_dim(x, r * n_loc, n_loc, 0)
+
+        logits = jnp.einsum("td,de->te", x_loc.astype(jnp.float32),
+                            w_router.astype(jnp.float32))
+        probs, gate_vals, expert_idx = _gating(logits[None], k)
+        expert_flat = expert_idx.reshape(-1)             # [n_rows]
+        gates_flat = gate_vals.reshape(-1)
+        order = jnp.argsort(expert_flat, stable=True)
+        sorted_experts = expert_flat[order]
+        token_of_row = order // k
+        rows = x_loc[token_of_row].astype(dt)            # [n_rows, D]
+
+        # Destination bucketing: experts are blocked over ranks, and
+        # rows are expert-sorted, so each destination's rows are a
+        # contiguous span. mode='drop' discards bucket overflow (counted
+        # below; impossible when c_pair == n_rows).
+        dest = sorted_experts // e_local                 # [n_rows]
+        dcount = jnp.bincount(dest, length=ep)
+        dstart = jnp.cumsum(dcount) - dcount
+        within = jnp.arange(n_rows) - dstart[dest]
+        send_rows = jnp.zeros((ep, c_pair, d), dt).at[dest, within].set(
+            rows, mode="drop")
+        # Pad sentinel e_local sorts after every real local expert id.
+        send_ids = jnp.full((ep, c_pair), e_local, jnp.int32).at[
+            dest, within].set(sorted_experts % e_local, mode="drop")
+        n_dropped = jnp.sum(jnp.where(within >= c_pair, 1.0, 0.0))
+
+        recv_rows = jax.lax.all_to_all(send_rows, "ep", 0, 0, tiled=True)
+        recv_ids = jax.lax.all_to_all(send_ids, "ep", 0, 0, tiled=True)
+
+        flat_ids = recv_ids.reshape(-1)                  # [ep*c_pair]
+        order2 = jnp.argsort(flat_ids, stable=True)
+        rows2 = recv_rows.reshape(-1, d)[order2]
+        gs = jnp.bincount(flat_ids, length=e_local + 1).astype(jnp.int32)
+        down = _ragged_ffn(rows2, lp_loc, gs, dt, pad_group=True)
+
+        # Invert the expert sort, return rows to their source rank, and
+        # combine at the source with the gate weights.
+        unsorted = jnp.zeros_like(down).at[order2].set(down)
+        ret = jax.lax.all_to_all(unsorted.reshape(ep, c_pair, d),
+                                 "ep", 0, 0, tiled=True)
+        res = ret[dest, jnp.clip(within, 0, c_pair - 1)]
+        res = jnp.where((within < c_pair)[:, None], res, 0.0)
+        weighted = res * gates_flat[order][:, None].astype(dt)
+        out_loc = jnp.zeros((n_loc, d), dt).at[token_of_row].add(weighted)
+
+        # Reassemble the full token axis: rank r holds span r, so a
+        # tiled all-gather reproduces the [n_tok, d] order directly —
+        # half the collective volume of a psum over a zero-padded
+        # full-size buffer, and no temporary.
+        out = jax.lax.all_gather(out_loc, "ep", axis=0, tiled=True)
+
+        # Aux losses must match the global (ep=1) formula exactly: the
+        # load-balance term is a product of token-MEANS, so psum the
+        # means (equal-sized slices) before multiplying — averaging
+        # per-rank aux values would differ.
+        onehot0 = jax.nn.one_hot(expert_idx[..., 0], e, dtype=jnp.float32)
+        frac_tokens = jax.lax.psum(
+            jnp.mean(onehot0, axis=(0, 1)), "ep") / ep
+        mean_probs = jax.lax.psum(
+            jnp.mean(probs, axis=(0, 1)), "ep") / ep
+        aux = e * jnp.sum(frac_tokens * mean_probs)
+        z = jax.lax.psum(
+            jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), "ep") / ep
+        dropped = jax.lax.psum(n_dropped, "ep") / (n_tok * k)
+        return out.reshape(b, s, d), aux, z, dropped
+
+    from jax.sharding import PartitionSpec as P
+    out, aux, z, dropped = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P("ep"), P("ep"), P("ep")),
+        out_specs=(P(), P(), P(), P()),
+        axis_names={"ep"},
+        check_vma=False,
+    )(h, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"])
+    return out, MoeMetrics(aux, z, dropped)
+
+
+def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None,
+                     mesh=None):
     """Dropless token-choice MoE via grouped matmul. Same weights and
     router as moe_mlp; every routed (token, expert) pair is computed.
 
     [B*S*k] rows sorted by expert -> ragged_dot against [E, D, F]
     weights (expert-contiguous groups) -> combine by scatter-add with
     the gate weights. All shapes static; only group_sizes is data-
-    dependent, which ragged_dot is built for."""
+    dependent, which ragged_dot is built for. Meshes with ep > 1 take
+    the shard_map all-to-all dispatch path (_moe_dropless_ep)."""
+    ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+    if ep > 1:
+        return _moe_dropless_ep(h, lp, cfg, mesh, ep)
     b, s, d = h.shape
     e, k = cfg.n_experts, cfg.moe_top_k
     dt = h.dtype
@@ -183,11 +333,7 @@ def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None):
     # intermediate would cost real HBM bandwidth every step.
     group_sizes = jnp.bincount(expert_flat, length=e).astype(jnp.int32)
 
-    gate_p = jax.lax.ragged_dot(rows, lp["w_gate"].astype(dt),
-                                group_sizes)
-    up_p = jax.lax.ragged_dot(rows, lp["w_up"].astype(dt), group_sizes)
-    down = jax.lax.ragged_dot(jax.nn.silu(gate_p) * up_p,
-                              lp["w_down"].astype(dt), group_sizes)
+    down = _ragged_ffn(rows, lp, group_sizes, dt)
 
     weighted = down * gates_flat[order][:, None].astype(dt)
     out = jnp.zeros((n_tok, d), dt).at[token_of_row].add(weighted)

@@ -251,12 +251,81 @@ def test_dropless_train_step(mesh8):
     assert losses[-1] < losses[0]
 
 
-def test_dropless_rejects_expert_parallel_mesh(mesh_ep):
-    cfg = llama_tiny(n_experts=4, moe_dropless=True)
+def test_dropless_ep_matches_single_rank():
+    # The shard_map all-to-all dispatch (ep=2) must reproduce the global
+    # ragged_dot path (ep=1) exactly, up to float reassociation from the
+    # differing scatter-add order. moe_ep_buffer_factor=2.0 at ep=2 is
+    # the guaranteed-never-drops bound, so aux metrics match too.
+    from container_engine_accelerators_tpu.parallel import sharding as shd
+    mesh = make_mesh(MeshAxes(fsdp=2, ep=2, tp=2), devices=jax.devices())
+    cfg = llama_tiny(n_experts=4, moe_dropless=True, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                cfg.vocab_size)
+
+    ref, aux_ref = forward(params, tokens, cfg, return_aux=True)
+    constrain = shd.make_constrain(mesh)
+    out, aux = jax.jit(
+        lambda p, t: forward(p, t, cfg, constrain=constrain, mesh=mesh,
+                             return_aux=True))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_dropless_ep_train_step(mesh_ep):
+    cfg = llama_tiny(vocab_size=64, n_experts=4, moe_dropless=True)
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2,
+                         decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh_ep, opt)
+    step_fn = make_train_step(cfg, mesh_ep, opt)
+    losses = []
+    for batch in synthetic_batches(cfg.vocab_size, batch_size=8,
+                                   seq_len=32, num_batches=8, seed=0):
+        batch = shard_batch(batch, mesh_ep)
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_dropless_ep_bucket_overflow_is_counted():
+    # A tiny buffer factor with an adversarial router (all tokens to one
+    # expert) must overflow the per-rank-pair buckets and report a
+    # nonzero dropped fraction rather than corrupting rows.
+    from container_engine_accelerators_tpu.models.moe import (
+        moe_mlp_dropless,
+    )
+    mesh = make_mesh(MeshAxes(fsdp=2, ep=2, tp=2), devices=jax.devices())
+    cfg = llama_tiny(n_experts=4, moe_top_k=1, moe_dropless=True,
+                     moe_ep_buffer_factor=0.25, dtype=jnp.float32)
+    d = cfg.d_model
+    lp = {
+        # Router biased hard toward expert 0 -> every row targets rank 0.
+        "w_router": jnp.zeros((d, 4)).at[:, 0].set(1.0),
+        "w_gate": 0.01 * jnp.ones((4, d, cfg.d_ff)),
+        "w_up": 0.01 * jnp.ones((4, d, cfg.d_ff)),
+        "w_down": 0.01 * jnp.ones((4, cfg.d_ff, d)),
+    }
+    h = jnp.ones((2, 16, d))
+
+    def run(h):
+        out, m = moe_mlp_dropless(h, lp, cfg, mesh=mesh)
+        return out, m.dropped_fraction   # MoeMetrics is not a pytree
+
+    out, dropped = jax.jit(run)(h)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    assert float(dropped) > 0.0
+
+
+def test_dropless_ep_rejects_pipeline_mesh():
+    mesh = make_mesh(MeshAxes(pp=2, ep=2, tp=2), devices=jax.devices())
+    cfg = llama_tiny(n_experts=4, moe_dropless=True,
+                     pipeline_microbatches=2)
     params = init_params(jax.random.key(0), cfg)
     tokens = jnp.zeros((2, 8), jnp.int32)
-    with pytest.raises(ValueError, match="ep == 1"):
-        forward(params, tokens, cfg, mesh=mesh_ep)
+    with pytest.raises(ValueError, match="nested shard_map"):
+        forward(params, tokens, cfg, mesh=mesh)
 
 
 # ---------- expert-choice routing ----------
