@@ -107,12 +107,25 @@ def load_model(checkpoint: str | None = None, seed: int = 0):
     return init_params(jax.random.key(seed), cfg), cfg
 
 
-def params_to_hf(params: dict, cfg: LlamaConfig):
+def params_to_hf(params: dict, cfg: LlamaConfig, layout: dict | None = None):
     """Inverse mapping: our pytree -> a transformers LlamaForCausalLM
-    (so checkpoints trained here export to the HF ecosystem)."""
+    (so checkpoints trained here export to the HF ecosystem).
+
+    `layout` is the layer-storage tag the params were trained under
+    (training/train.py state_layer_layout). HF is depth-ordered, so
+    params stored in the circular pipeline's interleaved order are
+    deinterleaved automatically here — no manual deinterleave_layers
+    step, no silently-scrambled export."""
     import torch
     from transformers import LlamaConfig as HFConfig
     from transformers import LlamaForCausalLM
+
+    from container_engine_accelerators_tpu.parallel.pipeline import (
+        relayout_layers,
+    )
+
+    params = dict(params)
+    params["layers"] = relayout_layers(params["layers"], layout, None)
 
     hf_cfg = HFConfig(
         vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
@@ -150,5 +163,6 @@ def params_to_hf(params: dict, cfg: LlamaConfig):
     return model
 
 
-def save_hf_checkpoint(params: dict, cfg: LlamaConfig, path: str) -> None:
-    params_to_hf(params, cfg).save_pretrained(path)
+def save_hf_checkpoint(params: dict, cfg: LlamaConfig, path: str,
+                       layout: dict | None = None) -> None:
+    params_to_hf(params, cfg, layout=layout).save_pretrained(path)

@@ -81,6 +81,45 @@ def deinterleave_layers(params, n_stages: int, repeats: int):
     return jax.tree.map(perm, params)
 
 
+def normalize_layout(layout: dict | None) -> tuple[int, int] | None:
+    """Canonical form of a layer-storage layout tag: (pp, v) when the
+    circular schedule's interleaved order is in effect, None for plain
+    depth order. Accepts the {'interleaved', 'pp', 'v'} dicts written
+    into checkpoint metadata (missing/None means depth order)."""
+    if not layout or not layout.get("interleaved"):
+        return None
+    return (int(layout["pp"]), int(layout["v"]))
+
+
+def relayout_layers(layers, saved: dict | None, target: dict | None):
+    """Re-permute stacked [L, ...] layer arrays from the storage order
+    tagged `saved` to the order `target` expects — the automatic
+    re-permute that lets a checkpoint written under one pp/v circular
+    config restore into any other (or into depth order) instead of
+    erroring. Shardings of the inputs are preserved. No-op (identity
+    return) when the two layouts already agree."""
+    import numpy as np
+    src, dst = normalize_layout(saved), normalize_layout(target)
+    if src == dst:
+        return layers
+    l = jax.tree.leaves(layers)[0].shape[0]
+    combined = np.arange(l, dtype=np.int32)
+    if dst is not None:
+        combined = _storage_perm_indices(l, dst[0], dst[1])  # depth->dst
+    if src is not None:
+        to_depth = np.argsort(_storage_perm_indices(l, src[0], src[1]))
+        # take(take(a, p1), p2) == take(a, p1[p2])
+        combined = to_depth[combined]
+
+    def perm(a):
+        out = jnp.take(a, jnp.asarray(combined), axis=0)
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None and hasattr(sharding, "mesh"):
+            out = jax.device_put(out, sharding)
+        return out
+    return jax.tree.map(perm, layers)
+
+
 def bubble_fraction(schedule: str, n_microbatches: int, n_stages: int,
                     circular_repeats: int = 1) -> float:
     """Idle fraction of each rank's timeline, from the schedule's tick

@@ -5,6 +5,7 @@ from container_engine_accelerators_tpu.training.train import (
     create_train_state,
     make_optimizer,
     make_train_step,
+    state_layer_layout,
 )
 
 __all__ = [
@@ -12,4 +13,5 @@ __all__ = [
     "create_train_state",
     "make_optimizer",
     "make_train_step",
+    "state_layer_layout",
 ]

@@ -5,6 +5,16 @@ checkpoints to GCS (reference demo/tpu-training/resnet-tpu.yaml:55-68).
 
 Orbax handles sharded arrays natively: each host writes its own shards
 (OCDBT), restore re-shards onto the current mesh from abstract targets.
+
+Layer-storage layout tag: checkpoints written under the circular
+pipeline's interleaved weight order (cfg.pipeline_interleave_weights)
+carry a {'interleaved', 'pp', 'v'} metadata item. On restore into a
+DIFFERENT layout — another pp/v circular config, or plain depth order —
+the stacked layer arrays (params AND the optimizer moments mirroring
+them) are automatically re-permuted via parallel/pipeline.py
+relayout_layers, the idempotent-reconfig discipline of the reference's
+partitioner (reference partition_gpu/partition_gpu.go:213-220) applied
+to weight layouts: converge to the requested state, don't error.
 """
 
 from __future__ import annotations
@@ -13,9 +23,41 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from container_engine_accelerators_tpu.parallel.pipeline import (
+    normalize_layout,
+    relayout_layers,
+)
 from container_engine_accelerators_tpu.training.train import TrainState
+
+_DEPTH_ORDER = {"interleaved": False}
+
+
+def _relayout_state_tree(tree, saved: dict | None, target: dict | None):
+    """Apply relayout_layers to every subtree stored under a 'layers'
+    key — params['layers'] plus the optax moment trees (mu/nu) that
+    mirror the param structure inside namedtuple chain states."""
+    if isinstance(tree, dict):
+        return {k: (relayout_layers(v, saved, target) if k == "layers"
+                    else _relayout_state_tree(v, saved, target))
+                for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        mapped = [_relayout_state_tree(v, saved, target) for v in tree]
+        if hasattr(tree, "_fields"):            # namedtuple (optax states)
+            return type(tree)(*mapped)
+        return tuple(mapped)
+    if isinstance(tree, list):
+        return [_relayout_state_tree(v, saved, target) for v in tree]
+    if tree is None or hasattr(tree, "shape") or jnp.isscalar(tree):
+        return tree   # array/scalar leaf
+    # An unrecognized container could hide a params-mirroring 'layers'
+    # subtree (e.g. a dataclass-pytree optax state) whose moments would
+    # then silently NOT be re-permuted — corrupt training, no error.
+    raise TypeError(
+        f"cannot walk {type(tree).__name__} during checkpoint layout "
+        "re-permute; teach _relayout_state_tree about this container")
 
 
 class CheckpointManager:
@@ -24,6 +66,7 @@ class CheckpointManager:
     def __init__(self, directory: str, save_interval_steps: int = 100,
                  max_to_keep: int = 3):
         directory = os.path.abspath(directory)
+        self._dir = directory
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -33,9 +76,18 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+    def save(self, step: int, state: TrainState, force: bool = False,
+             layout: dict | None = None) -> bool:
+        """`layout` is the layer-storage tag the state was built under
+        (training/train.py state_layer_layout); omitted means depth
+        order."""
         saved = self._mngr.save(
-            step, args=ocp.args.StandardSave(state._asdict()), force=force)
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state._asdict()),
+                layout=ocp.args.JsonSave(layout or _DEPTH_ORDER),
+            ),
+            force=force)
         return bool(saved)
 
     def wait(self):
@@ -44,10 +96,24 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
-    def restore(self, state_like: TrainState, step: int | None = None
-                ) -> TrainState | None:
-        """Restore into the shardings/dtypes of `state_like` (an existing or
-        abstract TrainState)."""
+    def saved_layout(self, step: int) -> dict:
+        """The layer-storage layout tag recorded at `step` (depth order
+        for checkpoints predating the tag)."""
+        step_dir = os.path.join(self._dir, str(step))
+        if not os.path.isdir(os.path.join(step_dir, "layout")):
+            return dict(_DEPTH_ORDER)
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(layout=ocp.args.JsonRestore()))
+        return dict(restored["layout"])
+
+    def restore(self, state_like: TrainState, step: int | None = None,
+                layout: dict | None = None) -> TrainState | None:
+        """Restore into the shardings/dtypes of `state_like` (an existing
+        or abstract TrainState). `layout` is the layer-storage order the
+        CALLER needs (state_layer_layout of the current cfg/mesh); when
+        it differs from the checkpoint's recorded layout, the stacked
+        layer arrays and their optimizer moments are re-permuted
+        automatically."""
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
@@ -57,9 +123,23 @@ class CheckpointManager:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
         abstract = jax.tree.map(to_abstract, state_like._asdict())
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
-        return TrainState(**restored)
+        step_dir = os.path.join(self._dir, str(step))
+        if os.path.isdir(os.path.join(step_dir, "state")):
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    layout=ocp.args.JsonRestore(),
+                ))
+            tree, saved_layout = restored["state"], restored["layout"]
+        else:
+            # Pre-tag checkpoint (bare StandardSave): depth order.
+            tree = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+            saved_layout = dict(_DEPTH_ORDER)
+
+        if normalize_layout(saved_layout) != normalize_layout(layout):
+            tree = _relayout_state_tree(tree, saved_layout, layout)
+        return TrainState(**tree)
 
     def close(self):
         self._mngr.close()

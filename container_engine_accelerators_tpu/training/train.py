@@ -51,10 +51,14 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     pipeline = bool(cfg.pipeline_microbatches) and mesh.shape.get("pp", 1) > 1
     pshard = shd.param_shardings(mesh, pipeline=pipeline,
                                  moe=bool(cfg.n_experts))
+    # Single source of truth for whether interleaved storage is active:
+    # the same tag checkpoints record, so save/restore re-permutes can
+    # never disagree with what init actually did.
+    layout = state_layer_layout(cfg, mesh)
+
     def init_fn(key):
         params = llama.init_params(key, cfg=cfg)
-        if pipeline and cfg.pipeline_schedule == "circular" \
-                and cfg.pipeline_interleave_weights:
+        if layout["interleaved"]:
             # Store layers in the circular schedule's round-robin order
             # so the blocked P('pp') shard needs no per-step all-to-all
             # (parallel/pipeline.py interleave_layers; deinterleave
@@ -63,8 +67,7 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
                 interleave_layers,
             )
             params["layers"] = interleave_layers(
-                params["layers"], mesh.shape["pp"],
-                cfg.pipeline_circular_repeats)
+                params["layers"], layout["pp"], layout["v"])
         return params
 
     init = jax.jit(init_fn, out_shardings=pshard)
@@ -173,6 +176,23 @@ def train_loop(state: TrainState, batches: Iterator, step_fn, mesh: Mesh,
     return state, metrics
 
 
+def state_layer_layout(cfg, mesh: Mesh | None) -> dict:
+    """The layer-storage layout tag for checkpoints written under this
+    (cfg, mesh): {'interleaved': True, 'pp': P, 'v': v} when the
+    circular pipeline's interleaved weight order is active (the same
+    condition create_train_state interleaves under), else depth order.
+    CheckpointManager stores this tag and uses it to re-permute on
+    restore into a different layout (parallel/pipeline.py
+    relayout_layers)."""
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if (bool(cfg.pipeline_microbatches) and pp > 1
+            and cfg.pipeline_schedule == "circular"
+            and cfg.pipeline_interleave_weights):
+        return {"interleaved": True, "pp": pp,
+                "v": cfg.pipeline_circular_repeats}
+    return {"interleaved": False}
+
+
 def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         ckpt_dir: str | None = None, save_every: int = 100,
         max_steps: int | None = None, key=None, log_every: int = 10,
@@ -198,9 +218,10 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     key = key if key is not None else jrandom.key(0)
     state = create_train_state(key, cfg, mesh, optimizer)
     mngr = None
+    layout = state_layer_layout(cfg, mesh)
     if ckpt_dir:
         mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
-        restored = mngr.restore(state)
+        restored = mngr.restore(state, layout=layout)
         if restored is not None:
             state = restored
             log_fn(f"resumed from step {int(jax.device_get(state.step))}")
@@ -227,7 +248,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         state, metrics = step_fn(state, batch)
         cur = int(jax.device_get(state.step))
         if mngr is not None:
-            mngr.save(cur, state)
+            mngr.save(cur, state, layout=layout)
         if log_every and i % log_every == 0:
             m = jax.device_get(metrics)
             log_fn(f"step {cur} loss {float(m['loss']):.4f}")
@@ -235,7 +256,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     if mngr is not None:
         final = int(jax.device_get(state.step))
         if mngr.latest_step() != final:
-            mngr.save(final, state, force=True)
+            mngr.save(final, state, force=True, layout=layout)
         mngr.wait()
         mngr.close()
     return state, metrics
