@@ -32,6 +32,10 @@ KNOWN_ERROR_CLASSES = (
     "RUNTIME_HANG",
     "HBM_ECC_CORRECTABLE",
     "ICI_CRC_ERROR",
+    # App-level exhaustion classes observed in real libtpu output
+    # (tests/fixtures/real_tpu_logs/): counted + surfaced, not critical.
+    "HBM_OOM",
+    "VMEM_OOM",
 )
 DEFAULT_CRITICAL = ("HBM_ECC_UNCORRECTABLE", "ICI_LINK_DOWN", "CHIP_LOST",
                     "THERMAL_TRIP")

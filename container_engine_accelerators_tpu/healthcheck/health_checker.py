@@ -131,6 +131,15 @@ DEFAULT_SCRAPE_RULES = (
     (r"thermal\s+(?:trip|shutdown)", "THERMAL_TRIP"),
     (r"(?:watchdog|heartbeat)\s+timeout|runtime\s+(?:hang|stuck)"
      r"|tpu\s+core\s+halted", "RUNTIME_HANG"),
+    # App-level memory exhaustion, validated against REAL libtpu output
+    # provoked on an attached v5e chip (tests/fixtures/real_tpu_logs/,
+    # demo/tpu-error/real-fault/) — the role the reference's vectorAdd
+    # illegal-memory-access demo plays for Xid 31
+    # (reference demo/gpu-error/illegal-memory-access/vectorAdd.cu:1-91).
+    # Non-critical by default: an application OOM is not a node fault,
+    # but fleets want it counted and surfaced as an Event.
+    (r"ran\s+out\s+of\s+memory\s+in\s+memory\s+space\s+hbm", "HBM_OOM"),
+    (r"ran\s+out\s+of\s+memory\s+in\s+memory\s+space\s+vmem", "VMEM_OOM"),
 )
 
 # Digits after the keyword must end at a token boundary: 'device
@@ -225,6 +234,10 @@ class TPUHealthChecker:
         self.poll_interval = poll_interval
         self.boot_id_path = boot_id_path
         self.error_counts: dict[str, int] = {}
+        # The node condition is only written once a CRITICAL class has
+        # been observed: it drives external auto-repair, so a routine
+        # app-level error (HBM_OOM) on a healthy node must never set it.
+        self._critical_seen = False
         self._stopped = False
         self._last_heartbeat = 0.0
 
@@ -253,7 +266,7 @@ class TPUHealthChecker:
                 continue
             for ev in events:
                 self.handle_event(ev)
-        if self.k8s and self.error_counts:
+        if self.k8s and self._critical_seen:
             now = time.monotonic()
             if now - self._last_heartbeat >= HEARTBEAT_INTERVAL:
                 self._last_heartbeat = now
@@ -266,6 +279,7 @@ class TPUHealthChecker:
             self.error_counts.get(ev.error_class, 0) + 1)
         critical = ev.error_class in self.config.health_critical_errors
         if critical:
+            self._critical_seen = True
             if ev.chip_index < 0:
                 for dev_id in list(self.manager.devices):
                     self.manager.set_device_health(dev_id, UNHEALTHY)
@@ -273,7 +287,10 @@ class TPUHealthChecker:
                 self.manager.set_chip_health(ev.chip_index, UNHEALTHY)
         if self.k8s:
             self.record_event(ev, critical)
-            self.update_condition()
+            # Non-critical classes are counted + surfaced as Events only;
+            # the condition (auto-repair trigger) needs a critical error.
+            if self._critical_seen:
+                self.update_condition()
 
     # ---------- K8s surface ----------
 
@@ -342,7 +359,13 @@ class TPUHealthChecker:
                 except ValueError:
                     pass
                 if stored and stored == self.boot_id():
-                    return  # same boot: errors still current
+                    # Same boot: errors still current. Re-arm the
+                    # heartbeat so a plugin restart (pod crash, DS
+                    # rollout) on an already-faulted node keeps the
+                    # condition fresh even though the original critical
+                    # event will not re-fire.
+                    self._critical_seen = True
+                    return
                 self.k8s.set_node_condition(
                     self.node_name,
                     self._condition("False", "NodeRebooted",

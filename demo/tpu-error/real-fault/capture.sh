@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Re-capture the real-failure fixture corpus from an attached TPU chip.
+# Each provocation's stderr is the verbatim runtime/compiler output the
+# health scraper is tested against (tests/test_real_log_fixtures.py).
+set -u
+here="$(cd "$(dirname "$0")" && pwd)"
+out="${1:-$here/../../../tests/fixtures/real_tpu_logs}"
+mkdir -p "$out"
+
+run() { # name script expected_exit
+  local name="$1" script="$2"
+  python "$here/$script" >/dev/null 2>"$out/$name.log"
+  echo "$name: exit=$? -> $out/$name.log ($(wc -l <"$out/$name.log") lines)"
+}
+
+run hbm_oom provoke_hbm_oom.py
+run vmem_oom provoke_vmem_oom.py
+
+# Benign control: a healthy run's client-side stderr (false-positive corpus).
+python - >/dev/null 2>"$out/benign_success.log" <<'EOF'
+import jax, jax.numpy as jnp
+a = jnp.ones((512, 512), dtype=jnp.bfloat16)
+print(float((a @ a).sum()))
+EOF
+echo "benign_success: exit=$? -> $out/benign_success.log"
+
+echo "Validate: python -m pytest tests/test_real_log_fixtures.py -q"

@@ -1,0 +1,27 @@
+"""Provoke a REAL HBM out-of-memory from libtpu (not a synthetic record).
+
+Requests a program whose arguments (64 GiB) exceed any current chip's
+HBM; XLA:TPU refuses at compile with a permanent error naming the
+memory space, capacity, and overage — the genuine log text the health
+scraper's HBM_OOM rule is validated against
+(tests/fixtures/real_tpu_logs/hbm_oom.log).
+
+Role model: the reference provokes a real Xid 31 with an out-of-bounds
+CUDA kernel to validate its whole pipeline on real events
+(reference demo/gpu-error/illegal-memory-access/vectorAdd.cu:1-91).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    print("devices:", jax.devices())
+    x = jnp.ones((4096, 4096, 1024), dtype=jnp.float32)  # 64 GiB of args
+    # Forcing a reduction compiles a program carrying the full argument
+    # set; materialization alone can be virtualized by the runtime.
+    print(float(x.sum()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Provoke a REAL scoped-VMEM exhaustion from the Mosaic/XLA:TPU stack.
+
+A pallas kernel whose block (128 MiB) exceeds the 16 MiB scoped-VMEM
+limit passes client-side lowering and fails inside libtpu's compiler:
+"Ran out of memory in memory space vmem while allocating on stack for
+%tpu_custom_call" — the genuine log text the scraper's VMEM_OOM rule is
+validated against (tests/fixtures/real_tpu_logs/vmem_oom.log).
+
+Role model: reference demo/gpu-error/illegal-memory-access/vectorAdd.cu:1-91
+(real driver error, not injected plumbing).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def main():
+    x = jnp.ones((4096, 4096), dtype=jnp.float32)  # 64 MiB in + 64 MiB out
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((4096, 4096), jnp.float32))(x)
+    print(float(out.sum()))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,20 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+@pytest.fixture
+def fake_k8s():
+    from tests.fake_k8s import FakeK8s
+    srv = FakeK8s()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(fake_k8s):
+    from container_engine_accelerators_tpu.k8s import K8sClient
+    return K8sClient(fake_k8s.url)
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devs = jax.devices()

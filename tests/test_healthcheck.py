@@ -25,20 +25,7 @@ from container_engine_accelerators_tpu.healthcheck import (
     TPUHealthChecker,
 )
 from container_engine_accelerators_tpu.k8s import ApiError, K8sClient
-from tests.fake_k8s import FakeK8s
 from tests.test_deviceplugin import make_fake_devfs
-
-
-@pytest.fixture
-def fake_k8s():
-    srv = FakeK8s()
-    yield srv
-    srv.stop()
-
-
-@pytest.fixture
-def client(fake_k8s):
-    return K8sClient(fake_k8s.url)
 
 
 def make_manager(tmp_path, n=2, cfg=None):
@@ -53,6 +40,11 @@ def make_checker(tmp_path, manager, client, **kw):
     boot.write_text("boot-1\n")
     log_path = tmp_path / "errors.jsonl"
     kw.setdefault("sources", [LogFileErrorSource(str(log_path))])
+    # When a caller passes sources=None (use the checker's defaults),
+    # keep the default JSONL feed under tmp_path too — never the
+    # production /var/log path, which may hold real records on a TPU
+    # host running this suite.
+    kw.setdefault("error_log_path", str(log_path))
     return TPUHealthChecker(
         manager, manager.config, k8s=client, node_name="node-a",
         boot_id_path=str(boot), **kw), log_path, boot
@@ -263,14 +255,23 @@ def test_critical_error_marks_device_unhealthy(tmp_path, fake_k8s, client):
 def test_noncritical_error_keeps_device_healthy(tmp_path, fake_k8s, client):
     m, dev = make_manager(tmp_path)
     checker, log_path, _ = make_checker(tmp_path, m, client)
+    fake_k8s.nodes["node-a"] = {"metadata": {"name": "node-a"}, "status": {}}
     log_path.write_text('{"chip": 0, "class": "HBM_ECC_CORRECTABLE"}\n')
     checker.poll_once()
     assert m.devices["accel0"].health == HEALTHY
     assert fake_k8s.events[0]["type"] == "Normal"
-    # Condition still surfaces the observation.
+    # Non-critical errors do NOT write the auto-repair node condition
+    # (it would expose a healthy node to repair controllers); the Event
+    # above is the surface. Once a critical error arrives, the condition
+    # carries the FULL count map including the earlier observation.
+    conds = fake_k8s.nodes["node-a"]["status"].get("conditions", [])
+    assert not any(c.get("type") == "TpuCriticalError" for c in conds)
+    log_path.write_text(
+        log_path.read_text() + '{"chip": 0, "class": "CHIP_LOST"}\n')
+    checker.poll_once()
     payload = json.loads(
         fake_k8s.nodes["node-a"]["status"]["conditions"][0]["message"])
-    assert payload["errors"] == {"HBM_ECC_CORRECTABLE": 1}
+    assert payload["errors"] == {"HBM_ECC_CORRECTABLE": 1, "CHIP_LOST": 1}
 
 
 def test_hostwide_error_flips_all_devices(tmp_path, fake_k8s, client):
@@ -306,6 +307,15 @@ def test_boot_id_reset_keeps_current_condition(tmp_path, fake_k8s, client):
     checker.maybe_reset_condition()
     assert fake_k8s.nodes["node-a"]["status"]["conditions"][0][
         "status"] == "True"
+    # Restart on an already-faulted node re-arms the heartbeat: the
+    # original critical event will not re-fire (devfs source re-seeds
+    # from current discovery), yet the condition must stay fresh for
+    # repair controllers that require a recent lastHeartbeatTime.
+    assert checker._critical_seen
+    checker._last_heartbeat = -1e9
+    checker.poll_once()
+    cond = fake_k8s.nodes["node-a"]["status"]["conditions"][0]
+    assert cond["status"] == "True"
 
 
 # ---------- version visibility ----------
