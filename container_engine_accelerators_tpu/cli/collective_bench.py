@@ -39,6 +39,10 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--axis", default="ici",
                    help="mesh axis to probe: ici | dcn")
+    p.add_argument("--backend", default=None,
+                   help="force a jax platform (e.g. 'cpu' for virtual-"
+                        "device runs; the JAX_PLATFORMS env var alone "
+                        "does not override an installed TPU plugin)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON line per size instead of the table")
     # Multi-process (multi-slice over DCN) wiring.
@@ -54,6 +58,8 @@ def main(argv=None) -> int:
 
     import jax
 
+    if args.backend:
+        jax.config.update("jax_platforms", args.backend)
     if args.coordinator:
         jax.distributed.initialize(
             coordinator_address=args.coordinator,

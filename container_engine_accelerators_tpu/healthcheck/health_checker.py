@@ -353,9 +353,11 @@ class TPUHealthChecker:
                 if not cond or cond.get("status") != "True":
                     return
                 stored = ""
+                stored_errors = {}
                 try:
-                    stored = json.loads(cond.get("message", "{}")).get(
-                        "bootID", "")
+                    payload = json.loads(cond.get("message", "{}"))
+                    stored = payload.get("bootID", "")
+                    stored_errors = payload.get("errors", {}) or {}
                 except ValueError:
                     pass
                 if stored and stored == self.boot_id():
@@ -363,8 +365,14 @@ class TPUHealthChecker:
                     # heartbeat so a plugin restart (pod crash, DS
                     # rollout) on an already-faulted node keeps the
                     # condition fresh even though the original critical
-                    # event will not re-fire.
+                    # event will not re-fire — and adopt the stored
+                    # count map so the heartbeat doesn't erase the fault
+                    # attribution with an empty one.
                     self._critical_seen = True
+                    for cls, n in stored_errors.items():
+                        if isinstance(n, int):
+                            self.error_counts[cls] = (
+                                self.error_counts.get(cls, 0) + n)
                     return
                 self.k8s.set_node_condition(
                     self.node_name,

@@ -303,19 +303,23 @@ def test_boot_id_reset_keeps_current_condition(tmp_path, fake_k8s, client):
         "metadata": {"name": "node-a"},
         "status": {"conditions": [{
             "type": "TpuCriticalError", "status": "True",
-            "message": json.dumps({"bootID": "boot-1", "errors": {}})}]}}
+            "message": json.dumps({"bootID": "boot-1",
+                                   "errors": {"CHIP_LOST": 2}})}]}}
     checker.maybe_reset_condition()
     assert fake_k8s.nodes["node-a"]["status"]["conditions"][0][
         "status"] == "True"
     # Restart on an already-faulted node re-arms the heartbeat: the
     # original critical event will not re-fire (devfs source re-seeds
     # from current discovery), yet the condition must stay fresh for
-    # repair controllers that require a recent lastHeartbeatTime.
+    # repair controllers that require a recent lastHeartbeatTime — and
+    # the heartbeat must carry the stored fault attribution forward, not
+    # erase it with the restarted process's empty count map.
     assert checker._critical_seen
     checker._last_heartbeat = -1e9
     checker.poll_once()
     cond = fake_k8s.nodes["node-a"]["status"]["conditions"][0]
     assert cond["status"] == "True"
+    assert json.loads(cond["message"])["errors"] == {"CHIP_LOST": 2}
 
 
 # ---------- version visibility ----------

@@ -7,7 +7,6 @@ import json
 
 import pytest
 
-from container_engine_accelerators_tpu.k8s import K8sClient
 from container_engine_accelerators_tpu.scheduler import schedule_daemon as sd
 from container_engine_accelerators_tpu.scheduler.label_nodes import (
     topology_labels,
@@ -24,21 +23,6 @@ from container_engine_accelerators_tpu.scheduler.topology import (
     pairwise_distance,
     topology_distance,
 )
-from tests.fake_k8s import FakeK8s
-
-
-@pytest.fixture
-def fake_k8s():
-    srv = FakeK8s()
-    yield srv
-    srv.stop()
-
-
-@pytest.fixture
-def client(fake_k8s):
-    return K8sClient(fake_k8s.url)
-
-
 # ---------- topology model ----------
 
 def T(name, cluster="c1", rack="r1", slice_id="", coords=None, topo=None):
