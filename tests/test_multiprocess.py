@@ -67,29 +67,48 @@ def test_collective_bench_cli_dcn_busbw():
     gpudirect-tcpxo/nccl-test-latest.yaml:124)."""
     import json
 
+    import tempfile
+
     port = free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update({
-            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "JAX_NUM_PROCESSES": "2",
-            "JAX_PROCESS_ID": str(pid),
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        })
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m",
-             "container_engine_accelerators_tpu.cli.collective_bench",
-             "--backend", "cpu", "--axis", "dcn",
-             "--collective", "all_reduce,all_gather",
-             "-b", "16k", "-e", "32k", "-f", "2", "-w", "1",
-             "--iters", "2", "--json"],
-            env=env, cwd=os.path.dirname(HERE),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"bench failed:\n{err[-2000:]}"
+    procs, errfiles = [], []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update({
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(pid),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            })
+            env.pop("JAX_PLATFORMS", None)
+            # stderr to a file, not a pipe: a chatty child must not
+            # block on a full pipe while its sibling waits at the
+            # distributed barrier (we only drain stdout sequentially).
+            ef = tempfile.TemporaryFile(mode="w+")
+            errfiles.append(ef)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "container_engine_accelerators_tpu.cli.collective_bench",
+                 "--backend", "cpu", "--axis", "dcn",
+                 "--collective", "all_reduce,all_gather",
+                 "-b", "16k", "-e", "32k", "-f", "2", "-w", "1",
+                 "--iters", "2", "--json"],
+                env=env, cwd=os.path.dirname(HERE),
+                stdout=subprocess.PIPE, stderr=ef, text=True))
+        outs = []
+        for p, ef in zip(procs, errfiles):
+            out, _ = p.communicate(timeout=420)
+            ef.seek(0)
+            err = ef.read()
+            assert p.returncode == 0, f"bench failed:\n{err[-2000:]}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for ef in errfiles:
+            ef.close()
+    for out in outs:
         lines = [json.loads(l) for l in out.splitlines()
                  if l.startswith("{")]
         # 2 collectives x 2 sweep points, all attributed to the DCN axis

@@ -201,12 +201,14 @@ def test_run_once_ignores_ungated(fake_k8s, client):
 
 # ---------- window-search quality vs exhaustive (measured) ----------
 #
-# The sliding-window search is NOT exhaustively optimal even on tree
-# metrics: the best k-subset can be non-contiguous in the sort order
-# (e.g. slices s0,s0,s1,s2,s2 with k=4 — the optimum skips the middle
-# s1 node). These tests turn the docstring's "near-optimal" claim and
-# the acknowledged trade-off (schedule_daemon.py:15-19) into measured
-# bounds instead of leaving them unquantified.
+# The raw sliding-window search is NOT exhaustively optimal: the best
+# k-subset can be non-contiguous in the sort order (e.g. slices
+# s0,s0,s1,s2,s2 with k=4 — the optimum skips the middle s1 node), and
+# on torus coordinates every window can score identically while a
+# non-window subset wins. The 1-exchange refinement + greedy
+# multi-starts (schedule_daemon._refine_selection/_greedy_starts) exist
+# to close exactly those gaps; these tests measure the combined search
+# against brute force and pin the bound.
 
 
 def _brute_force_best(topos, k):
@@ -257,10 +259,11 @@ def test_window_search_quality_tree_metrics():
             slice_id=f"s{rng.randint(0, 2)}", coords="",
             rack=f"r{rng.randint(0, 2)}"))
     match_rate, worst_ratio = _quality_stats(results)
-    # Measured: the window search finds the exhaustive optimum in the
-    # large majority of tree-metric instances and never strays far.
-    assert match_rate >= 0.8, match_rate
-    assert worst_ratio <= 1.5, worst_ratio
+    # Measured: with the 1-exchange refinement + greedy multi-starts the
+    # search matched the exhaustive optimum on every sampled tree-metric
+    # instance; thresholds leave a sliver of slack for new seeds.
+    assert match_rate >= 0.95, match_rate
+    assert worst_ratio <= 1.05, worst_ratio
 
 
 def test_window_search_quality_coord_metrics():
@@ -269,8 +272,11 @@ def test_window_search_quality_coord_metrics():
         make_labels=lambda rng: slice_labels(
             "s1", f"{rng.randint(0, 3)}-{rng.randint(0, 3)}"))
     match_rate, worst_ratio = _quality_stats(results)
-    assert match_rate >= 0.5, match_rate
-    assert worst_ratio <= 2.0, worst_ratio
+    # Coordinate (torus) metrics were the weak case for the pure window
+    # search (worst 2x); refinement + greedy starts close it to optimal
+    # on every sampled instance (r2 VERDICT item 6 asked for <= 1.2).
+    assert match_rate >= 0.95, match_rate
+    assert worst_ratio <= 1.05, worst_ratio
 
 
 # ---------- node-failure repair (re-gate via controller recreation) ----
