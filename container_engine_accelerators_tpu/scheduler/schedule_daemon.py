@@ -216,18 +216,24 @@ def _greedy_starts(slots, k, anchors, max_seeds: int = 8
     starts = []
     for seed in distinct:
         sel, used = [seed], {seed}
+        # Running distance-to-selection per candidate, updated by one
+        # distance per (candidate, applied addition) — O(k*N) per seed
+        # instead of recomputing O(k) sums inside the argmin.
+        run_sum = [sum(topology_distance(t, a) for a in anchors)
+                   + topology_distance(t, slots[seed][0])
+                   for t, _ in slots]
         while len(sel) < k:
-            cur = [slots[j][0] for j in sel]
             best_i, best_c = None, None
-            for i, (t, _) in enumerate(slots):
+            for i in range(len(slots)):
                 if i in used:
                     continue
-                c = (sum(topology_distance(t, x) for x in cur)
-                     + sum(topology_distance(t, a) for a in anchors))
-                if best_c is None or c < best_c:
-                    best_i, best_c = i, c
+                if best_c is None or run_sum[i] < best_c:
+                    best_i, best_c = i, run_sum[i]
             sel.append(best_i)
             used.add(best_i)
+            t_new = slots[best_i][0]
+            for i, (t, _) in enumerate(slots):
+                run_sum[i] += topology_distance(t, t_new)
         starts.append(sel)
     return starts
 

@@ -14,6 +14,25 @@ TPU_INSTALL_DIR_CONTAINER="${TPU_INSTALL_DIR_CONTAINER:-/usr/local/tpu}"
 LIBTPU_SOURCE_DIR="${LIBTPU_SOURCE_DIR:-/opt/libtpu}"
 CACHE_FILE="${TPU_INSTALL_DIR_CONTAINER}/.cache"
 
+# Version pin (the NVIDIA_DRIVER_VERSION analog of the reference's
+# R-series daemonsets, e.g. ubuntu/daemonset-preloaded-R550.yaml:71-73):
+# a pinned daemonset sets LIBTPU_VERSION and the installer stages that
+# exact version from the image's multi-version tree, failing loudly if
+# the image does not carry it.
+if [[ -n "${LIBTPU_VERSION:-}" ]]; then
+  LIBTPU_SOURCE_DIR="${LIBTPU_SOURCE_DIR}/versions/${LIBTPU_VERSION}"
+  if [[ ! -f "${LIBTPU_SOURCE_DIR}/libtpu.so" || \
+        ! -f "${LIBTPU_SOURCE_DIR}/version" ]]; then
+    echo "Pinned libtpu ${LIBTPU_VERSION} not present in installer" \
+         "image (${LIBTPU_SOURCE_DIR}); rebuild the image or drop the pin."
+    exit 1
+  fi
+  if [[ "$(cat "${LIBTPU_SOURCE_DIR}/version")" != "${LIBTPU_VERSION}" ]]; then
+    echo "Installer image version file disagrees with pin ${LIBTPU_VERSION}"
+    exit 1
+  fi
+fi
+
 check_cached_version() {
   echo "Checking cached version"
   if [[ ! -f "${CACHE_FILE}" ]]; then
