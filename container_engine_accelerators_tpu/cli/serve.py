@@ -129,6 +129,183 @@ class BatchingEngine:
                         item[3].set_exception(e)
 
 
+class ContinuousEngine:
+    """In-flight (continuous) batching: a fixed pool of decode slots
+    steps together every iteration; new requests are prefilled into free
+    slots BETWEEN steps, joining the running batch immediately instead
+    of waiting for the current batch to drain. Short requests no longer
+    queue behind long ones and mixed (prompt_len, max_new) traffic
+    shares one executable — the serving-density step the window engine
+    lacks (ROADMAP item 6; the reference's serving demo delegates this
+    to TF-Serving's batcher, reference demo/serving/
+    tensorflow-serving.yaml).
+
+    TPU-native shape discipline: slots/max_len are static; prompts pad
+    to `prompt_bucket` multiples so prefill compiles once per bucket;
+    per-slot cache positions live in a [slots] length vector (the pallas
+    decode kernel consumes it directly). A free slot keeps computing on
+    garbage — idle lanes are cheaper than recompiles."""
+
+    def __init__(self, params, cfg, max_slots: int = 8,
+                 max_len: int = 2048, prompt_bucket: int = 64,
+                 max_prompt_len: int = 1024):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.prompt_bucket = prompt_bucket
+        self.max_prompt_len = max_prompt_len
+        self.queue: queue.SimpleQueue = queue.SimpleQueue()
+        self.steps_run = 0          # decode iterations (all slots at once)
+        self.prefills_run = 0
+        self.requests_served = 0
+        self.batches_run = 0        # alias: /healthz parity with window
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._worker, daemon=True,
+                                       name="serve-continuous")
+        self.thread.start()
+
+    def submit(self, tokens: list[int], max_new_tokens: int,
+               temperature: float) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if not tokens or len(tokens) > self.max_prompt_len:
+            fut.set_exception(ValueError(
+                f"prompt length must be in [1, {self.max_prompt_len}]"))
+            return fut
+        if max_new_tokens < 1 or max_new_tokens > 1024:
+            fut.set_exception(ValueError(
+                "max_new_tokens must be in [1, 1024]"))
+            return fut
+        # The prompt is padded UP to a bucket multiple before prefill,
+        # so the bucketed length (not the raw one) must fit the cache.
+        bucketed = -(-len(tokens) // self.prompt_bucket) * self.prompt_bucket
+        if (len(tokens) + max_new_tokens > self.max_len
+                or bucketed > self.max_len):
+            fut.set_exception(ValueError(
+                f"prompt (bucketed to {bucketed}) + max_new_tokens "
+                f"exceeds cache max_len {self.max_len}"))
+            return fut
+        self.queue.put((tuple(tokens), max_new_tokens, temperature, fut))
+        return fut
+
+    def stop(self):
+        self._stop.set()
+
+    # ---------- worker ----------
+
+    def _worker(self):
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.models.decode import (
+            _jitted_decode_step_slots,
+            _jitted_pick_tokens,
+            _jitted_prefill_slot,
+            init_slot_cache,
+        )
+
+        s = self.max_slots
+        cache = init_slot_cache(self.cfg, s, self.max_len)
+        step_fn = _jitted_decode_step_slots(self.cfg)
+        prefill_fn = _jitted_prefill_slot(self.cfg)
+        pick_fn = _jitted_pick_tokens()
+        base_key = jax.random.key(0)
+
+        # Host-side slot table: None = free, else dict with the request
+        # state. Device-side mirrors: last token, temperature per slot.
+        slots: list[dict | None] = [None] * s
+        last_tok = [0] * s
+        temps = [0.0] * s
+
+        def admit_one(item, slot_idx):
+            tokens, n_new, temp, fut = item
+            tp = -(-len(tokens) // self.prompt_bucket) * self.prompt_bucket
+            padded = list(tokens) + [0] * (tp - len(tokens))
+            nonlocal cache
+            last_logits, cache = prefill_fn(
+                self.params, cache, jnp.int32(slot_idx),
+                jnp.asarray(padded, jnp.int32),
+                jnp.int32(len(tokens)))
+            self.prefills_run += 1
+            key = jax.random.fold_in(base_key,
+                                     self.prefills_run & 0xFFFFFFF)
+            tok = int(pick_fn(last_logits[None, :],
+                              jnp.asarray([temp], jnp.float32), key)[0])
+            slots[slot_idx] = {"fut": fut, "remaining": n_new - 1,
+                               "out": list(tokens) + [tok], "temp": temp}
+            last_tok[slot_idx] = tok
+            temps[slot_idx] = temp
+            if n_new == 1:
+                self._finish(slot_idx, slots)
+
+        def reset_after_device_error(err):
+            # Both prefill and decode DONATE the cache: after any device
+            # failure the old buffer may be consumed or poisoned, so
+            # recovery = fail every in-flight request and rebuild the
+            # pool from scratch.
+            nonlocal cache
+            for i, sl in enumerate(slots):
+                if sl is not None and not sl["fut"].done():
+                    sl["fut"].set_exception(err)
+                slots[i] = None
+            cache = init_slot_cache(self.cfg, s, self.max_len)
+
+        while not self._stop.is_set():
+            free = [i for i in range(s) if slots[i] is None]
+            # Admit into every free slot; block briefly only when fully
+            # idle so shutdown stays responsive.
+            idle = all(sl is None for sl in slots)
+            while free:
+                try:
+                    item = self.queue.get(timeout=0.05 if idle else 0.0)
+                except queue.Empty:
+                    break
+                try:
+                    admit_one(item, free.pop(0))
+                except Exception as e:
+                    log.exception("prefill failed")
+                    if not item[3].done():
+                        item[3].set_exception(e)
+                    reset_after_device_error(e)
+                    break
+                idle = False
+            if all(sl is None for sl in slots):
+                continue
+
+            tokens_arr = jnp.asarray(last_tok, jnp.int32)
+            active_arr = jnp.asarray(
+                [sl is not None for sl in slots], bool)
+            temps_arr = jnp.asarray(temps, jnp.float32)
+            try:
+                logits, cache = step_fn(self.params, cache, tokens_arr,
+                                        active_arr)
+                self.steps_run += 1
+                self.batches_run = self.steps_run
+                key = jax.random.fold_in(base_key,
+                                         (self.steps_run & 0xFFFFFFF)
+                                         | (1 << 28))
+                toks = [int(t) for t in pick_fn(logits, temps_arr, key)]
+            except Exception as e:
+                log.exception("decode step failed")
+                reset_after_device_error(e)
+                continue
+            for i, sl in enumerate(slots):
+                if sl is None:
+                    continue
+                sl["out"].append(toks[i])
+                last_tok[i] = toks[i]
+                sl["remaining"] -= 1
+                if sl["remaining"] <= 0:
+                    self._finish(i, slots)
+
+    def _finish(self, i, slots):
+        sl = slots[i]
+        if not sl["fut"].done():
+            sl["fut"].set_result([int(t) for t in sl["out"]])
+        self.requests_served += 1
+        slots[i] = None
+
+
 def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -176,6 +353,14 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--batch-window-ms", type=float, default=5.0)
+    p.add_argument("--engine", choices=("window", "continuous"),
+                   default="window",
+                   help="window = shape-bucket batch-window engine; "
+                        "continuous = in-flight batching over a fixed "
+                        "slot pool (admits new requests into the "
+                        "running decode batch)")
+    p.add_argument("--max-len", type=int, default=2048,
+                   help="continuous engine: KV-cache capacity per slot")
     p.add_argument("--quantize-int8", action="store_true",
                    help="serve int8-quantized weights (halves weight HBM "
                         "traffic on the decode path)")
@@ -192,8 +377,12 @@ def main(argv=None) -> int:
         params = quantize_llama_params(params)
         log.info("serving int8-quantized weights")
 
-    engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
-                            window_ms=args.batch_window_ms)
+    if args.engine == "continuous":
+        engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
+                                  max_len=args.max_len)
+    else:
+        engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
+                                window_ms=args.batch_window_ms)
     server = make_server(engine, args.port)
     log.info("serving on :%d (/generate, /healthz)", args.port)
     server.serve_forever()

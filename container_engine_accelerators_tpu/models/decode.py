@@ -77,9 +77,11 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     # Causal-by-position mask: new token at cache_len+i sees keys
-    # [0, cache_len+i].
+    # [0, cache_len+i]. cache_len is a scalar (shared length) or [B]
+    # (per-slot lengths on the continuous-batching path).
+    per_row_len = jnp.asarray(cache_len, jnp.int32).reshape(-1, 1, 1, 1)
     key_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
-    query_pos = cache_len + jax.lax.broadcasted_iota(
+    query_pos = per_row_len + jax.lax.broadcasted_iota(
         jnp.int32, logits.shape, 2)
     logits = jnp.where(key_pos <= query_pos, logits, -1e30)
     del max_len
@@ -88,15 +90,30 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
 
 
 def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
-                cfg: LlamaConfig) -> tuple[jnp.ndarray, KVCache]:
+                cfg: LlamaConfig, active: jnp.ndarray | None = None
+                ) -> tuple[jnp.ndarray, KVCache]:
     """Run T new tokens ([B, T], T static — 1 for decode, prompt length for
-    prefill). Returns (logits [B, T, vocab] float32, updated cache)."""
+    prefill). Returns (logits [B, T, vocab] float32, updated cache).
+
+    cache.length may be a scalar (classic batched path: every row at the
+    same position) or a [B] vector (continuous-batching slots: every row
+    at its own position). The branch is STATIC (on length's rank), so
+    the classic path keeps its single dynamic_update_slice per layer and
+    the slot path pays the per-row scatter only where it's needed.
+    `active` ([B] bool, slot path only) gates which rows' lengths
+    advance; inactive (free) slots still compute — their writes land in
+    rows the next prefill overwrites."""
     b, t = tokens.shape
     max_len = cache.k.shape[2]
     dt = cfg.dtype
+    per_slot = jnp.ndim(cache.length) > 0
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
-    positions = cache.length + jnp.arange(t, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (b, t))
+    if per_slot:
+        row_len = jnp.minimum(cache.length, max_len - t)      # [B]
+        positions = row_len[:, None] + jnp.arange(t, dtype=jnp.int32)
+    else:
+        positions = cache.length + jnp.arange(t, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, t))
 
     x = params["embed"].astype(dt)[tokens]
 
@@ -112,6 +129,17 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             return out.reshape(h.shape[0], h.shape[1], -1)
         return h @ w.astype(h.dtype)
 
+    def write(c, new):
+        if per_slot:
+            # Per-row scatter: row b's T new entries land at row_len[b].
+            return jax.vmap(
+                lambda cb, nb, st: jax.lax.dynamic_update_slice(
+                    cb, nb.astype(cb.dtype), (st, 0, 0)))(c, new, row_len)
+        return jax.lax.dynamic_update_slice(
+            c, new.astype(c.dtype), (0, cache.length, 0, 0))
+
+    att_len = row_len if per_slot else cache.length
+
     def layer_body(x, scanned):
         lp, k_cache_in, v_cache_in = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -120,12 +148,10 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         v = proj(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache_in, k.astype(k_cache_in.dtype), (0, cache.length, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache_in, v.astype(v_cache_in.dtype), (0, cache.length, 0, 0))
+        k_cache = write(k_cache_in, k)
+        v_cache = write(v_cache_in, v)
         attn = _cached_attention(q.astype(dt), k_cache.astype(dt),
-                                 v_cache.astype(dt), cache.length, cfg)
+                                 v_cache.astype(dt), att_len, cfg)
         x = x + proj(attn.reshape(b, t, -1), lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(proj(h2, lp["w_gate"]))
@@ -147,8 +173,97 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     else:
         logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                             params["lm_head"].astype(jnp.float32))
-    new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
+    new_len = cache.length + t
+    if per_slot:
+        new_len = jnp.minimum(cache.length + t, max_len)
+        if active is not None:
+            new_len = jnp.where(active, new_len, cache.length)
+    new_cache = KVCache(k=new_k, v=new_v, length=new_len)
     return logits, new_cache
+
+
+# ---------- continuous batching (slot) API ----------
+#
+# The serving engine's in-flight batching needs every slot of one decode
+# batch to sit at a DIFFERENT position: cache.length becomes a [slots]
+# vector, writes scatter per row, and attention masks per row (the
+# pallas kernel takes the vector directly). Shapes stay fully static —
+# a free slot still computes, its writes land in rows the next prefill
+# overwrites — which is the TPU-native way to express continuous
+# batching (recompilation is the thing to avoid, not idle lanes).
+
+
+def init_slot_cache(cfg: LlamaConfig, slots: int, max_len: int,
+                    dtype=None) -> KVCache:
+    """KVCache with per-slot lengths ([slots] int32, all zero)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((slots,), jnp.int32))
+
+
+def decode_step_slots(params: dict, cache: KVCache, tokens: jnp.ndarray,
+                      active: jnp.ndarray, cfg: LlamaConfig
+                      ) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step for every slot: tokens [B] (one per slot), active
+    [B] bool. Returns (last-token logits [B, vocab] f32, cache with
+    active lengths advanced). Thin wrapper: decode_step does the work,
+    keyed off the cache's vector length."""
+    logits, cache = decode_step(params, cache, tokens[:, None], cfg,
+                                active=active)
+    return logits[:, 0], cache
+
+
+def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
+                 tokens: jnp.ndarray, true_len: jnp.ndarray,
+                 cfg: LlamaConfig) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill ONE request into slot `slot` of a slot cache.
+
+    tokens: [Tp] prompt padded to a bucket length (padding tokens run
+    through the model; their K/V rows sit beyond true_len, masked by the
+    per-slot length and progressively overwritten as decode advances).
+    slot / true_len are traced scalars, so one compiled executable
+    serves every (bucket, config) pair regardless of target slot.
+    Returns (logits of the last LIVE token [vocab] f32, updated cache).
+    """
+    tp = tokens.shape[0]
+    tmp = init_cache(cfg, 1, tp)
+    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg)
+    k = jax.lax.dynamic_update_slice(
+        cache.k, tmp.k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        cache.v, tmp.v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    length = cache.length.at[slot].set(true_len)
+    last = logits[0, true_len - 1]
+    return last, KVCache(k=k, v=v, length=length)
+
+
+def pick_tokens(logits: jnp.ndarray, temps: jnp.ndarray,
+                key: jax.Array) -> jnp.ndarray:
+    """Per-slot sampling: greedy where temp <= 0, categorical at the
+    slot's own temperature otherwise. logits [B, V], temps [B]."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.random.categorical(
+        key, logits / safe_t, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_decode_step_slots(cfg: LlamaConfig):
+    return jax.jit(functools.partial(decode_step_slots, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_prefill_slot(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_slot, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_pick_tokens():
+    return jax.jit(pick_tokens)
 
 
 @functools.lru_cache(maxsize=32)

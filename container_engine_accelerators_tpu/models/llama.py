@@ -44,7 +44,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16          # activations
     param_dtype: Any = jnp.float32     # master weights
-    remat_policy: str = "dots"         # 'none' | 'dots' | 'full'
+    remat_policy: str = "dots"         # 'none'|'dots_all'|'dots'|'full'
     use_flash: bool | None = None      # None = auto by platform
     # Sequence/context parallelism over the 'sp' mesh axis; enabled by
     # the training layer when the mesh has sp > 1. Mode 'ring' rotates
@@ -266,6 +266,13 @@ def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None):
 
 _REMAT_POLICIES = {
     "none": None,
+    # Saves every matmul output (q/k/v/o, mlp gate/up/down): backward
+    # recomputes only cheap elementwise ops, so the remat FLOP overhead
+    # is ~0 at the cost of ~b*s*(4d+2f) bf16 of residuals per layer.
+    "dots_all": "dots_saveable",
+    # Saves only batch-free matmul outputs — in a transformer every
+    # activation carries the batch dim, so this recomputes nearly the
+    # whole forward (≈ +2N FLOPs/token) with minimal residual memory.
     "dots": "dots_with_no_batch_dims_saveable",
     "full": "nothing_saveable",
 }

@@ -71,7 +71,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
                    hkv: int):
     ki = pl.program_id(1)
     num_k = pl.num_programs(1)
-    cache_len = len_ref[0]
+    cache_len = len_ref[pl.program_id(0)]  # per-batch-row live length
 
     @pl.when(ki == 0)
     def _init():
@@ -136,7 +136,11 @@ def decode_attention(q, k_cache, v_cache, cache_len,
                      interpret: bool = False):
     """q: [B, T, Hq, D] new-token queries at positions
     [cache_len, cache_len + T); k_cache/v_cache: [B, max_len, Hkv, D]
-    with the new tokens already written. Returns [B, T, Hq, D]."""
+    with the new tokens already written. Returns [B, T, Hq, D].
+
+    cache_len may be a scalar (shared live length, the classic batched
+    path) or a [B] vector (per-slot lengths — the continuous-batching
+    serving path, where every slot is at a different position)."""
     b, t, hq, d = q.shape
     max_len, hkv = k_cache.shape[1], k_cache.shape[2]
     g = hq // hkv
@@ -155,7 +159,8 @@ def decode_attention(q, k_cache, v_cache, cache_len,
     if rows != t * g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - t * g), (0, 0)))
 
-    len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
+    len_arr = jnp.broadcast_to(
+        jnp.asarray(cache_len, jnp.int32).reshape(-1), (b,))
 
     def kv_map(bi, ki, len_ref):
         # Clamp dead blocks to the last live one: Mosaic elides the
@@ -163,7 +168,7 @@ def decode_attention(q, k_cache, v_cache, cache_len,
         # block, so per-step traffic scales with the LIVE cache length,
         # not max_len (the splash-attention trick; the compute for those
         # steps is already predicated off by `run` in the kernel).
-        last_live = (len_ref[0] + t - 1) // block_k
+        last_live = (len_ref[bi] + t - 1) // block_k
         return (bi, jnp.minimum(ki, last_live), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

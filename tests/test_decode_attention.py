@@ -108,3 +108,24 @@ def test_decode_step_routes_through_kernel():
     np.testing.assert_allclose(jax.device_get(on_step),
                                jax.device_get(off_step),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_kernel_per_slot_vector_lengths():
+    """The continuous-batching path hands the kernel a [B] length vector
+    (every slot at a different position); per-row masking and block
+    clamping must match the per-row reference."""
+    b, t, hq, hkv, d, max_len = 4, 1, 8, 2, 128, 256
+    key = jax.random.key(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (b, max_len, hkv, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (b, max_len, hkv, d), jnp.float32)
+    lengths = jnp.asarray([0, 17, 100, 255], jnp.int32)
+
+    got = decode_attention(q, k_cache, v_cache, lengths, interpret=True)
+    for i in range(b):
+        want = reference(q[i:i + 1], k_cache[i:i + 1], v_cache[i:i + 1],
+                         jnp.int32(int(lengths[i])))
+        np.testing.assert_allclose(
+            jax.device_get(got[i:i + 1]), jax.device_get(want),
+            rtol=2e-5, atol=2e-5, err_msg=f"slot {i}")

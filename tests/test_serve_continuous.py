@@ -1,0 +1,172 @@
+"""Continuous (in-flight) batching engine: greedy parity with direct
+generate(), mid-flight admission, and the serving-density property that
+motivated it (VERDICT r2 weak #5 / ROADMAP item 6)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from container_engine_accelerators_tpu.cli.serve import ContinuousEngine
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models.decode import generate
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+@pytest.fixture()
+def engine(model):
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, max_slots=4, max_len=256,
+                           prompt_bucket=16, max_prompt_len=128)
+    yield eng
+    eng.stop()
+
+
+def direct(params, cfg, tokens, n_new):
+    out = generate(params, jnp.asarray([tokens], jnp.int32), cfg, n_new)
+    return [int(t) for t in out[0]]
+
+
+def test_greedy_parity_mixed_lengths(model, engine):
+    """Concurrent mixed-shape greedy requests must each match a direct
+    single-request generate() exactly: per-slot lengths, per-slot
+    positions, and prompt padding must not leak between slots."""
+    params, cfg = model
+    reqs = [([1, 2, 3], 5), ([4, 5], 7), ([9, 8, 7, 6, 5, 4], 3),
+            ([17] * 20, 6), ([2], 4)]
+    futs = [engine.submit(list(t), n, 0.0) for t, n in reqs]
+    for (t, n), fut in zip(reqs, futs):
+        got = fut.result(timeout=120)
+        assert got == direct(params, cfg, t, n), (t, n)
+
+
+def test_inflight_admission(model, engine):
+    """A short request submitted while a long one is mid-decode must be
+    admitted into the RUNNING batch and finish first — the property the
+    window engine lacks (it drains the current batch before starting
+    the next)."""
+    long_fut = engine.submit([1, 2, 3], 200, 0.0)
+    # Wait until the long request is demonstrably mid-decode.
+    deadline = time.monotonic() + 60
+    while engine.steps_run < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert engine.steps_run >= 3
+    steps_at_submit = engine.steps_run
+    short_fut = engine.submit([4, 5], 3, 0.0)
+    short = short_fut.result(timeout=120)
+    assert not long_fut.done(), \
+        "short request should finish while the long one is still decoding"
+    assert len(short) == 5
+    # The short request rode the in-flight batch: it completed within a
+    # few steps of submission, not after the long request's 200.
+    assert engine.steps_run - steps_at_submit < 60
+    assert len(long_fut.result(timeout=300)) == 203
+
+
+def test_decode_steps_scale_with_longest_not_sum(model):
+    """Density property: K concurrent mixed requests cost ~max(max_new)
+    decode iterations, not sum(max_new) — the measurable form of the
+    throughput gain under mixed traffic (a bucketed/serial engine pays
+    each bucket separately)."""
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, max_slots=4, max_len=256,
+                           prompt_bucket=16, max_prompt_len=128)
+    try:
+        reqs = [([1, 2, 3], 40), ([4, 5], 37), ([6] * 9, 33),
+                ([7, 8, 9, 1], 25)]
+        futs = [eng.submit(list(t), n, 0.0) for t, n in reqs]
+        for f in futs:
+            f.result(timeout=300)
+        total_new = sum(n for _, n in reqs)          # 135
+        longest = max(n for _, n in reqs)            # 40
+        # All four decode concurrently in one slot pool: the iteration
+        # count tracks the longest request (+ admission skew), far below
+        # the serial sum.
+        assert eng.steps_run <= longest + 10, eng.steps_run
+        assert eng.steps_run < total_new * 0.5
+        assert eng.requests_served == 4
+    finally:
+        eng.stop()
+
+
+def test_temperature_zero_and_sampled_coexist(model, engine):
+    """Greedy and sampled requests share one batch (per-slot temps);
+    the greedy one must still match direct generate()."""
+    params, cfg = model
+    g_fut = engine.submit([1, 2, 3], 5, 0.0)
+    s_fut = engine.submit([1, 2, 3], 5, 0.9)
+    g = g_fut.result(timeout=120)
+    s = s_fut.result(timeout=120)
+    assert g == direct(params, cfg, [1, 2, 3], 5)
+    assert len(s) == 8
+    assert all(0 <= t < cfg.vocab_size for t in s)
+
+
+def test_slot_reuse_after_completion(model, engine):
+    """More requests than slots: later requests recycle freed slots and
+    still match direct generate()."""
+    params, cfg = model
+    reqs = [([i + 1, i + 2], 4 + (i % 3)) for i in range(10)]
+    futs = [engine.submit(list(t), n, 0.0) for t, n in reqs]
+    for (t, n), fut in zip(reqs, futs):
+        assert fut.result(timeout=300) == direct(params, cfg, t, n)
+    assert engine.requests_served >= 10
+
+
+def test_http_roundtrip_continuous(model):
+    """Full HTTP path over the continuous engine (make_server is
+    engine-agnostic; this pins that contract)."""
+    import json
+    import urllib.request
+
+    from container_engine_accelerators_tpu.cli.serve import make_server
+
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, max_slots=2, max_len=128,
+                           prompt_bucket=16, max_prompt_len=64)
+    server = make_server(eng, 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            got = json.loads(resp.read())["tokens"]
+        assert got == direct(params, cfg, [1, 2, 3], 4)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["requests"] == 1
+    finally:
+        eng.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_bucketed_prompt_must_fit_cache(model):
+    """A prompt whose BUCKETED length exceeds max_len must be rejected at
+    submit (prefill would otherwise try to write past the cache and kill
+    the worker)."""
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, max_slots=2, max_len=40,
+                           prompt_bucket=32, max_prompt_len=64)
+    try:
+        fut = eng.submit([1] * 34, 2, 0.0)  # buckets to 64 > 40
+        with pytest.raises(ValueError, match="bucketed"):
+            fut.result(timeout=30)
+        # A fitting request on the same engine still works.
+        ok = eng.submit([1, 2, 3], 2, 0.0).result(timeout=120)
+        assert ok == direct(params, cfg, [1, 2, 3], 2)
+    finally:
+        eng.stop()
