@@ -21,7 +21,8 @@ QUICK_TESTS = tests/test_deviceplugin.py tests/test_healthcheck.py \
     tests/test_scheduler.py tests/test_partition_tpu.py \
     tests/test_partitioned_stack.py tests/test_manifests.py \
     tests/test_nri.py tests/test_native.py tests/test_dataset.py \
-    tests/test_real_log_fixtures.py tests/test_installers.py
+    tests/test_real_log_fixtures.py tests/test_installers.py \
+    tests/test_nri_golden.py
 
 test-quick:
 	$(PYTHON) -m pytest $(QUICK_TESTS) -q

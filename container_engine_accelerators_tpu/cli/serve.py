@@ -27,6 +27,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("tpu-serve")
 
 
+def _validate_request(tokens, max_new_tokens, max_prompt_len,
+                      fut) -> bool:
+    """Shared request validation for both engines; fails `fut` and
+    returns False on a bad request."""
+    if not tokens or len(tokens) > max_prompt_len:
+        fut.set_exception(ValueError(
+            f"prompt length must be in [1, {max_prompt_len}]"))
+        return False
+    if max_new_tokens < 1 or max_new_tokens > 1024:
+        fut.set_exception(ValueError(
+            "max_new_tokens must be in [1, 1024]"))
+        return False
+    return True
+
+
 class BatchingEngine:
     def __init__(self, params, cfg, max_batch: int = 8,
                  window_ms: float = 5.0, max_prompt_len: int = 1024):
@@ -46,13 +61,8 @@ class BatchingEngine:
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        if not tokens or len(tokens) > self.max_prompt_len:
-            fut.set_exception(ValueError(
-                f"prompt length must be in [1, {self.max_prompt_len}]"))
-            return fut
-        if max_new_tokens < 1 or max_new_tokens > 1024:
-            fut.set_exception(ValueError(
-                "max_new_tokens must be in [1, 1024]"))
+        if not _validate_request(tokens, max_new_tokens,
+                                 self.max_prompt_len, fut):
             return fut
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut))
         return fut
@@ -149,9 +159,18 @@ class ContinuousEngine:
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_len: int = 2048, prompt_bucket: int = 64,
                  max_prompt_len: int = 1024):
+        from container_engine_accelerators_tpu.models.decode import (
+            _kernel_eligible,
+        )
+
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
+        if _kernel_eligible(cfg):
+            # Same rounding generate() applies: the pallas decode kernel
+            # requires max_len % 128 == 0, and a raw --max-len like 2000
+            # would otherwise silently disqualify it on EVERY step.
+            max_len = -(-max_len // 128) * 128
         self.max_len = max_len
         self.prompt_bucket = prompt_bucket
         self.max_prompt_len = max_prompt_len
@@ -168,13 +187,8 @@ class ContinuousEngine:
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        if not tokens or len(tokens) > self.max_prompt_len:
-            fut.set_exception(ValueError(
-                f"prompt length must be in [1, {self.max_prompt_len}]"))
-            return fut
-        if max_new_tokens < 1 or max_new_tokens > 1024:
-            fut.set_exception(ValueError(
-                "max_new_tokens must be in [1, 1024]"))
+        if not _validate_request(tokens, max_new_tokens,
+                                 self.max_prompt_len, fut):
             return fut
         # The prompt is padded UP to a bucket multiple before prefill,
         # so the bucketed length (not the raw one) must fit the cache.
