@@ -9,12 +9,14 @@ its stated target for this stack).
 Config is a width-2048 GQA decoder (head_dim 128 so the pallas flash
 attention kernel engages), bf16 activations, remat='dots', adamw.
 
-The headline value uses the MEDIAN step time (VERDICT r1 item 2
-prescribed median-of-steps/best-window hardening: the tunnel environment
-injects one-off stalls a thin wall-clock window cannot reject).
-Wall-clock throughput and MFU are reported alongside in the same JSON
-line so the estimator choice is always visible; a systematic gap
-between the two is the signal to distrust the median.
+Timing is PIPELINED (round 3): steps are enqueued back-to-back and
+fenced once per window, the way any real training loop runs. The round-2
+per-step fence charged every step a full host round trip, which the
+axon tunnel makes ~68 ms (tools/component_bench.py null-dispatch
+measurement) — a 17% tax no deployment pays. Stall robustness (VERDICT
+r1 item 2) is kept by timing MULTIPLE independent windows and taking
+the median window; wall-clock over all windows is reported alongside so
+a systematic gap between the two estimators stays visible.
 """
 
 from __future__ import annotations
@@ -59,7 +61,10 @@ def main():
         n_kv_heads=8, d_ff=8192, max_seq_len=2048, remat_policy="dots",
         dtype=jnp.bfloat16)
     batch_size, seq_len = 5, 2048
-    warmup_steps, bench_steps = 3, 16
+    warmup_steps = 3
+    # 5 windows: the median still reads true with up to two windows hit
+    # by the tunnel's one-off multi-hundred-ms stalls.
+    n_windows, window_steps = 5, 8
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
@@ -69,31 +74,38 @@ def main():
     state = create_train_state(jax.random.key(0), cfg, mesh, opt)
     step_fn = make_train_step(cfg, mesh, opt)
 
+    bench_steps = n_windows * window_steps
     batches = synthetic_batches(cfg.vocab_size, batch_size, seq_len,
                                 num_batches=warmup_steps + bench_steps)
     batches = [shard_batch(b, mesh) for b in batches]
 
-    # Synchronize by fetching the loss to host each step: on the axon
-    # tunnel platform block_until_ready returns before execution finishes
+    # Synchronize warmup by fetching the loss: on the axon tunnel
+    # platform block_until_ready returns before execution finishes
     # (donated buffers report ready), so device_get is the only reliable
     # fence.
     for b in batches[:warmup_steps]:
         state, metrics = step_fn(state, b)
         float(metrics["loss"])
 
-    # Per-step timing with a median estimator: the tunnel/remote-compile
-    # environment occasionally injects multi-hundred-ms stalls into a
-    # single step, which a single wall-clock window over few steps cannot
-    # distinguish from genuinely slower compute.
-    step_times = []
-    for b in batches[warmup_steps:]:
+    # Pipelined windows: enqueue window_steps steps back-to-back, fence
+    # once on the final loss (the chained state dependency serializes the
+    # steps, so the fence covers the whole window). Median-of-windows
+    # rejects the tunnel's occasional multi-hundred-ms one-off stalls the
+    # way round 2's median-of-steps did, without charging every step a
+    # ~68 ms host round trip that no real training loop pays.
+    window_times = []
+    it = iter(batches[warmup_steps:])
+    for _ in range(n_windows):
         t0 = time.perf_counter()
-        state, metrics = step_fn(state, b)
-        float(metrics["loss"])
-        step_times.append(time.perf_counter() - t0)
-    wall_dt = sum(step_times)
-    step_times.sort()
-    median_dt = step_times[len(step_times) // 2]
+        last = None
+        for _ in range(window_steps):
+            state, metrics = step_fn(state, next(it))
+            last = metrics["loss"]
+        float(last)
+        window_times.append(time.perf_counter() - t0)
+    wall_dt = sum(window_times)
+    window_times.sort()
+    median_dt = window_times[len(window_times) // 2] / window_steps
 
     tokens_per_step = batch_size * seq_len
     tok_per_sec_per_chip = tokens_per_step / median_dt / n_dev
@@ -103,21 +115,21 @@ def main():
     mfu = tok_per_sec_per_chip * flops_per_token / peak
     wall_mfu = wall_tok_per_sec * flops_per_token / peak
 
-    print(f"step times (s): min={step_times[0]:.4f} "
-          f"median={median_dt:.4f} max={step_times[-1]:.4f}",
+    print(f"window step times (s): "
+          f"{[round(w / window_steps, 4) for w in window_times]}",
           file=sys.stderr)
     # vs_baseline keys on the WALL-CLOCK estimator: the 0.40-MFU north
-    # star predates the median-step metric, and wall clock is the
-    # conservative one (median systematically reads a bit higher), so
-    # cross-round comparisons stay apples-to-apples. The median stays as
-    # a robustness diagnostic in `value`/`unit`.
+    # star predates the windowed metric, and wall clock is the
+    # conservative one (the median window reads a bit higher), so
+    # cross-round comparisons stay apples-to-apples. The median window
+    # stays as a robustness diagnostic in `value`/`unit`.
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
         "unit": f"tokens/s/chip (MFU={mfu:.3f})",
         "vs_baseline": round(wall_mfu / 0.40, 3),
         "vs_baseline_estimator": "wallclock",
-        "estimator": "median-step",
+        "estimator": "median-window-pipelined",
         "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
         "wallclock_mfu": round(wall_mfu, 3),
     }))

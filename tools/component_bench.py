@@ -1,0 +1,198 @@
+"""Component-level timing for the bench config (VERDICT r2 item 8).
+
+Times each forward component of the bench model shape in isolation,
+inside a single jit with a lax.scan repeat (so per-dispatch/tunnel
+overhead is amortized away — the round-2 'model shapes ceiling' numbers
+were measured per-dispatch and understate fused throughput).
+
+Components:
+  - matmul(m,k,n): stacked-weight scan matmul at the MLP/vocab shapes
+  - mlp: full gated MLP block (3 matmuls + silu + mul)
+  - attn_proj: q/k/v/o projections
+  - flash_fwd: pallas causal flash attention forward
+  - flash_train: flash attention fwd+bwd via value_and_grad
+  - norm_rope: rms_norm + rope (HBM-bound elementwise)
+
+Prints one JSON line per component with achieved TFLOP/s and fraction
+of the 197 TFLOP/s v5e bf16 peak.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+PEAK = 197e12
+B, S, D, F, H, KV, HD = 5, 2048, 2048, 8192, 16, 8, 128
+L = 8  # scan length — amortizes dispatch, mimics stacked-layer weights
+
+
+def timed(fn, *args, iters=8, warmup=2):
+    # Reduce to a scalar INSIDE jit: fetching a large array over the
+    # tunnel costs seconds and would swamp the compute being measured.
+    sfn = jax.jit(lambda *a: jnp.sum(fn(*a).astype(jnp.float32)))
+    for _ in range(warmup):
+        jax.device_get(sfn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(sfn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def report(name, median_s, flops):
+    tflops = flops / median_s / 1e12
+    print(json.dumps({
+        "component": name, "median_s": round(median_s, 5),
+        "tflops": round(tflops, 1), "frac_peak": round(tflops * 1e12 / PEAK, 3),
+    }), flush=True)
+
+
+def scan_op(body, x, weights):
+    def step(carry, w):
+        return body(carry, w), None
+    y, _ = jax.lax.scan(step, x, weights)
+    return y
+
+
+def main():
+    key = jax.random.key(0)
+    tok = B * S
+
+    # --- stacked matmul at MLP up-proj shape [tok, D] x [D, F]
+    x = jax.random.normal(key, (tok, D), jnp.bfloat16)
+    w = jax.random.normal(key, (L, D, F), jnp.bfloat16)
+
+    @jax.jit
+    def mm_up(x, w):
+        # carry stays [tok, D]: project up then contract back (two matmuls)
+        def body(c, wi):
+            h = c @ wi
+            return (h @ wi.T).astype(jnp.bfloat16)
+        return scan_op(body, x, w)
+
+    t = timed(mm_up, x, w)
+    report("matmul_upT_down", t, L * 2 * 2 * tok * D * F)
+
+    # --- vocab-shape matmul [tok, D] x [D, 32768]
+    V = 32768
+    wv = jax.random.normal(key, (D, V), jnp.bfloat16)
+
+    @jax.jit
+    def mm_vocab(x, wv):
+        def body(c, _):
+            out = jnp.einsum("td,dv->tv", c, wv,
+                             preferred_element_type=jnp.float32)
+            return c + out[:, :D].astype(jnp.bfloat16) * 1e-6, None
+        y, _ = jax.lax.scan(body, x, jnp.arange(4))
+        return y
+
+    t = timed(mm_vocab, x, wv)
+    report("matmul_vocab_f32acc", t, 4 * 2 * tok * D * V)
+
+    # --- full gated MLP block, stacked weights, scan over L
+    wg = jax.random.normal(key, (L, D, F), jnp.bfloat16)
+    wu = jax.random.normal(key, (L, D, F), jnp.bfloat16)
+    wd = jax.random.normal(key, (L, F, D), jnp.bfloat16)
+
+    @jax.jit
+    def mlp(x, wg, wu, wd):
+        def body(c, ws):
+            g, u, d = ws
+            h = jax.nn.silu(c @ g) * (c @ u)
+            return c + h @ d
+        return scan_op(body, x, (wg, wu, wd))
+
+    t = timed(mlp, x, wg, wu, wd)
+    report("mlp_block", t, L * 3 * 2 * tok * D * F)
+
+    # --- attention projections q/k/v/o
+    wq = jax.random.normal(key, (L, D, H * HD), jnp.bfloat16)
+    wk = jax.random.normal(key, (L, D, KV * HD), jnp.bfloat16)
+    wvp = jax.random.normal(key, (L, D, KV * HD), jnp.bfloat16)
+    wo = jax.random.normal(key, (L, H * HD, D), jnp.bfloat16)
+
+    @jax.jit
+    def attn_proj(x, wq, wk, wvp, wo):
+        def body(c, ws):
+            q, k, v, o = ws
+            qq = c @ q
+            kk = c @ k
+            vv = c @ v
+            return c + qq @ o + jnp.pad(kk + vv, ((0, 0), (0, D - KV * HD)))
+        return scan_op(body, x, (wq, wk, wvp, wo))
+
+    t = timed(attn_proj, x, wq, wk, wvp, wo)
+    flops = L * 2 * tok * D * HD * (2 * H + 2 * KV)
+    report("attn_projections", t, flops)
+
+    # --- flash attention forward (bench shape, GQA repeated inside)
+    from container_engine_accelerators_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+    q = jax.random.normal(key, (B, S, H, HD), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, KV, HD), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, KV, HD), jnp.bfloat16)
+
+    @jax.jit
+    def flash_l(q, k, v):
+        def body(c, _):
+            return flash_attention(c, k, v, causal=True), None
+        y, _ = jax.lax.scan(body, q, jnp.arange(L))
+        return y
+
+    t = timed(flash_l, q, k, v)
+    causal_flops = L * 2 * B * H * S * S * HD  # qk + pv, halved for causal
+    report("flash_fwd", t, causal_flops)
+
+    # --- flash attention train (fwd+bwd)
+    @jax.jit
+    def flash_train(q, k, v):
+        def loss(q):
+            def body(c, _):
+                return flash_attention(c, k, v, causal=True), None
+            y, _ = jax.lax.scan(body, q, jnp.arange(L))
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        return jax.grad(loss)(q)
+
+    t = timed(flash_train, q, k, v)
+    report("flash_train", t, 3 * causal_flops)
+
+    # --- norm + rope elementwise (HBM-bound)
+    from container_engine_accelerators_tpu.ops import (
+        apply_rope, rms_norm, rope_frequencies,
+    )
+    cos, sin = rope_frequencies(HD, S, 500_000.0)
+    gamma = jnp.ones((D,), jnp.float32)
+    xb = jax.random.normal(key, (B, S, D), jnp.bfloat16)
+
+    @jax.jit
+    def norm_rope(xb):
+        def body(c, _):
+            h = rms_norm(c, gamma, 1e-5)
+            qh = h.reshape(B, S, H, HD)
+            qh = apply_rope(qh, cos, sin)
+            return c + qh.reshape(B, S, D) * 1e-6, None
+        y, _ = jax.lax.scan(body, xb, jnp.arange(L))
+        return y
+
+    t = timed(norm_rope, xb)
+    # report bandwidth instead of flops: bytes ~ L * 4 passes * size
+    nbytes = L * 4 * xb.size * 2
+    print(json.dumps({
+        "component": "norm_rope", "median_s": round(t, 5),
+        "gbps": round(nbytes / t / 1e9, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Single-chip MFU attribution sweep (VERDICT r2 item 8).
+
+Measures the bench config's step time under controlled variations —
+remat policy, batch size, flash on/off — plus a forward-only timing and
+device memory stats, so the gap between measured MFU and the practical
+matmul ceiling (BASELINE.md: 0.55-0.68 on this chip) is *attributed*
+rather than guessed at.
+
+The key accounting fact: bench MFU counts 6N FLOPs/token (PaLM fwd+bwd)
+but `remat_policy='dots'` (dots_with_no_batch_dims_saveable) recomputes
+nearly the whole forward during backward, so the chip executes ~8N.
+A policy that saves matmul outputs ('dots_all') removes the extra 2N at
+the cost of ~b*s*(4d+2f) bf16 residuals per layer.
+
+Usage:
+    python tools/mfu_sweep.py                  # default sweep
+    python tools/mfu_sweep.py dots_all:5 dots:5 none:5   # policy:batch list
+
+Prints one JSON line per variant:
+    {"variant": "...", "median_step_s": ..., "mfu": ...,
+     "hbm_peak_gb": ..., "fwd_median_s": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+
+def _sync(x):
+    jax.device_get(x)
+
+
+def measure(policy: str, batch_size: int, *, seq_len: int = 2048,
+            use_flash=None, steps: int = 10, warmup: int = 2,
+            fwd_only_too: bool = True) -> dict:
+    from bench import detect_peak_flops
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+    from container_engine_accelerators_tpu.training import (
+        create_train_state, make_optimizer, make_train_step)
+    from container_engine_accelerators_tpu.training.data import (
+        synthetic_batches,
+    )
+    from container_engine_accelerators_tpu.training.train import shard_batch
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=seq_len, remat_policy=policy,
+        use_flash=use_flash, dtype=jnp.bfloat16)
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
+                     devices=jax.devices())
+    opt = make_optimizer(warmup_steps=10, decay_steps=1000)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    step_fn = make_train_step(cfg, mesh, opt)
+    batches = [shard_batch(b, mesh) for b in synthetic_batches(
+        cfg.vocab_size, batch_size, seq_len, num_batches=warmup + steps)]
+
+    for b in batches[:warmup]:
+        state, metrics = step_fn(state, b)
+        _sync(metrics["loss"])
+    # Pipelined timing: enqueue all steps, fence once on the final loss.
+    # The tunnel adds ~68 ms per host round trip (tools/component_bench
+    # null-dispatch measurement); per-step fencing charges that latency
+    # to every step, which no real training loop pays.
+    t0 = time.perf_counter()
+    last = None
+    for b in batches[warmup:]:
+        state, metrics = step_fn(state, b)
+        last = metrics["loss"]
+    _sync(last)
+    median = (time.perf_counter() - t0) / steps
+
+    dev = jax.devices()[0]
+    stats = dev.memory_stats() or {}
+    peak_gb = stats.get("peak_bytes_in_use", 0) / 2**30
+
+    result = {
+        "variant": f"{policy}:b{batch_size}:s{seq_len}"
+                   + ("" if use_flash is None else f":flash={use_flash}"),
+        "step_s": round(median, 4),
+        "hbm_peak_gb": round(peak_gb, 2),
+    }
+
+    tokens = batch_size * seq_len
+    peak = detect_peak_flops()
+    result["tokens_per_s"] = round(tokens / median, 1)
+    result["mfu"] = round(
+        tokens / median * cfg.train_flops_per_token(seq_len) / peak, 4)
+
+    if fwd_only_too:
+        # Forward-only timing isolates bwd+update cost. Loss fetch is the
+        # fence (block_until_ready is unreliable on the tunnel platform).
+        from container_engine_accelerators_tpu.parallel import sharding as shd
+        from container_engine_accelerators_tpu.training.train import loss_fn
+        constrain = shd.make_constrain(mesh, sequence_parallel=False)
+        fwd = jax.jit(lambda p, b: loss_fn(p, b, cfg, constrain, mesh))
+        for b in batches[:warmup]:
+            _sync(fwd(state.params, b))
+        ftimes = []
+        for b in batches[warmup:warmup + 5]:
+            t0 = time.perf_counter()
+            _sync(fwd(state.params, b))
+            ftimes.append(time.perf_counter() - t0)
+        ftimes.sort()
+        result["fwd_median_s"] = round(ftimes[len(ftimes) // 2], 4)
+    return result
+
+
+def main():
+    variants = sys.argv[1:] or [
+        "dots:5", "dots_all:5", "dots_all:8", "none:5"]
+    for spec in variants:
+        parts = spec.split(":")
+        policy, bs = parts[0], int(parts[1])
+        seq = int(parts[2]) if len(parts) > 2 else 2048
+        try:
+            r = measure(policy, bs, seq_len=seq)
+        except Exception as e:  # OOM is an expected, informative outcome
+            r = {"variant": spec, "error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()
