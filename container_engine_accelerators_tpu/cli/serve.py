@@ -320,6 +320,307 @@ class ContinuousEngine:
         slots[i] = None
 
 
+class PagedContinuousEngine(ContinuousEngine):
+    """Continuous batching over a PAGED KV cache: slots share a page
+    pool sized in HBM pages, not in slots x max_len reservations — the
+    pool can be far smaller than the slots' combined logical capacity,
+    and long-sequence slots only hold the pages they have actually
+    filled (ROADMAP item 6's final step; models/decode.py PagedKVCache).
+
+    Page lifecycle (all host-side, between device steps):
+      - admit: allocate the prompt's pages; hold the request in queue if
+        the pool can't cover them right now;
+      - decode: before each step, slots whose next token crosses a page
+        boundary get a fresh page via one masked assign_pages scatter;
+      - exhaustion: when no page is free, PREEMPT the youngest request —
+        free its pages and requeue it (prompt + generated-so-far becomes
+        the new prompt, with its remaining budget), vLLM-style;
+      - finish: pages return to the free list.
+
+    _worker deliberately restates the continuous loop rather than
+    threading page hooks through the base class: admission goes through
+    a backlog (page pressure can defer the queue head), device-error
+    recovery must also fail backlogged requests, and page growth sits
+    between admission and the step — the control flow differs at every
+    extension point a hook interface would need. Both loops are pinned
+    by their own engine test suites (test_serve_continuous.py /
+    test_serve_paged.py).
+    """
+
+    def __init__(self, params, cfg, max_slots: int = 8,
+                 max_len: int = 2048, page: int = 128,
+                 pool_pages: int | None = None,
+                 max_prompt_len: int = 1024):
+        import math
+
+        from container_engine_accelerators_tpu.models.decode import (
+            _kernel_eligible,
+        )
+
+        # Logical per-slot capacity rounds to page multiples; the prompt
+        # bucket IS the page so prefill scatters whole pages. When the
+        # pallas kernel is eligible the base __init__ ALSO rounds
+        # max_len up to a 128 multiple — round to lcm(page, 128) here so
+        # that rounding is already a no-op and max_pages * page stays
+        # exactly the self.max_len that submit() validates against (a
+        # mismatch would let requests run past the real logical capacity
+        # and silently overwrite the last KV position).
+        quantum = math.lcm(page, 128) if _kernel_eligible(cfg) else page
+        max_len = -(-max_len // quantum) * quantum
+        self.page = page
+        self.max_pages = max_len // page
+        # Default pool: half the full-reservation footprint (+ trash
+        # row) — the oversubscription that pays for paging.
+        self.pool_pages = pool_pages or (
+            max_slots * self.max_pages // 2 + 1)
+        self.preemptions = 0
+        super().__init__(params, cfg, max_slots=max_slots,
+                         max_len=max_len, prompt_bucket=page,
+                         max_prompt_len=max_prompt_len)
+        assert self.max_len == self.max_pages * self.page
+
+    def submit(self, tokens, max_new_tokens, temperature):
+        """Reject prompts whose pages can NEVER all be free at once —
+        admission would otherwise retry forever, head-of-line blocking
+        every later request while the worker spins."""
+        bucketed = -(-len(tokens) // self.page) * self.page
+        if bucketed // self.page > self.pool_pages - 1:
+            import concurrent.futures as cf
+            fut: cf.Future = cf.Future()
+            fut.set_exception(ValueError(
+                f"prompt needs {bucketed // self.page} pages but the "
+                f"pool has only {self.pool_pages - 1} usable; raise "
+                "--pool-pages"))
+            return fut
+        return super().submit(tokens, max_new_tokens, temperature)
+
+    # ---------- worker ----------
+
+    def _worker(self):
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.models.decode import (
+            PageAllocator,
+            _jitted_assign_pages,
+            _jitted_decode_step_paged,
+            _jitted_pick_tokens,
+            _jitted_prefill_slot_paged,
+            init_paged_cache,
+        )
+
+        s = self.max_slots
+        page = self.page
+
+        def fresh_cache():
+            return (init_paged_cache(self.cfg, s, self.pool_pages, page,
+                                     self.max_pages),
+                    PageAllocator(self.pool_pages))
+
+        cache, alloc = fresh_cache()
+        step_fn = _jitted_decode_step_paged(self.cfg)
+        prefill_fn = _jitted_prefill_slot_paged(self.cfg)
+        assign_fn = _jitted_assign_pages()
+        pick_fn = _jitted_pick_tokens()
+        base_key = jax.random.key(0)
+
+        slots: list[dict | None] = [None] * s
+        last_tok = [0] * s
+        temps = [0.0] * s
+        backlog: list = []  # requests waiting for slots OR pages
+
+        def free_slot_pages(i):
+            if slots[i] and slots[i]["rows"]:
+                alloc.free(slots[i]["rows"])
+                slots[i]["rows"] = []
+
+        def finish(i):
+            free_slot_pages(i)
+            self._finish(i, slots)
+
+        def preempt_youngest(exclude: int | None = None) -> int | None:
+            """Free the most recently admitted request's pages and
+            requeue it (generated tokens become part of its next
+            prompt). Returns the victim slot, or None if none is
+            preemptible."""
+            victims = [i for i, sl in enumerate(slots)
+                       if sl is not None and i != exclude]
+            if not victims:
+                return None
+            i = max(victims, key=lambda j: slots[j]["admitted"])
+            sl = slots[i]
+            free_slot_pages(i)
+            # Requeue at the FRONT: preempted work keeps priority.
+            backlog.insert(0, (tuple(sl["out"]), sl["remaining"],
+                               sl["temp"], sl["fut"]))
+            slots[i] = None
+            self.preemptions += 1
+            return i
+
+        def admit_one(item, slot_idx) -> bool:
+            """False = not enough pages right now (item NOT consumed)."""
+            tokens, n_new, temp, fut = item
+            tp = -(-len(tokens) // page) * page
+            if tp // page > self.pool_pages - 1:
+                # Can never be satisfied (a PREEMPTED request's regrown
+                # prompt can exceed what submit() validated) — fail it
+                # instead of head-of-line blocking the backlog forever.
+                if not fut.done():
+                    fut.set_exception(RuntimeError(
+                        f"request needs {tp // page} prompt pages but "
+                        f"the pool has only {self.pool_pages - 1} "
+                        "usable; raise --pool-pages"))
+                return True  # consumed
+            rows = alloc.alloc(tp // page)
+            if rows is None:
+                return False
+            padded = list(tokens) + [0] * (tp - len(tokens))
+            nonlocal cache
+            last_logits, cache = prefill_fn(
+                self.params, cache, jnp.int32(slot_idx),
+                jnp.asarray(rows, jnp.int32),
+                jnp.asarray(padded, jnp.int32), jnp.int32(len(tokens)))
+            self.prefills_run += 1
+            key = jax.random.fold_in(base_key,
+                                     self.prefills_run & 0xFFFFFFF)
+            tok = int(pick_fn(last_logits[None, :],
+                              jnp.asarray([temp], jnp.float32), key)[0])
+            slots[slot_idx] = {
+                "fut": fut, "remaining": n_new - 1,
+                "out": list(tokens) + [tok], "temp": temp,
+                "rows": rows, "len": len(tokens),
+                "admitted": self.prefills_run}
+            last_tok[slot_idx] = tok
+            temps[slot_idx] = temp
+            if n_new == 1:
+                finish(slot_idx)
+            return True
+
+        def reset_after_device_error(err):
+            nonlocal cache, alloc
+            for i, sl in enumerate(slots):
+                if sl is not None and not sl["fut"].done():
+                    sl["fut"].set_exception(err)
+                slots[i] = None
+            for item in backlog:
+                if not item[3].done():
+                    item[3].set_exception(err)
+            backlog.clear()
+            cache, alloc = fresh_cache()
+
+        def grow_pages() -> bool:
+            """Give every active slot whose next write crosses into an
+            unallocated page a fresh page (one masked scatter); preempts
+            on exhaustion. False = a device error was handled."""
+            import numpy as np
+            nonlocal cache
+            mask = np.zeros(s, bool)
+            pos = np.zeros(s, np.int32)
+            rws = np.zeros(s, np.int32)
+            for i, sl in enumerate(slots):
+                if sl is None:
+                    continue
+                pg = sl["len"] // page
+                if pg < len(sl["rows"]):
+                    continue  # current page still has room
+                if pg >= self.max_pages:
+                    continue  # at logical capacity; write clamps
+                row = None
+                while row is None:
+                    got = alloc.alloc(1)
+                    if got is not None:
+                        row = got[0]
+                        continue
+                    victim = preempt_youngest(exclude=i)
+                    if victim is None:
+                        # Only this slot is left and the pool is empty:
+                        # the pool is simply too small for the request.
+                        sl["fut"].set_exception(RuntimeError(
+                            "page pool exhausted and no preemptible "
+                            "request left; raise --pool-pages"))
+                        free_slot_pages(i)
+                        slots[i] = None
+                        break
+                    # A victim that was granted a page earlier in THIS
+                    # sweep must not have it written: the row is back in
+                    # the free list and may be handed out right here.
+                    mask[victim] = False
+                if slots[i] is None:
+                    continue
+                sl["rows"].append(row)
+                mask[i] = True
+                pos[i] = pg
+                rws[i] = row
+            if mask.any():
+                try:
+                    cache = assign_fn(cache, jnp.asarray(pos),
+                                      jnp.asarray(rws), jnp.asarray(mask))
+                except Exception as e:
+                    log.exception("assign_pages failed")
+                    reset_after_device_error(e)
+                    return False
+            return True
+
+        while not self._stop.is_set():
+            idle = all(sl is None for sl in slots)
+            # Pull new traffic into the backlog, then admit from the
+            # backlog in order while slots AND pages allow.
+            while True:
+                try:
+                    backlog.append(self.queue.get(
+                        timeout=0.05 if idle and not backlog else 0.0))
+                except queue.Empty:
+                    break
+            free = [i for i in range(s) if slots[i] is None]
+            while backlog and free:
+                try:
+                    if not admit_one(backlog[0], free[0]):
+                        break  # pages exhausted: retry next loop
+                    backlog.pop(0)
+                    if slots[free[0]] is not None:  # actually admitted
+                        free.pop(0)
+                    idle = False
+                except Exception as e:
+                    log.exception("prefill failed")
+                    item = backlog.pop(0)
+                    if not item[3].done():
+                        item[3].set_exception(e)
+                    reset_after_device_error(e)
+                    free = []
+                    break
+            if all(sl is None for sl in slots):
+                continue
+
+            if not grow_pages():
+                continue
+            tokens_arr = jnp.asarray(last_tok, jnp.int32)
+            active_arr = jnp.asarray(
+                [sl is not None for sl in slots], bool)
+            temps_arr = jnp.asarray(temps, jnp.float32)
+            try:
+                logits, cache = step_fn(self.params, cache, tokens_arr,
+                                        active_arr)
+                self.steps_run += 1
+                self.batches_run = self.steps_run
+                key = jax.random.fold_in(base_key,
+                                         (self.steps_run & 0xFFFFFFF)
+                                         | (1 << 28))
+                toks = [int(t) for t in pick_fn(logits, temps_arr, key)]
+            except Exception as e:
+                log.exception("decode step failed")
+                reset_after_device_error(e)
+                continue
+            for i, sl in enumerate(slots):
+                if sl is None:
+                    continue
+                sl["out"].append(toks[i])
+                sl["len"] = min(sl["len"] + 1, self.max_len)
+                last_tok[i] = toks[i]
+                sl["remaining"] -= 1
+                if sl["remaining"] <= 0:
+                    finish(i)
+
+
 def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -367,14 +668,25 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--batch-window-ms", type=float, default=5.0)
-    p.add_argument("--engine", choices=("window", "continuous"),
+    p.add_argument("--engine", choices=("window", "continuous", "paged"),
                    default="window",
                    help="window = shape-bucket batch-window engine; "
                         "continuous = in-flight batching over a fixed "
                         "slot pool (admits new requests into the "
-                        "running decode batch)")
+                        "running decode batch); paged = continuous "
+                        "batching over a shared KV page pool (slots "
+                        "hold only the pages they filled; preemption "
+                        "on pool exhaustion)")
     p.add_argument("--max-len", type=int, default=2048,
-                   help="continuous engine: KV-cache capacity per slot")
+                   help="continuous/paged engine: logical KV capacity "
+                        "per slot")
+    p.add_argument("--page-size", type=int, default=128,
+                   help="paged engine: tokens per KV page (multiple of "
+                        "128 for the pallas kernel)")
+    p.add_argument("--pool-pages", type=int, default=None,
+                   help="paged engine: total pool pages incl. the "
+                        "reserved trash row (default: half the full "
+                        "slots x max_len reservation)")
     p.add_argument("--quantize-int8", action="store_true",
                    help="serve int8-quantized weights (halves weight HBM "
                         "traffic on the decode path)")
@@ -391,7 +703,11 @@ def main(argv=None) -> int:
         params = quantize_llama_params(params)
         log.info("serving int8-quantized weights")
 
-    if args.engine == "continuous":
+    if args.engine == "paged":
+        engine = PagedContinuousEngine(
+            params, cfg, max_slots=args.max_batch, max_len=args.max_len,
+            page=args.page_size, pool_pages=args.pool_pages)
+    elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len)
     else:

@@ -33,12 +33,44 @@ class KVCache(NamedTuple):
     length: jnp.ndarray  # [] int32 — tokens already cached
 
 
+class PagedKVCache(NamedTuple):
+    """Slot caches scattered over a shared page pool (vLLM-style paging,
+    TPU-shaped: every array static, the block table a scalar-prefetch
+    operand of the pallas kernel). Pool row 0 is a permanent TRASH page:
+    never allocated, it absorbs the writes of inactive slots (whose
+    table rows may already be reassigned) and backs garbage table
+    entries. HBM per slot scales with ALLOCATED pages, so a slot pool
+    can oversubscribe logical capacity: slots * max_pages pages of
+    capacity backed by only n_pages of HBM (serve.py admission/
+    preemption keeps the sum of live pages <= n_pages - 1)."""
+    k_pool: jnp.ndarray  # [L, n_pages, page, Hkv, D]
+    v_pool: jnp.ndarray  # [L, n_pages, page, Hkv, D]
+    tables: jnp.ndarray  # [slots, max_pages] int32 pool row per page
+    length: jnp.ndarray  # [slots] int32 live length per slot
+
+    @property
+    def page(self) -> int:
+        return self.k_pool.shape[2]
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
                dtype=None) -> KVCache:
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
+
+
+def init_paged_cache(cfg: LlamaConfig, slots: int, n_pages: int,
+                     page: int, max_pages: int, dtype=None) -> PagedKVCache:
+    """n_pages POOL pages (row 0 reserved as trash) shared by `slots`
+    slots of logical capacity max_pages * page tokens each."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+    return PagedKVCache(
+        k_pool=jnp.zeros(shape, dtype), v_pool=jnp.zeros(shape, dtype),
+        tables=jnp.zeros((slots, max_pages), jnp.int32),
+        length=jnp.zeros((slots,), jnp.int32))
 
 
 def _kernel_eligible(cfg: LlamaConfig) -> bool:
@@ -51,6 +83,28 @@ def _kernel_eligible(cfg: LlamaConfig) -> bool:
     if cfg.use_flash is None:
         return jax.default_backend() not in ("cpu", "gpu")
     return cfg.use_flash
+
+
+def _paged_attention(q, k_pool, v_pool, cache_len, tables,
+                     cfg: LlamaConfig):
+    """Paged-path attention: q [slots, T, Hq, D]; pools
+    [n_pages, page, Hkv, D]; tables [slots, max_pages]. The pallas paged
+    kernel indirects pool rows through the table; off-TPU the pages are
+    gathered back to a contiguous per-slot cache and the XLA fallback
+    runs (test/CPU path — gathering defeats paging's memory point, which
+    only matters where the kernel runs anyway)."""
+    from container_engine_accelerators_tpu.ops import decode_attention as da
+
+    if _kernel_eligible(cfg) and da.paged_supported(q, k_pool,
+                                                    k_pool.shape[1]):
+        interpret = jax.default_backend() != "tpu"
+        return da.paged_decode_attention(q, k_pool, v_pool, cache_len,
+                                         tables, interpret=interpret)
+    slots, max_pages = tables.shape
+    n_pages, page, hkv, d = k_pool.shape
+    k_c = k_pool[tables].reshape(slots, max_pages * page, hkv, d)
+    v_c = v_pool[tables].reshape(slots, max_pages * page, hkv, d)
+    return _cached_attention(q, k_c, v_c, cache_len, cfg)
 
 
 def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
@@ -104,7 +158,15 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     advance; inactive (free) slots still compute — their writes land in
     rows the next prefill overwrites."""
     b, t = tokens.shape
-    max_len = cache.k.shape[2]
+    paged = isinstance(cache, PagedKVCache)
+    if paged:
+        if t != 1:
+            raise ValueError(
+                "paged decode_step handles single-token steps only; "
+                "prefill goes through prefill_slot_paged")
+        max_len = cache.tables.shape[1] * cache.page  # logical capacity
+    else:
+        max_len = cache.k.shape[2]
     dt = cfg.dtype
     per_slot = jnp.ndim(cache.length) > 0
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
@@ -129,14 +191,41 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             return out.reshape(h.shape[0], h.shape[1], -1)
         return h @ w.astype(h.dtype)
 
-    def write(c, new):
-        if per_slot:
-            # Per-row scatter: row b's T new entries land at row_len[b].
-            return jax.vmap(
-                lambda cb, nb, st: jax.lax.dynamic_update_slice(
-                    cb, nb.astype(cb.dtype), (st, 0, 0)))(c, new, row_len)
-        return jax.lax.dynamic_update_slice(
-            c, new.astype(c.dtype), (0, cache.length, 0, 0))
+    if paged:
+        # New token of slot s lands at logical position row_len[s] ->
+        # pool row tables[s, pos // page], sublane pos % page. Inactive
+        # slots write to the reserved trash row 0 instead: their table
+        # rows may already belong to another request (freed on finish),
+        # and a stale write there would corrupt it.
+        page = cache.page
+        w_rows = cache.tables[jnp.arange(b), row_len // page]  # [slots]
+        if active is not None:
+            w_rows = jnp.where(active, w_rows, 0)
+        w_offs = row_len % page
+
+        def write(pool, new):
+            return pool.at[w_rows, w_offs].set(
+                new[:, 0].astype(pool.dtype))
+
+        def attend(q, k_pool, v_pool):
+            return _paged_attention(q, k_pool.astype(dt),
+                                    v_pool.astype(dt), att_len,
+                                    cache.tables, cfg)
+    else:
+        def write(c, new):
+            if per_slot:
+                # Per-row scatter: row b's T new entries land at
+                # row_len[b].
+                return jax.vmap(
+                    lambda cb, nb, st: jax.lax.dynamic_update_slice(
+                        cb, nb.astype(cb.dtype), (st, 0, 0)))(
+                            c, new, row_len)
+            return jax.lax.dynamic_update_slice(
+                c, new.astype(c.dtype), (0, cache.length, 0, 0))
+
+        def attend(q, k_cache, v_cache):
+            return _cached_attention(q, k_cache.astype(dt),
+                                     v_cache.astype(dt), att_len, cfg)
 
     att_len = row_len if per_slot else cache.length
 
@@ -150,8 +239,7 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         k = apply_rope(k, cos, sin, positions=positions)
         k_cache = write(k_cache_in, k)
         v_cache = write(v_cache_in, v)
-        attn = _cached_attention(q.astype(dt), k_cache.astype(dt),
-                                 v_cache.astype(dt), att_len, cfg)
+        attn = attend(q.astype(dt), k_cache, v_cache)
         x = x + proj(attn.reshape(b, t, -1), lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(proj(h2, lp["w_gate"]))
@@ -161,8 +249,10 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
 
     # Scan over layers with stacked params + stacked caches as xs — one
     # layer traced once regardless of depth, caches updated in place.
+    kv_in = ((cache.k_pool, cache.v_pool) if paged
+             else (cache.k, cache.v))
     x, (new_k, new_v) = jax.lax.scan(
-        layer_body, x, (params["layers"], cache.k, cache.v))
+        layer_body, x, (params["layers"],) + kv_in)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if isinstance(params["lm_head"], QuantWeight):
@@ -178,7 +268,11 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         new_len = jnp.minimum(cache.length + t, max_len)
         if active is not None:
             new_len = jnp.where(active, new_len, cache.length)
-    new_cache = KVCache(k=new_k, v=new_v, length=new_len)
+    if paged:
+        new_cache = PagedKVCache(k_pool=new_k, v_pool=new_v,
+                                 tables=cache.tables, length=new_len)
+    else:
+        new_cache = KVCache(k=new_k, v=new_v, length=new_len)
     return logits, new_cache
 
 
@@ -236,6 +330,124 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
     length = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - 1]
     return last, KVCache(k=k, v=v, length=length)
+
+
+# ---------- paged KV (page-pool) API ----------
+#
+# The slot cache above still reserves max_len HBM per slot; the paged
+# cache replaces per-slot reservations with a shared page pool + block
+# tables, so HBM scales with LIVE tokens and the engine can oversubscribe
+# logical capacity (ROADMAP item 6's paged-KV step; design notes on
+# PagedKVCache). Page allocation/free/preemption is HOST logic between
+# steps (serve.py PagedContinuousEngine + PageAllocator below); device
+# code only ever sees static shapes.
+
+
+def decode_step_paged(params: dict, cache: PagedKVCache,
+                      tokens: jnp.ndarray, active: jnp.ndarray,
+                      cfg: LlamaConfig
+                      ) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step for every slot of a paged cache: tokens [slots],
+    active [slots] bool. The slot's next page (tables[s, len//page]) must
+    already be allocated — the engine assigns pages BEFORE the step."""
+    logits, cache = decode_step(params, cache, tokens[:, None], cfg,
+                                active=active)
+    return logits[:, 0], cache
+
+
+def prefill_slot_paged(params: dict, cache: PagedKVCache,
+                       slot: jnp.ndarray, rows: jnp.ndarray,
+                       tokens: jnp.ndarray, true_len: jnp.ndarray,
+                       cfg: LlamaConfig
+                       ) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill ONE request into the paged cache.
+
+    tokens: [Tp] prompt padded to a PAGE multiple; rows: [Tp // page]
+    pool rows for the prompt's pages (allocated by the engine; the count
+    is static per bucket so one executable serves each bucket). Runs the
+    contiguous prefill into a temp cache, then scatters its pages into
+    the pool and points the slot's table at them. Returns (last live
+    token's logits [vocab] f32, updated cache)."""
+    tp = tokens.shape[0]
+    page = cache.page
+    n_pg = tp // page
+    tmp = init_cache(cfg, 1, tp)
+    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg)
+    L = cache.k_pool.shape[0]
+    hkv, d = cache.k_pool.shape[3], cache.k_pool.shape[4]
+    k_pages = tmp.k.reshape(L, n_pg, page, hkv, d)
+    v_pages = tmp.v.reshape(L, n_pg, page, hkv, d)
+    k_pool = cache.k_pool.at[:, rows].set(
+        k_pages.astype(cache.k_pool.dtype))
+    v_pool = cache.v_pool.at[:, rows].set(
+        v_pages.astype(cache.v_pool.dtype))
+    tables = jax.lax.dynamic_update_slice(
+        cache.tables, rows[None, :].astype(jnp.int32), (slot, 0))
+    length = cache.length.at[slot].set(true_len)
+    last = logits[0, true_len - 1]
+    return last, PagedKVCache(k_pool=k_pool, v_pool=v_pool,
+                              tables=tables, length=length)
+
+
+def assign_pages(cache: PagedKVCache, page_pos: jnp.ndarray,
+                 rows: jnp.ndarray, mask: jnp.ndarray) -> PagedKVCache:
+    """Point slot s's table entry page_pos[s] at pool row rows[s] where
+    mask[s] (no-op rows keep their current value). One masked scatter
+    covers every slot that crossed a page boundary this step."""
+    s = cache.tables.shape[0]
+    idx = jnp.arange(s)
+    cur = cache.tables[idx, page_pos]
+    new = jnp.where(mask, rows.astype(jnp.int32), cur)
+    return cache._replace(tables=cache.tables.at[idx, page_pos].set(new))
+
+
+class PageAllocator:
+    """Host-side free list over the pool's page rows. Row 0 is reserved
+    as the trash page (inactive-slot writes land there). Pure host state:
+    allocation decisions happen between device steps, mirroring how the
+    reference's device plugin hands out devices — the accelerator only
+    ever sees the resulting static tables."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError("pool needs >= 2 pages (row 0 is reserved)")
+        self._free = list(range(n_pages - 1, 0, -1))  # pop() -> low rows
+        self.n_pages = n_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> list[int] | None:
+        """n pool rows, or None (nothing allocated) if unavailable."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, rows: list[int]) -> None:
+        for r in rows:
+            if not 0 < r < self.n_pages:
+                raise ValueError(f"bad page row {r}")
+            if r in self._free:
+                raise ValueError(f"double free of page row {r}")
+        self._free.extend(rows)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_decode_step_paged(cfg: LlamaConfig):
+    return jax.jit(functools.partial(decode_step_paged, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_prefill_slot_paged(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_slot_paged, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_assign_pages():
+    return jax.jit(assign_pages, donate_argnums=(0,))
 
 
 def pick_tokens(logits: jnp.ndarray, temps: jnp.ndarray,

@@ -45,18 +45,55 @@ DEFAULT_BLOCK_K = 1024
 _VMEM_TILE_BUDGET = 8 * 1024 * 1024
 
 
+def _query_rows(t: int, g: int) -> int:
+    """T*G GQA query rows padded to the f32 sublane multiple."""
+    return max(8, -(-(t * g) // 8) * 8)
+
+
+def _scratch_fits(t: int, g: int, hkv: int, d: int) -> bool:
+    """f32 scratch scales with ALL query rows (hkv groups x rows each):
+    acc [hkv, rows, d] + m/l [hkv, rows, 128] — long prefills on
+    many-KV-head models must fall back or they blow scoped VMEM. One
+    formula shared by the contiguous and paged gates so the two paths
+    can never disagree on kernel eligibility."""
+    rows = _query_rows(t, g)
+    return 4 * hkv * rows * (d + 2 * 128) <= 6 * 1024 * 1024
+
+
+def _scratch_shapes(hkv: int, rows: int, d: int):
+    return [
+        pltpu.VMEM((hkv, rows, d), jnp.float32),
+        pltpu.VMEM((hkv, rows, 128), jnp.float32),
+        pltpu.VMEM((hkv, rows, 128), jnp.float32),
+    ]
+
+
+def _group_queries(q, hkv: int, g: int, rows: int):
+    """[B, T, Hq, D] -> [B, Hkv, rows, D]: group the queries that share
+    a KV head so one head's tile serves the whole group, padding to the
+    sublane multiple."""
+    b, t, hq, d = q.shape
+    qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, hkv, t * g, d)
+    if rows != t * g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - t * g), (0, 0)))
+    return qg
+
+
+def _ungroup_output(out, t: int, g: int):
+    """Inverse of _group_queries on the kernel output."""
+    b, hkv, rows, d = out.shape
+    out = out[:, :, :t * g, :].reshape(b, hkv, t, g, d)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, t, hkv * g, d)
+
+
 def supported(q, k_cache) -> bool:
     """q: [B, T, Hq, D]; k_cache: [B, max_len, Hkv, D]."""
     b, t, hq, d = q.shape
     max_len, hkv = k_cache.shape[1], k_cache.shape[2]
     g = hq // hkv
-    rows = max(8, -(-(t * g) // 8) * 8)
-    # f32 scratch scales with ALL query rows (hkv groups x rows each):
-    # acc [hkv, rows, d] + m/l [hkv, rows, 128] — long prefills on
-    # many-KV-head models must fall back or they blow scoped VMEM.
-    scratch_bytes = 4 * hkv * rows * (d + 2 * 128)
     return (d % 128 == 0 and max_len % 128 == 0 and max_len >= 256
-            and scratch_bytes <= 6 * 1024 * 1024)
+            and _scratch_fits(t, g, hkv, d))
 
 
 def _pick_block(requested: int, s: int) -> int:
@@ -150,14 +187,8 @@ def decode_attention(q, k_cache, v_cache, cache_len,
     per_row = 4 * hkv * d * k_cache.dtype.itemsize
     cap = max(128, _VMEM_TILE_BUDGET // per_row // 128 * 128)
     block_k = _pick_block(min(block_k, cap), max_len)
-    rows = max(8, -(-(t * g) // 8) * 8)  # pad to the f32 sublane multiple
-
-    # [B, T, Hq, D] -> [B, Hkv, T*G, D]: group the queries that share a
-    # KV head so one head's tile serves the whole group.
-    qg = q.reshape(b, t, hkv, g, d).transpose(0, 2, 1, 3, 4)
-    qg = qg.reshape(b, hkv, t * g, d)
-    if rows != t * g:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - t * g), (0, 0)))
+    rows = _query_rows(t, g)
+    qg = _group_queries(q, hkv, g, rows)
 
     len_arr = jnp.broadcast_to(
         jnp.asarray(cache_len, jnp.int32).reshape(-1), (b,))
@@ -186,11 +217,7 @@ def decode_attention(q, k_cache, v_cache, cache_len,
         ],
         out_specs=pl.BlockSpec((1, hkv, rows, d),
                                lambda bi, ki, len_ref: (bi, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((hkv, rows, d), jnp.float32),
-            pltpu.VMEM((hkv, rows, 128), jnp.float32),
-            pltpu.VMEM((hkv, rows, 128), jnp.float32),
-        ],
+        scratch_shapes=_scratch_shapes(hkv, rows, d),
     )
 
     out = pl.pallas_call(
@@ -201,5 +228,88 @@ def decode_attention(q, k_cache, v_cache, cache_len,
         interpret=interpret,
     )(len_arr, qg, k_cache, v_cache)
 
-    out = out[:, :, :t * g, :].reshape(b, hkv, t, g, d)
-    return out.transpose(0, 2, 1, 3, 4).reshape(b, t, hq, d)
+    return _ungroup_output(out, t, g)
+
+
+def paged_supported(q, k_pool, page: int) -> bool:
+    """q: [B, T, Hq, D]; k_pool: [n_pages, page, Hkv, D]."""
+    b, t, hq, d = q.shape
+    hkv = k_pool.shape[2]
+    g = hq // hkv
+    return (d % 128 == 0 and page % 128 == 0
+            and _scratch_fits(t, g, hkv, d))
+
+
+def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
+                           interpret: bool = False):
+    """Paged variant: the cache lives in a shared page pool and each
+    slot's logical sequence is scattered across pool rows by its block
+    table (vLLM-style paging, done the TPU way: the table is a second
+    scalar-prefetch operand and ONLY the BlockSpec index map changes —
+    the kernel body runs unmodified in logical coordinates).
+
+    q:        [slots, T, Hq, D] new-token queries
+    k_pool:   [n_pages, page, Hkv, D] shared pages (v_pool alike)
+    lengths:  [slots] int32 live length per slot (new tokens already
+              written at logical positions [len, len+T))
+    tables:   [slots, max_pages] int32 pool row of each logical page;
+              entries past the live pages may be garbage — the index map
+              clamps to the last live page and the kernel masks by
+              position. Returns [slots, T, Hq, D].
+    """
+    b, t, hq, d = q.shape
+    n_pages, page, hkv, _ = k_pool.shape
+    max_pages = tables.shape[1]
+    g = hq // hkv
+    rows = _query_rows(t, g)
+    qg = _group_queries(q, hkv, g, rows)
+
+    len_arr = jnp.asarray(lengths, jnp.int32).reshape(-1)
+    tab_arr = jnp.asarray(tables, jnp.int32)
+
+    def kv_map(bi, ki, len_ref, tab_ref):
+        # Logical page ki of slot bi lives at pool row tab_ref[bi, ki]:
+        # the pool's page-row dim plays the role the contiguous cache's
+        # batch dim played, so the block shape (1, page, hkv, d) and the
+        # kernel body are IDENTICAL — paging is purely an index-map
+        # change. Dead pages clamp to the last live one so Mosaic elides
+        # their HBM->VMEM copies (same trick as the contiguous kernel),
+        # and the clamp also keeps garbage table entries in-bounds.
+        last_live = (len_ref[bi] + t - 1) // page
+        row = tab_ref[bi, jnp.minimum(ki, last_live)]
+        return (jnp.clip(row, 0, n_pages - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, hkv, rows, d),
+                         lambda bi, ki, len_ref, tab_ref: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, page, hkv, d), kv_map),
+            pl.BlockSpec((1, page, hkv, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, rows, d),
+                               lambda bi, ki, len_ref, tab_ref:
+                               (bi, 0, 0, 0)),
+        scratch_shapes=_scratch_shapes(hkv, rows, d),
+    )
+
+    def paged_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                     acc, m_scr, l_scr):
+        # The contiguous kernel body runs unmodified: its per-grid-step
+        # K/V block is one page, its k_start (ki * block_k) is the
+        # LOGICAL page start, and its masking/online-softmax are all
+        # position-based — paging only changes where the bytes come
+        # from, which the index map above fully encapsulates.
+        _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc, m_scr, l_scr, scale=d ** -0.5, block_k=page,
+                       t=t, g=g, hkv=hkv)
+
+    out = pl.pallas_call(
+        paged_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        interpret=interpret,
+    )(len_arr, tab_arr, qg, k_pool, v_pool)
+
+    return _ungroup_output(out, t, g)

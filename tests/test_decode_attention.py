@@ -15,6 +15,7 @@ from container_engine_accelerators_tpu.models.decode import (
 from container_engine_accelerators_tpu.models.llama import init_params
 from container_engine_accelerators_tpu.ops.decode_attention import (
     decode_attention,
+    paged_decode_attention,
     supported,
 )
 
@@ -129,3 +130,40 @@ def test_kernel_per_slot_vector_lengths():
         np.testing.assert_allclose(
             jax.device_get(got[i:i + 1]), jax.device_get(want),
             rtol=2e-5, atol=2e-5, err_msg=f"slot {i}")
+
+
+def test_paged_kernel_matches_contiguous():
+    """The paged kernel indirects pool rows through a block table but
+    computes in logical coordinates: scattering a contiguous cache's
+    pages across a shuffled pool must reproduce the contiguous result
+    exactly, with garbage table entries past the live pages tolerated
+    (the index map clamps them)."""
+    slots, t, hq, hkv, d = 3, 1, 8, 4, 128
+    page, n_pages, max_pages = 128, 16, 6
+    max_len = max_pages * page
+    key = jax.random.key(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (slots, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (slots, max_len, hkv, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (slots, max_len, hkv, d), jnp.float32)
+    lengths = jnp.asarray([130, 5, 300], jnp.int32)
+
+    # Garbage-filled table; live pages get real pool rows.
+    tables = np.full((slots, max_pages), 13, np.int32)
+    k_pool = np.zeros((n_pages, page, hkv, d), np.float32)
+    v_pool = np.zeros((n_pages, page, hkv, d), np.float32)
+    free = list(range(1, n_pages))
+    for s in range(slots):
+        for p in range(-(-int(lengths[s] + t) // page)):
+            tables[s, p] = free.pop()
+            k_pool[tables[s, p]] = np.asarray(k_cache)[s, p * page:
+                                                       (p + 1) * page]
+            v_pool[tables[s, p]] = np.asarray(v_cache)[s, p * page:
+                                                       (p + 1) * page]
+
+    ref = decode_attention(q, k_cache, v_cache, lengths, interpret=True)
+    got = paged_decode_attention(q, jnp.asarray(k_pool),
+                                 jnp.asarray(v_pool), lengths,
+                                 jnp.asarray(tables), interpret=True)
+    np.testing.assert_allclose(jax.device_get(got), jax.device_get(ref),
+                               rtol=2e-5, atol=2e-5)

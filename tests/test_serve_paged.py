@@ -1,0 +1,252 @@
+"""Paged KV-cache serving: allocator invariants, paged-vs-contiguous
+decode parity (page-boundary crossings included), oversubscribed-pool
+preemption, and greedy parity through the engine (ROADMAP item 6's final
+step — slots hold only the pages they filled)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.cli.serve import (
+    PagedContinuousEngine,
+)
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models.decode import (
+    PageAllocator,
+    _jitted_assign_pages,
+    _jitted_decode_step_paged,
+    _jitted_decode_step_slots,
+    _jitted_prefill_slot,
+    _jitted_prefill_slot_paged,
+    generate,
+    init_paged_cache,
+    init_slot_cache,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def direct(params, cfg, tokens, n_new):
+    out = generate(params, jnp.asarray([tokens], jnp.int32), cfg, n_new)
+    return [int(t) for t in out[0]]
+
+
+# ---------- allocator ----------
+
+def test_allocator_invariants():
+    a = PageAllocator(5)          # rows 1..4 usable, 0 reserved
+    assert a.free_pages == 4
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.alloc(2) is None     # only 1 left; nothing consumed
+    assert a.free_pages == 1
+    a.free(got[:1])
+    assert a.free_pages == 2
+    with pytest.raises(ValueError, match="double free"):
+        a.free(got[:1])
+    with pytest.raises(ValueError, match="bad page"):
+        a.free([0])               # the trash row is never allocatable
+    with pytest.raises(ValueError):
+        PageAllocator(1)
+
+
+# ---------- decode parity ----------
+
+def test_paged_matches_slot_decode_across_page_boundary(model):
+    """Greedy decode over a paged cache must match the contiguous slot
+    cache token-for-token, including steps where slots cross into a
+    freshly assigned page (the write-indirection and table plumbing are
+    exactly what this exercises)."""
+    params, cfg = model
+    slots, page, max_pages, n_pages = 3, 16, 6, 12
+    max_len = max_pages * page
+    cache_c = init_slot_cache(cfg, slots, max_len)
+    cache_p = init_paged_cache(cfg, slots, n_pages, page, max_pages)
+    alloc = PageAllocator(n_pages)
+    step_c = _jitted_decode_step_slots(cfg)
+    step_p = _jitted_decode_step_paged(cfg)
+    pre_c = _jitted_prefill_slot(cfg)
+    pre_p = _jitted_prefill_slot_paged(cfg)
+    asg = _jitted_assign_pages()
+
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12]]
+    for s, pr in enumerate(prompts):
+        padded = jnp.asarray(pr + [0] * (page - len(pr)), jnp.int32)
+        l1, cache_c = pre_c(params, cache_c, jnp.int32(s), padded,
+                            jnp.int32(len(pr)))
+        rows = alloc.alloc(1)
+        l2, cache_p = pre_p(params, cache_p, jnp.int32(s),
+                            jnp.asarray(rows, jnp.int32), padded,
+                            jnp.int32(len(pr)))
+        assert float(jnp.max(jnp.abs(l1 - l2))) < 1e-4, s
+
+    last = jnp.asarray([5, 9, 12], jnp.int32)
+    active = jnp.asarray([True] * slots)
+    lens = [len(p) for p in prompts]
+    allocated = [1] * slots
+    crossings = 0
+    for _ in range(40):  # crosses page boundaries at len 16 and 32
+        mask = np.zeros(slots, bool)
+        pos = np.zeros(slots, np.int32)
+        rws = np.zeros(slots, np.int32)
+        for s in range(slots):
+            pg = lens[s] // page
+            if pg >= allocated[s]:
+                (row,) = alloc.alloc(1)
+                allocated[s] += 1
+                mask[s], pos[s], rws[s] = True, pg, row
+                crossings += 1
+        if mask.any():
+            cache_p = asg(cache_p, jnp.asarray(pos), jnp.asarray(rws),
+                          jnp.asarray(mask))
+        lc, cache_c = step_c(params, cache_c, last, active)
+        lp, cache_p = step_p(params, cache_p, last, active)
+        tc = jnp.argmax(lc, axis=-1).astype(jnp.int32)
+        tp = jnp.argmax(lp, axis=-1).astype(jnp.int32)
+        assert bool(jnp.all(tc == tp)), (
+            f"diverged at lens {lens}: {tc} vs {tp}")
+        last = tc
+        lens = [n + 1 for n in lens]
+    assert crossings >= slots * 2  # every slot crossed >= 2 boundaries
+
+
+def test_inactive_slot_writes_hit_trash_page(model):
+    """A freed slot's table rows may be reassigned to another request;
+    the freed slot keeps computing (static shapes) and its writes must
+    land in the reserved trash row, not the reassigned pages."""
+    params, cfg = model
+    slots, page, max_pages, n_pages = 2, 16, 4, 6
+    cache = init_paged_cache(cfg, slots, n_pages, page, max_pages)
+    pre = _jitted_prefill_slot_paged(cfg)
+    step = _jitted_decode_step_paged(cfg)
+    # Slot 0 and 1 prefilled on the SAME pool row sequence would alias;
+    # give slot 1 row 1 and slot 0 row 2, then mark slot 1 inactive and
+    # point its table at slot 0's row — the active=False gate must keep
+    # slot 1's writes out of row 2.
+    padded = jnp.asarray([1, 2, 3] + [0] * (page - 3), jnp.int32)
+    _, cache = pre(params, cache, jnp.int32(0),
+                   jnp.asarray([2], jnp.int32), padded, jnp.int32(3))
+    _, cache = pre(params, cache, jnp.int32(1),
+                   jnp.asarray([2], jnp.int32), padded, jnp.int32(3))
+    row2_before = np.asarray(cache.k_pool[:, 2])
+    active = jnp.asarray([False, False])
+    _, cache = step(params, cache, jnp.asarray([9, 9], jnp.int32), active)
+    row2_after = np.asarray(cache.k_pool[:, 2])
+    np.testing.assert_array_equal(row2_before, row2_after)
+
+
+# ---------- engine ----------
+
+@pytest.fixture()
+def paged_engine(model):
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=4, max_len=256,
+                                page=16, pool_pages=None,
+                                max_prompt_len=128)
+    yield eng
+    eng.stop()
+
+
+def test_engine_greedy_parity_mixed_lengths(model, paged_engine):
+    params, cfg = model
+    reqs = [([1, 2, 3], 5), ([4, 5], 7), ([9, 8, 7, 6, 5, 4], 3),
+            ([17] * 20, 6), ([2], 24)]
+    futs = [paged_engine.submit(list(t), n, 0.0) for t, n in reqs]
+    for (t, n), fut in zip(reqs, futs):
+        assert fut.result(timeout=300) == direct(params, cfg, t, n), (t, n)
+
+
+def test_engine_preemption_under_page_pressure(model):
+    """Pool far smaller than the slots' combined appetite: requests must
+    preempt (freeing pages, requeueing with their progress) and STILL
+    all return exact greedy results — preemption re-prefills the full
+    prefix, so greedy decoding is bit-stable across it."""
+    params, cfg = model
+    # 3 requests x (1 prompt page + ~3 decode pages) vs 5 usable pages.
+    eng = PagedContinuousEngine(params, cfg, max_slots=3, max_len=64,
+                                page=16, pool_pages=6,
+                                max_prompt_len=32)
+    try:
+        reqs = [([1, 2, 3], 40), ([7, 8], 40), ([11] * 5, 40)]
+        futs = [eng.submit(list(t), n, 0.0) for t, n in reqs]
+        for (t, n), fut in zip(reqs, futs):
+            assert fut.result(timeout=600) == direct(params, cfg, t, n), \
+                (t, n)
+        assert eng.preemptions > 0, \
+            "pool was sized to force preemption; none happened"
+        assert eng.requests_served == 3
+    finally:
+        eng.stop()
+
+
+def test_engine_pool_too_small_for_single_request(model):
+    """If even ONE request cannot fit the pool alone, its future must
+    fail with a clear error instead of livelocking the worker."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=64,
+                                page=16, pool_pages=3,  # 2 usable pages
+                                max_prompt_len=32)
+    try:
+        fut = eng.submit([1, 2, 3], 40, 0.0)  # needs ~3 pages
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            fut.result(timeout=300)
+        # Engine survives: a fitting request still completes.
+        ok = eng.submit([4, 5], 8, 0.0).result(timeout=300)
+        assert ok == direct(params, cfg, [4, 5], 8)
+    finally:
+        eng.stop()
+
+
+def test_engine_slot_and_page_reuse(model, paged_engine):
+    """More requests than slots; pages recycle through the free list and
+    later requests still match direct generate()."""
+    params, cfg = model
+    reqs = [([i + 1, i + 2], 4 + (i % 3)) for i in range(10)]
+    futs = [paged_engine.submit(list(t), n, 0.0) for t, n in reqs]
+    for (t, n), fut in zip(reqs, futs):
+        assert fut.result(timeout=300) == direct(params, cfg, t, n)
+    assert paged_engine.requests_served >= 10
+
+
+def test_submit_rejects_prompt_larger_than_pool(model):
+    """A prompt needing more pages than the pool owns can never be
+    admitted; submit must fail it immediately instead of head-of-line
+    blocking the backlog while the worker spins."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=128,
+                                page=16, pool_pages=3,  # 2 usable pages
+                                max_prompt_len=128)
+    try:
+        fut = eng.submit([1] * 60, 2, 0.0)  # needs 4 pages > 2 usable
+        with pytest.raises(ValueError, match="pool has only"):
+            fut.result(timeout=30)
+        # The engine is not wedged: a fitting request still completes.
+        ok = eng.submit([1, 2], 3, 0.0).result(timeout=300)
+        assert ok == direct(params, cfg, [1, 2], 3)
+    finally:
+        eng.stop()
+
+
+def test_max_len_capacity_invariant():
+    """self.max_len must equal max_pages * page even when the base
+    engine's kernel-eligibility rounding bumps max_len to a 128
+    multiple — a mismatch would let submit() accept requests past the
+    real logical capacity (silent KV overwrite)."""
+    cfg = llama_tiny(n_layers=1, d_model=256, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128, use_flash=True)
+    params = init_params(jax.random.key(0), cfg)
+    # page 48 and max_len 2000: lcm(48, 128) = 384 forces real rounding.
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=2000,
+                                page=48, pool_pages=8)
+    try:
+        assert eng.max_len == eng.max_pages * eng.page
+        assert eng.max_len % 128 == 0 and eng.max_len % 48 == 0
+        assert eng.max_len >= 2000
+    finally:
+        eng.stop()
