@@ -385,8 +385,7 @@ class PagedContinuousEngine(ContinuousEngine):
         every later request while the worker spins."""
         bucketed = -(-len(tokens) // self.page) * self.page
         if bucketed // self.page > self.pool_pages - 1:
-            import concurrent.futures as cf
-            fut: cf.Future = cf.Future()
+            fut: concurrent.futures.Future = concurrent.futures.Future()
             fut.set_exception(ValueError(
                 f"prompt needs {bucketed // self.page} pages but the "
                 f"pool has only {self.pool_pages - 1} usable; raise "
@@ -438,13 +437,16 @@ class PagedContinuousEngine(ContinuousEngine):
             free_slot_pages(i)
             self._finish(i, slots)
 
-        def preempt_youngest(exclude: int | None = None) -> int | None:
+        def preempt_youngest() -> int | None:
             """Free the most recently admitted request's pages and
             requeue it (generated tokens become part of its next
-            prompt). Returns the victim slot, or None if none is
-            preemptible."""
-            victims = [i for i, sl in enumerate(slots)
-                       if sl is not None and i != exclude]
+            prompt). The page-requesting slot itself is a valid victim
+            — excluding it would evict an OLDER request whenever the
+            requester is the youngest, inverting the policy and making
+            the oldest in-flight request pay repeated full-prefix
+            recompute under sustained pressure. Returns the victim
+            slot, or None if nothing is active."""
+            victims = [i for i, sl in enumerate(slots) if sl is not None]
             if not victims:
                 return None
             i = max(victims, key=lambda j: slots[j]["admitted"])
@@ -526,15 +528,15 @@ class PagedContinuousEngine(ContinuousEngine):
                 if pg >= self.max_pages:
                     continue  # at logical capacity; write clamps
                 row = None
-                while row is None:
+                while row is None and slots[i] is not None:
                     got = alloc.alloc(1)
                     if got is not None:
                         row = got[0]
                         continue
-                    victim = preempt_youngest(exclude=i)
+                    victim = preempt_youngest()
                     if victim is None:
-                        # Only this slot is left and the pool is empty:
-                        # the pool is simply too small for the request.
+                        # Unreachable in practice (slot i itself is a
+                        # candidate) — belt against future refactors.
                         sl["fut"].set_exception(RuntimeError(
                             "page pool exhausted and no preemptible "
                             "request left; raise --pool-pages"))
@@ -544,6 +546,8 @@ class PagedContinuousEngine(ContinuousEngine):
                     # A victim that was granted a page earlier in THIS
                     # sweep must not have it written: the row is back in
                     # the free list and may be handed out right here.
+                    # (If the victim is slot i itself — it was the
+                    # youngest — it is requeued and gets no page.)
                     mask[victim] = False
                 if slots[i] is None:
                     continue

@@ -193,8 +193,10 @@ def test_engine_pool_too_small_for_single_request(model):
                                 page=16, pool_pages=3,  # 2 usable pages
                                 max_prompt_len=32)
     try:
-        fut = eng.submit([1, 2, 3], 40, 0.0)  # needs ~3 pages
-        with pytest.raises(RuntimeError, match="pool exhausted"):
+        # Needs ~3 pages total: self-preempts as it outgrows the pool
+        # until its regrown prompt alone can't fit, then fails cleanly.
+        fut = eng.submit([1, 2, 3], 40, 0.0)
+        with pytest.raises(RuntimeError, match="raise --pool-pages"):
             fut.result(timeout=300)
         # Engine survives: a fitting request still completes.
         ok = eng.submit([4, 5], 8, 0.0).result(timeout=300)
