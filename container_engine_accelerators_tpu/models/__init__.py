@@ -2,7 +2,9 @@
 manifests (reference demo/tpu-training/resnet-tpu.yaml, inception-v3-tpu.yaml).
 
 Flagship: Llama-3 family decoder (models/llama.py), sharded dp/fsdp/sp/tp.
-Also: MNIST MLP (models/mnist.py) — the PR1 smoke-test workload.
+Also: ResNet v1.5 (models/resnet.py) — the reference's vision demo
+family, NHWC/bf16/MXU-conv TPU-native; MNIST MLP (models/mnist.py) —
+the PR1 smoke-test workload.
 """
 
 from container_engine_accelerators_tpu.models.llama import (
