@@ -328,8 +328,11 @@ class PagedContinuousEngine(ContinuousEngine):
     filled (ROADMAP item 6's final step; models/decode.py PagedKVCache).
 
     Page lifecycle (all host-side, between device steps):
-      - admit: allocate the prompt's pages; hold the request in queue if
-        the pool can't cover them right now;
+      - admit: match the prompt's FULL pages against the prefix cache
+        (chain-hashed pages retained from earlier requests — matched
+        pages are shared by refcount and their forward is skipped via
+        prefill_suffix_paged), allocate fresh pages for the rest; hold
+        the request in queue if the pool can't cover them right now;
       - decode: before each step, slots whose next token crosses a page
         boundary get a fresh page via one masked assign_pages scatter;
       - exhaustion: when no page is free, PREEMPT the youngest request —
@@ -350,7 +353,7 @@ class PagedContinuousEngine(ContinuousEngine):
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_len: int = 2048, page: int = 128,
                  pool_pages: int | None = None,
-                 max_prompt_len: int = 1024):
+                 max_prompt_len: int = 1024, prefix_cap: int = 256):
         import math
 
         from container_engine_accelerators_tpu.models.decode import (
@@ -374,6 +377,11 @@ class PagedContinuousEngine(ContinuousEngine):
         self.pool_pages = pool_pages or (
             max_slots * self.max_pages // 2 + 1)
         self.preemptions = 0
+        # Prefix cache: full prompt pages are retained (refcounted) and
+        # reused across requests sharing a page-aligned prompt prefix —
+        # their forward is skipped entirely at admission.
+        self.prefix_cap = prefix_cap
+        self.prefix_pages_reused = 0
         super().__init__(params, cfg, max_slots=max_slots,
                          max_len=max_len, prompt_bucket=page,
                          max_prompt_len=max_prompt_len)
@@ -401,10 +409,12 @@ class PagedContinuousEngine(ContinuousEngine):
 
         from container_engine_accelerators_tpu.models.decode import (
             PageAllocator,
+            PrefixIndex,
             _jitted_assign_pages,
             _jitted_decode_step_paged,
             _jitted_pick_tokens,
-            _jitted_prefill_slot_paged,
+            _jitted_prefill_suffix_paged,
+            _jitted_set_slot_pages,
             init_paged_cache,
         )
 
@@ -412,16 +422,27 @@ class PagedContinuousEngine(ContinuousEngine):
         page = self.page
 
         def fresh_cache():
+            alloc = PageAllocator(self.pool_pages)
             return (init_paged_cache(self.cfg, s, self.pool_pages, page,
                                      self.max_pages),
-                    PageAllocator(self.pool_pages))
+                    alloc, PrefixIndex(alloc, cap=self.prefix_cap))
 
-        cache, alloc = fresh_cache()
+        cache, alloc, index = fresh_cache()
         step_fn = _jitted_decode_step_paged(self.cfg)
-        prefill_fn = _jitted_prefill_slot_paged(self.cfg)
+        prefill_fn = _jitted_prefill_suffix_paged(self.cfg)
+        set_pages_fn = _jitted_set_slot_pages()
         assign_fn = _jitted_assign_pages()
         pick_fn = _jitted_pick_tokens()
         base_key = jax.random.key(0)
+
+        def try_alloc(n):
+            """alloc with prefix-index eviction under pressure: retained
+            prefix pages are a cache, preempting live work to keep them
+            would invert the priority."""
+            rows = alloc.alloc(n)
+            while rows is None and index.evict_lru():
+                rows = alloc.alloc(n)
+            return rows
 
         slots: list[dict | None] = [None] * s
         last_tok = [0] * s
@@ -473,16 +494,34 @@ class PagedContinuousEngine(ContinuousEngine):
                         f"the pool has only {self.pool_pages - 1} "
                         "usable; raise --pool-pages"))
                 return True  # consumed
-            rows = alloc.alloc(tp // page)
-            if rows is None:
+            # Prefix cache: reuse pool rows for the longest chain of
+            # FULL prompt pages another request already computed (at
+            # most (len-1)//page — the page holding the last live token
+            # stays private since decode will write into it).
+            n_full = (len(tokens) - 1) // page
+            hashes = PrefixIndex.chain_hashes(tokens, page, n_full)
+            shared = index.match(hashes)
+            p_len = len(shared) * page
+            fresh = try_alloc(tp // page - len(shared))
+            if fresh is None:
+                alloc.free(shared)  # drop our refs; entries stay cached
                 return False
+            all_rows = shared + fresh
+            table_row = all_rows + [0] * (self.max_pages - len(all_rows))
             padded = list(tokens) + [0] * (tp - len(tokens))
             nonlocal cache
+            cache = set_pages_fn(cache, jnp.int32(slot_idx),
+                                 jnp.asarray(table_row, jnp.int32),
+                                 jnp.int32(p_len))
             last_logits, cache = prefill_fn(
                 self.params, cache, jnp.int32(slot_idx),
-                jnp.asarray(rows, jnp.int32),
-                jnp.asarray(padded, jnp.int32), jnp.int32(len(tokens)))
+                jnp.asarray(padded[p_len:], jnp.int32),
+                jnp.int32(len(tokens)))
             self.prefills_run += 1
+            self.prefix_pages_reused += len(shared)
+            # Retain the freshly computed full pages for future prompts.
+            for i in range(len(shared), n_full):
+                index.insert(hashes[i], all_rows[i])
             key = jax.random.fold_in(base_key,
                                      self.prefills_run & 0xFFFFFFF)
             tok = int(pick_fn(last_logits[None, :],
@@ -490,7 +529,7 @@ class PagedContinuousEngine(ContinuousEngine):
             slots[slot_idx] = {
                 "fut": fut, "remaining": n_new - 1,
                 "out": list(tokens) + [tok], "temp": temp,
-                "rows": rows, "len": len(tokens),
+                "rows": all_rows, "len": len(tokens),
                 "admitted": self.prefills_run}
             last_tok[slot_idx] = tok
             temps[slot_idx] = temp
@@ -499,7 +538,7 @@ class PagedContinuousEngine(ContinuousEngine):
             return True
 
         def reset_after_device_error(err):
-            nonlocal cache, alloc
+            nonlocal cache, alloc, index
             for i, sl in enumerate(slots):
                 if sl is not None and not sl["fut"].done():
                     sl["fut"].set_exception(err)
@@ -508,7 +547,7 @@ class PagedContinuousEngine(ContinuousEngine):
                 if not item[3].done():
                     item[3].set_exception(err)
             backlog.clear()
-            cache, alloc = fresh_cache()
+            cache, alloc, index = fresh_cache()
 
         def grow_pages() -> bool:
             """Give every active slot whose next write crosses into an
@@ -529,7 +568,7 @@ class PagedContinuousEngine(ContinuousEngine):
                     continue  # at logical capacity; write clamps
                 row = None
                 while row is None and slots[i] is not None:
-                    got = alloc.alloc(1)
+                    got = try_alloc(1)
                     if got is not None:
                         row = got[0]
                         continue
@@ -691,6 +730,9 @@ def main(argv=None) -> int:
                    help="paged engine: total pool pages incl. the "
                         "reserved trash row (default: half the full "
                         "slots x max_len reservation)")
+    p.add_argument("--prefix-cache-cap", type=int, default=256,
+                   help="paged engine: max retained full prompt pages "
+                        "in the prefix cache (0 disables sharing)")
     p.add_argument("--quantize-int8", action="store_true",
                    help="serve int8-quantized weights (halves weight HBM "
                         "traffic on the decode path)")
@@ -710,7 +752,8 @@ def main(argv=None) -> int:
     if args.engine == "paged":
         engine = PagedContinuousEngine(
             params, cfg, max_slots=args.max_batch, max_len=args.max_len,
-            page=args.page_size, pool_pages=args.pool_pages)
+            page=args.page_size, pool_pages=args.pool_pages,
+            prefix_cap=args.prefix_cache_cap)
     elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len)

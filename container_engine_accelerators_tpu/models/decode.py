@@ -160,10 +160,6 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     b, t = tokens.shape
     paged = isinstance(cache, PagedKVCache)
     if paged:
-        if t != 1:
-            raise ValueError(
-                "paged decode_step handles single-token steps only; "
-                "prefill goes through prefill_slot_paged")
         max_len = cache.tables.shape[1] * cache.page  # logical capacity
     else:
         max_len = cache.k.shape[2]
@@ -192,20 +188,26 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         return h @ w.astype(h.dtype)
 
     if paged:
-        # New token of slot s lands at logical position row_len[s] ->
-        # pool row tables[s, pos // page], sublane pos % page. Inactive
-        # slots write to the reserved trash row 0 instead: their table
-        # rows may already belong to another request (freed on finish),
-        # and a stale write there would corrupt it.
+        # New token t_i of slot s lands at logical position
+        # row_len[s] + i -> pool row tables[s, pos // page], sublane
+        # pos % page (T > 1 is the suffix-prefill path: every target
+        # page must be pre-assigned in the table). Inactive slots write
+        # to the reserved trash row 0 instead: their table rows may
+        # already belong to another request (freed on finish), and a
+        # stale write there would corrupt it.
         page = cache.page
-        w_rows = cache.tables[jnp.arange(b), row_len // page]  # [slots]
+        w_pos = row_len[:, None] + jnp.arange(t, dtype=jnp.int32)
+        w_rows = cache.tables[jnp.arange(b)[:, None],
+                              jnp.minimum(w_pos // page,
+                                          cache.tables.shape[1] - 1)]
         if active is not None:
-            w_rows = jnp.where(active, w_rows, 0)
-        w_offs = row_len % page
+            w_rows = jnp.where(active[:, None], w_rows, 0)
+        w_offs = w_pos % page
 
         def write(pool, new):
-            return pool.at[w_rows, w_offs].set(
-                new[:, 0].astype(pool.dtype))
+            hkv_d = new.shape[2:]
+            return pool.at[w_rows.reshape(-1), w_offs.reshape(-1)].set(
+                new.reshape(b * t, *hkv_d).astype(pool.dtype))
 
         def attend(q, k_pool, v_pool):
             return _paged_attention(q, k_pool.astype(dt),
@@ -389,6 +391,48 @@ def prefill_slot_paged(params: dict, cache: PagedKVCache,
                               tables=tables, length=length)
 
 
+def set_slot_pages(cache: PagedKVCache, slot: jnp.ndarray,
+                   rows: jnp.ndarray,
+                   length: jnp.ndarray) -> PagedKVCache:
+    """Replace slot's whole table row with `rows` ([max_pages] int32 —
+    shared-prefix rows + fresh rows + trash-0 padding) and set its
+    length. One executable serves every admission (slot/length traced)."""
+    tables = jax.lax.dynamic_update_slice(
+        cache.tables, rows[None, :].astype(jnp.int32), (slot, 0))
+    return cache._replace(tables=tables,
+                          length=cache.length.at[slot].set(length))
+
+
+def prefill_suffix_paged(params: dict, cache: PagedKVCache,
+                         slot: jnp.ndarray, suffix_tokens: jnp.ndarray,
+                         true_len: jnp.ndarray, cfg: LlamaConfig
+                         ) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill a request whose first `cache.length[slot]` tokens are
+    ALREADY in the cache via shared prefix pages (prefix caching): only
+    the suffix runs through the model. The slot's table must already
+    hold the shared prefix rows AND fresh rows covering the suffix
+    pages (set_slot_pages), with length[slot] = prefix_len
+    (page-aligned).
+
+    suffix_tokens: [Ts] = prompt[prefix_len:] padded to a page
+    multiple. Returns (logits of the last LIVE token [vocab] f32,
+    updated cache). Compared to prefill_slot_paged this skips the
+    prefix's forward entirely — the compute saving of prefix sharing;
+    executables key on the static Ts bucket (slot/lengths traced)."""
+    max_pages = cache.tables.shape[1]
+    # b=1 view of the slot: pools are shared (writes scatter into pool
+    # rows), so running decode_step on the view fills the real cache.
+    tab1 = jax.lax.dynamic_slice(cache.tables, (slot, 0), (1, max_pages))
+    len1 = jax.lax.dynamic_slice(cache.length, (slot,), (1,))
+    sub = PagedKVCache(k_pool=cache.k_pool, v_pool=cache.v_pool,
+                       tables=tab1, length=len1)
+    logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg)
+    length = cache.length.at[slot].set(true_len)
+    last = logits[0, true_len - len1[0] - 1]
+    return last, PagedKVCache(k_pool=sub.k_pool, v_pool=sub.v_pool,
+                              tables=cache.tables, length=length)
+
+
 def assign_pages(cache: PagedKVCache, page_pos: jnp.ndarray,
                  rows: jnp.ndarray, mask: jnp.ndarray) -> PagedKVCache:
     """Point slot s's table entry page_pos[s] at pool row rows[s] where
@@ -402,35 +446,130 @@ def assign_pages(cache: PagedKVCache, page_pos: jnp.ndarray,
 
 
 class PageAllocator:
-    """Host-side free list over the pool's page rows. Row 0 is reserved
-    as the trash page (inactive-slot writes land there). Pure host state:
-    allocation decisions happen between device steps, mirroring how the
-    reference's device plugin hands out devices — the accelerator only
-    ever sees the resulting static tables."""
+    """Host-side refcounted free list over the pool's page rows. Row 0
+    is reserved as the trash page (inactive-slot writes land there).
+    Pure host state: allocation decisions happen between device steps,
+    mirroring how the reference's device plugin hands out devices — the
+    accelerator only ever sees the resulting static tables.
+
+    Refcounts exist for prefix sharing: a full prompt page reused by a
+    second request (or retained by the serving engine's prefix index)
+    is `share`d rather than copied; it returns to the free list only
+    when the last holder frees it. Shared pages are safe without
+    copy-on-write because only FULL pages are ever shared and decode
+    writes only at positions >= the slot's live length — a full shared
+    page is never a write target."""
 
     def __init__(self, n_pages: int):
         if n_pages < 2:
             raise ValueError("pool needs >= 2 pages (row 0 is reserved)")
         self._free = list(range(n_pages - 1, 0, -1))  # pop() -> low rows
+        self._refs: dict[int, int] = {}
         self.n_pages = n_pages
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    def refcount(self, row: int) -> int:
+        return self._refs.get(row, 0)
+
     def alloc(self, n: int = 1) -> list[int] | None:
-        """n pool rows, or None (nothing allocated) if unavailable."""
+        """n pool rows (refcount 1 each), or None (nothing allocated)
+        if unavailable."""
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        rows = [self._free.pop() for _ in range(n)]
+        for r in rows:
+            self._refs[r] = 1
+        return rows
+
+    def share(self, row: int) -> int:
+        """Take an additional reference on an allocated row."""
+        if self._refs.get(row, 0) < 1:
+            raise ValueError(f"share of unallocated page row {row}")
+        self._refs[row] += 1
+        return row
 
     def free(self, rows: list[int]) -> None:
+        """Drop one reference per row; rows reaching zero return to the
+        free list."""
         for r in rows:
             if not 0 < r < self.n_pages:
                 raise ValueError(f"bad page row {r}")
-            if r in self._free:
+            if self._refs.get(r, 0) < 1:
                 raise ValueError(f"double free of page row {r}")
-        self._free.extend(rows)
+        for r in rows:
+            self._refs[r] -= 1
+            if self._refs[r] == 0:
+                del self._refs[r]
+                self._free.append(r)
+
+
+class PrefixIndex:
+    """Host-side prefix cache over FULL prompt pages: a chain hash of
+    page-aligned token blocks -> the pool row holding that page's KV.
+    Each entry holds its own allocator reference, so retained pages
+    survive the request that computed them and later requests with the
+    same prompt prefix `share` the rows instead of recomputing the
+    prefix (the serving engine skips their forward entirely via
+    prefill_suffix_paged). LRU-bounded by `cap` entries; the engine
+    additionally evicts under pool pressure before preempting.
+
+    Chain hashing (hash of (parent_hash, page_tokens)) makes a page's
+    identity include its whole prefix, so two prompts sharing page 2's
+    tokens but differing in page 1 never collide."""
+
+    def __init__(self, alloc: PageAllocator, cap: int = 256):
+        import collections
+        self.alloc = alloc
+        self.cap = cap
+        self._lru: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()
+
+    @staticmethod
+    def chain_hashes(tokens, page: int, n_full: int) -> list[int]:
+        hashes, h = [], 0
+        for i in range(n_full):
+            h = hash((h, tuple(tokens[i * page:(i + 1) * page])))
+            hashes.append(h)
+        return hashes
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def match(self, hashes: list[int]) -> list[int]:
+        """Pool rows for the longest indexed chain prefix, one extra
+        reference taken per row (caller owns them)."""
+        rows = []
+        for h in hashes:
+            row = self._lru.get(h)
+            if row is None:
+                break
+            self._lru.move_to_end(h)
+            rows.append(self.alloc.share(row))
+        return rows
+
+    def insert(self, h: int, row: int) -> None:
+        if h in self._lru:
+            self._lru.move_to_end(h)
+            return
+        self._lru[h] = self.alloc.share(row)
+        if len(self._lru) > self.cap:
+            self.evict_lru()
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry (freeing its reference);
+        False when empty."""
+        if not self._lru:
+            return False
+        _, row = self._lru.popitem(last=False)
+        self.alloc.free([row])
+        return True
+
+    def clear(self) -> None:
+        while self.evict_lru():
+            pass
 
 
 @functools.lru_cache(maxsize=32)
@@ -443,6 +582,17 @@ def _jitted_decode_step_paged(cfg: LlamaConfig):
 def _jitted_prefill_slot_paged(cfg: LlamaConfig):
     return jax.jit(functools.partial(prefill_slot_paged, cfg=cfg),
                    donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_prefill_suffix_paged(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_suffix_paged, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_set_slot_pages():
+    return jax.jit(set_slot_pages, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=32)

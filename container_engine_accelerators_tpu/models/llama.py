@@ -282,6 +282,13 @@ _REMAT_POLICIES = {
 }
 
 
+def _resolve_remat_policy(name: str):
+    policy_name = _REMAT_POLICIES[name]
+    if policy_name is None:
+        return None
+    return getattr(jax.checkpoint_policies, policy_name)
+
+
 def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             constrain=None, mesh=None, return_aux: bool = False):
     """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
@@ -344,9 +351,7 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         return x, aux
 
     if cfg.remat_policy != "none":
-        policy_name = _REMAT_POLICIES[cfg.remat_policy]
-        policy = (getattr(jax.checkpoint_policies, policy_name)
-                  if policy_name else None)
+        policy = _resolve_remat_policy(cfg.remat_policy)
         layer_body = jax.checkpoint(layer_body, policy=policy)
 
     if use_pp:

@@ -49,8 +49,10 @@ def _pick_block(requested: int, s: int) -> int:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
-                acc, m_scr, l_scr, *, scale: float, block_q: int,
+                acc, m_scr, l_scr, *, block_q: int,
                 block_k: int, causal: bool, segmented: bool):
+    # q arrives pre-scaled by 1/sqrt(d) (one cheap [S, d] pass in the
+    # wrapper instead of a [bq, bk] VPU pass per block here).
     ki = pl.program_id(3)
     num_k = pl.num_programs(3)
 
@@ -65,19 +67,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
     k_start = ki * block_k
 
     run = True
+    needs_causal_mask = False
     if causal:
-        # Skip blocks strictly above the diagonal.
+        # Skip blocks strictly above the diagonal; blocks strictly below
+        # it (every key index <= every query index) skip the iota/where
+        # masking passes entirely — for long S most running blocks are
+        # interior, and the [bq, bk] elementwise passes are what bound
+        # this kernel (the MXU work is ~3 passes' worth at d=128).
         run = q_start + block_q - 1 >= k_start
+        needs_causal_mask = k_start + block_k - 1 > q_start
 
-    @pl.when(run)
-    def _compute():
+    def _body(mask_causal: bool):
         q = q_ref[0, 0, :, :]  # [bq, d]
         k = k_ref[0, 0, :, :]  # [bk, d]
         v = v_ref[0, 0, :, :]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if mask_causal:
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
@@ -98,6 +105,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    if causal:
+        @pl.when(run & needs_causal_mask)
+        def _compute_diag():
+            _body(True)
+
+        @pl.when(run & jnp.logical_not(needs_causal_mask))
+        def _compute_interior():
+            _body(False)
+    else:
+        _body(False)  # non-causal: only the segment mask (inside _body)
+
     @pl.when(ki == num_k - 1)
     def _finalize():
         l = l_scr[:, :1]
@@ -110,6 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
 def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
          segmented):
     b, h, s, d = q.shape
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     block_q = _pick_block(block_q, s)
     block_k = _pick_block(block_k, s)
     grid = (b, h, s // block_q, s // block_k)
@@ -121,7 +140,7 @@ def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
         return (bi, hi, ki, 0)
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+        functools.partial(_fwd_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
                           segmented=segmented),
         grid=grid,
@@ -158,7 +177,9 @@ def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_acc, *,
-                   scale, block_q, block_k, causal, segmented):
+                   block_q, block_k, causal, segmented):
+    # q arrives pre-scaled; the kernel's dq is w.r.t. scaled q, and the
+    # wrapper multiplies by scale once at the end ([S, d] pass).
     ki = pl.program_id(3)
     num_k = pl.num_programs(3)
 
@@ -169,11 +190,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
     q_start = pl.program_id(2) * block_q
     k_start = ki * block_k
     run = True
+    needs_causal_mask = False
     if causal:
         run = q_start + block_q - 1 >= k_start
+        needs_causal_mask = k_start + block_k - 1 > q_start
 
-    @pl.when(run)
-    def _compute():
+    def _body(mask_causal: bool):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -181,8 +203,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
         lse = lse_ref[0, 0, :, 0]      # [bq]
         delta = delta_ref[0, 0, :, 0]  # [bq]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if mask_causal:
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
@@ -193,10 +215,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale              # [bq, bk]
+        ds = p * (dp - delta[:, None])                      # [bq, bk]
         dq_acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
                                          (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(run & needs_causal_mask)
+        def _compute_diag():
+            _body(True)
+
+        @pl.when(run & jnp.logical_not(needs_causal_mask))
+        def _compute_interior():
+            _body(False)
+    else:
+        _body(False)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -205,7 +238,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, block_q, block_k, causal, segmented):
+                    block_q, block_k, causal, segmented):
+    # q arrives pre-scaled, which makes dk = ds^T @ q_scaled directly
+    # correct (s = q_scaled . k, so ds/dk carries the scale via q).
     qi = pl.program_id(3)
     num_q = pl.num_programs(3)
 
@@ -217,11 +252,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
     q_start = qi * block_q
     k_start = pl.program_id(2) * block_k
     run = True
+    needs_causal_mask = False
     if causal:
         run = q_start + block_q - 1 >= k_start
+        needs_causal_mask = k_start + block_k - 1 > q_start
 
-    @pl.when(run)
-    def _compute():
+    def _body(mask_causal: bool):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
@@ -229,8 +265,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if mask_causal:
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, NEG_INF)
@@ -245,11 +281,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         # dk += ds^T @ q
         dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(run & needs_causal_mask)
+        def _compute_diag():
+            _body(True)
+
+        @pl.when(run & jnp.logical_not(needs_causal_mask))
+        def _compute_interior():
+            _body(False)
+    else:
+        _body(False)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -259,6 +306,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, seg, causal, block_q, block_k, interpret, segmented):
+    # NOTE (round-3 finding): under `jax.checkpoint` the backward pass
+    # replays this forward kernel to rebuild the (out, lse) residuals —
+    # and no remat policy can prevent it: policies select values from
+    # the PRIMAL trace, while custom_vjp residuals materialize only in
+    # the backward replay of the fwd rule (verified by HLO kernel
+    # counts: naming out/lse and saving them grew residual memory but
+    # the 4th pallas call remained). The replay costs ~1 fwd kernel per
+    # layer (~1.3 ms at bench shapes); avoiding it would require moving
+    # attention outside the rematted region at ~170 MB/layer residual
+    # cost — a bad trade at current HBM headroom.
     scale = q.shape[-1] ** -0.5
     out, _ = _fwd(q, k, v, seg, scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, interpret=interpret, segmented=segmented)
@@ -278,6 +335,9 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
     q, k, v, seg, out, lse = res
     b, h, s, d = q.shape
     scale = d ** -0.5
+    # Kernels consume pre-scaled q (see _fwd); dq comes back w.r.t. the
+    # scaled q and is multiplied by scale below.
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     block_q = _pick_block(block_q, s)
     block_k = _pick_block(block_k, s)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -293,7 +353,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
         return (bi, hi, qi, 0)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+        functools.partial(_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
                           segmented=segmented),
         grid=(b, h, s // block_q, s // block_k),
@@ -326,7 +386,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
         return (bi, hi, qi, 0)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+        functools.partial(_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
                           segmented=segmented),
         grid=(b, h, s // block_k, s // block_q),
@@ -356,6 +416,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
         ],
         interpret=interpret,
     )(q, k, v, seg, seg, do, lse, delta)
+    dq = (dq.astype(jnp.float32) * scale).astype(dq.dtype)
     return dq, dk, dv, jnp.zeros_like(seg)
 
 

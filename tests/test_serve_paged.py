@@ -252,3 +252,97 @@ def test_max_len_capacity_invariant():
         assert eng.max_len >= 2000
     finally:
         eng.stop()
+
+
+# ---------- prefix sharing ----------
+
+def test_allocator_refcount_sharing():
+    a = PageAllocator(6)
+    (r,) = a.alloc(1)
+    assert a.refcount(r) == 1
+    a.share(r)
+    assert a.refcount(r) == 2
+    a.free([r])                       # one holder left
+    assert a.refcount(r) == 1 and a.free_pages == 4
+    a.free([r])                       # last holder: back to the pool
+    assert a.refcount(r) == 0 and a.free_pages == 5
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share(r)
+
+
+def test_prefix_index_chain_and_eviction():
+    from container_engine_accelerators_tpu.models.decode import PrefixIndex
+
+    a = PageAllocator(8)
+    idx = PrefixIndex(a, cap=2)
+    toks = list(range(32))
+    h = PrefixIndex.chain_hashes(toks, 16, 2)
+    (r0,) = a.alloc(1)
+    (r1,) = a.alloc(1)
+    idx.insert(h[0], r0)
+    idx.insert(h[1], r1)
+    # Chain property: same page tokens under a DIFFERENT first page
+    # must not match.
+    other = PrefixIndex.chain_hashes(list(range(100, 116)) + toks[16:],
+                                     16, 2)
+    assert other[1] != h[1]
+    m = idx.match(h)
+    assert m == [r0, r1] and a.refcount(r0) == 3  # alloc + index + match
+    a.free(m)
+    # Cap-2 LRU: the match refreshed h[0] then h[1], so after a third
+    # insert the eviction victim is h[0] (least recently touched).
+    (r2,) = a.alloc(1)
+    h3 = PrefixIndex.chain_hashes(list(range(50, 66)), 16, 1)
+    idx.insert(h3[0], r2)
+    assert len(idx) == 2
+    assert idx.match(h) == []         # h[0] evicted -> chain walk stops
+    assert a.refcount(r0) == 1        # only the original alloc ref left
+
+
+def test_engine_prefix_sharing_exact_and_correct(model):
+    """Two requests with the same long prompt: the second must reuse the
+    first's full prompt pages (prefix_pages_reused > 0, fewer fresh
+    pages consumed) and still return exactly the direct greedy result;
+    a third request sharing only the first page reuses just that one."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=4, max_len=256,
+                                page=16, pool_pages=40,
+                                max_prompt_len=128)
+    try:
+        prompt = list(range(1, 37))               # 36 tokens: 2 full pages
+        r1 = eng.submit(list(prompt), 4, 0.0).result(timeout=300)
+        assert eng.prefix_pages_reused == 0
+        r2 = eng.submit(list(prompt), 7, 0.0).result(timeout=300)
+        assert eng.prefix_pages_reused == 2       # both full pages shared
+        assert r1 == direct(params, cfg, prompt, 4)
+        assert r2 == direct(params, cfg, prompt, 7)
+        # Same first page, different second page.
+        forked = prompt[:16] + [99] * 20
+        r3 = eng.submit(list(forked), 5, 0.0).result(timeout=300)
+        assert eng.prefix_pages_reused == 3
+        assert r3 == direct(params, cfg, forked, 5)
+    finally:
+        eng.stop()
+
+
+def test_engine_prefix_cache_evicts_under_pressure(model):
+    """Retained prefix pages are a cache: when the pool runs dry they
+    must be evicted before any live request is preempted."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=64,
+                                page=16, pool_pages=6,  # 5 usable
+                                max_prompt_len=64)
+    try:
+        # Fills the index with 3 full pages, then finishes (pages only
+        # held by the index afterwards; 2 of the 5 usable stay free).
+        warm = list(range(1, 50))                 # 49 tokens: 3 full pages
+        eng.submit(list(warm), 2, 0.0).result(timeout=300)
+        # An unrelated request needing 3 prompt pages + a 4th during
+        # decode — the index must give pages back at admission AND at
+        # the growth step, with no live request preempted.
+        big = [77] * 40                           # buckets to 3 pages
+        got = eng.submit(list(big), 20, 0.0).result(timeout=300)
+        assert got == direct(params, cfg, big, 20)
+        assert eng.preemptions == 0
+    finally:
+        eng.stop()
