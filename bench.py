@@ -48,6 +48,42 @@ def detect_peak_flops() -> float:
     return 197e12  # conservative default
 
 
+def _emit_unavailable(detail: str) -> None:
+    """One structured JSON line so a backend outage reads as an outage in
+    BENCH_r*.json, not a crash with parsed=null (round-3 verdict item 1)."""
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": "tpu_unavailable",
+        "detail": detail[-400:],
+    }))
+
+
+def require_backend(attempts: int = 3, timeout_s: float = 120.0) -> bool:
+    """Prove the accelerator backend can initialise before touching it
+    in-process. With this environment's TPU plugin registered, a downed
+    tunnel makes ANY in-process jax.devices() call hang or raise inside
+    backends() with no interruptible point — so the probe runs in a
+    throwaway subprocess under a hard timeout (shared with the dryrun
+    entry: __graft_entry__.probe_default_backend), with a short bounded
+    retry to ride out transient tunnel flaps. Returns True when the
+    backend is up; emits the structured outage line and returns False
+    otherwise."""
+    from __graft_entry__ import probe_default_backend
+
+    last = "no attempt ran"
+    for i in range(attempts):
+        if i:
+            time.sleep(15 * i)
+        n_dev, last = probe_default_backend(timeout_s=timeout_s)
+        if n_dev > 0:
+            return True
+    _emit_unavailable(last)
+    return False
+
+
 def main():
     from container_engine_accelerators_tpu.models import llama
     from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
@@ -136,4 +172,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if not require_backend():
+        sys.exit(0)
+    try:
+        main()
+    except Exception as e:  # mid-run flap: still emit the structured line
+        msg = f"{type(e).__name__}: {e}"
+        if "UNAVAILABLE" in msg or "backend" in msg.lower():
+            _emit_unavailable(msg)
+            sys.exit(0)
+        raise
