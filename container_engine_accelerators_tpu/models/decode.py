@@ -54,9 +54,12 @@ class PagedKVCache(NamedTuple):
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
-               dtype=None) -> KVCache:
+               dtype=None, n_kv_heads: int | None = None) -> KVCache:
+    """`n_kv_heads` overrides cfg's count — the tensor-parallel path
+    allocates per-shard caches holding only the shard's local KV heads."""
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    hkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
+    shape = (cfg.n_layers, batch, max_len, hkv, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
 
@@ -144,7 +147,8 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
 
 
 def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
-                cfg: LlamaConfig, active: jnp.ndarray | None = None
+                cfg: LlamaConfig, active: jnp.ndarray | None = None,
+                tp_axis: str | None = None
                 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T new tokens ([B, T], T static — 1 for decode, prompt length for
     prefill). Returns (logits [B, T, vocab] float32, updated cache).
@@ -156,7 +160,16 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     the slot path pays the per-row scatter only where it's needed.
     `active` ([B] bool, slot path only) gates which rows' lengths
     advance; inactive (free) slots still compute — their writes land in
-    rows the next prefill overwrites."""
+    rows the next prefill overwrites.
+
+    `tp_axis` (inside shard_map only): Megatron-style tensor parallelism
+    over that mesh axis — wq/wk/wv/w_gate/w_up arrive column-sharded
+    (local heads / local ff), wo/w_down row-sharded, the cache holds
+    only local KV heads, and this function inserts the two per-layer
+    psums (after wo and w_down) plus the lm_head all-gather. Activations
+    (x) stay replicated, which is the right decode-time layout: at T=1
+    there is no sequence axis worth sharding. See models/decode_tp.py
+    for the specs + shard_map wiring."""
     b, t = tokens.shape
     paged = isinstance(cache, PagedKVCache)
     if paged:
@@ -180,12 +193,21 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     # kernel runs in interpret mode off-TPU.
     interpret = jax.default_backend() in ("cpu", "gpu")
 
-    def proj(h, w):
+    def proj(h, w, reduce: bool = False):
+        """reduce=True marks the row-sharded matmuls (wo, w_down) whose
+        outputs are partial sums under tensor parallelism."""
         n = h.shape[0] * h.shape[1]
         if isinstance(w, QuantWeight):
+            if tp_axis is not None:
+                raise NotImplementedError(
+                    "int8-quantized weights are not supported on the "
+                    "tensor-parallel decode path yet")
             out = int8_matmul(h.reshape(n, -1), w, interpret=interpret)
             return out.reshape(h.shape[0], h.shape[1], -1)
-        return h @ w.astype(h.dtype)
+        out = h @ w.astype(h.dtype)
+        if reduce and tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        return out
 
     if paged:
         # New token t_i of slot s lands at logical position
@@ -234,19 +256,21 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     def layer_body(x, scanned):
         lp, k_cache_in, v_cache_in = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = proj(h, lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = proj(h, lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-        v = proj(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        # Head counts come from the weights, not cfg: under tp the
+        # column-sharded wq/wk/wv produce only this shard's heads.
+        q = proj(h, lp["wq"]).reshape(b, t, -1, cfg.head_dim)
+        k = proj(h, lp["wk"]).reshape(b, t, -1, cfg.head_dim)
+        v = proj(h, lp["wv"]).reshape(b, t, -1, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
         k_cache = write(k_cache_in, k)
         v_cache = write(v_cache_in, v)
         attn = attend(q.astype(dt), k_cache, v_cache)
-        x = x + proj(attn.reshape(b, t, -1), lp["wo"])
+        x = x + proj(attn.reshape(b, t, -1), lp["wo"], reduce=True)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(proj(h2, lp["w_gate"]))
         up = proj(h2, lp["w_up"])
-        x = x + proj(gate * up, lp["w_down"])
+        x = x + proj(gate * up, lp["w_down"], reduce=True)
         return x, (k_cache, v_cache)
 
     # Scan over layers with stacked params + stacked caches as xs — one
@@ -258,6 +282,9 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if isinstance(params["lm_head"], QuantWeight):
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "int8-quantized lm_head unsupported on the tp decode path")
         n = b * t
         logits = int8_matmul(
             x.reshape(n, -1).astype(jnp.float32), params["lm_head"],
@@ -265,6 +292,12 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     else:
         logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                             params["lm_head"].astype(jnp.float32))
+        if tp_axis is not None:
+            # lm_head is vocab-column-sharded: concatenate the local
+            # vocab slices back to the full distribution. At decode T=1
+            # this moves B*V floats — trivial next to the matmul.
+            logits = jax.lax.all_gather(logits, tp_axis, axis=2,
+                                        tiled=True)
     new_len = cache.length + t
     if per_slot:
         new_len = jnp.minimum(cache.length + t, max_len)
@@ -299,20 +332,22 @@ def init_slot_cache(cfg: LlamaConfig, slots: int, max_len: int,
 
 
 def decode_step_slots(params: dict, cache: KVCache, tokens: jnp.ndarray,
-                      active: jnp.ndarray, cfg: LlamaConfig
+                      active: jnp.ndarray, cfg: LlamaConfig,
+                      tp_axis: str | None = None
                       ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step for every slot: tokens [B] (one per slot), active
     [B] bool. Returns (last-token logits [B, vocab] f32, cache with
     active lengths advanced). Thin wrapper: decode_step does the work,
     keyed off the cache's vector length."""
     logits, cache = decode_step(params, cache, tokens[:, None], cfg,
-                                active=active)
+                                active=active, tp_axis=tp_axis)
     return logits[:, 0], cache
 
 
 def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
                  tokens: jnp.ndarray, true_len: jnp.ndarray,
-                 cfg: LlamaConfig) -> tuple[jnp.ndarray, KVCache]:
+                 cfg: LlamaConfig, tp_axis: str | None = None
+                 ) -> tuple[jnp.ndarray, KVCache]:
     """Prefill ONE request into slot `slot` of a slot cache.
 
     tokens: [Tp] prompt padded to a bucket length (padding tokens run
@@ -323,8 +358,11 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
     Returns (logits of the last LIVE token [vocab] f32, updated cache).
     """
     tp = tokens.shape[0]
-    tmp = init_cache(cfg, 1, tp)
-    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg)
+    # Local-KV-head count derives from the PASSED cache, so the same code
+    # serves the replicated and tp-sharded (shard_map) paths.
+    tmp = init_cache(cfg, 1, tp, n_kv_heads=cache.k.shape[3])
+    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
+                              tp_axis=tp_axis)
     k = jax.lax.dynamic_update_slice(
         cache.k, tmp.k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(
@@ -347,20 +385,20 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
 
 def decode_step_paged(params: dict, cache: PagedKVCache,
                       tokens: jnp.ndarray, active: jnp.ndarray,
-                      cfg: LlamaConfig
+                      cfg: LlamaConfig, tp_axis: str | None = None
                       ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step for every slot of a paged cache: tokens [slots],
     active [slots] bool. The slot's next page (tables[s, len//page]) must
     already be allocated — the engine assigns pages BEFORE the step."""
     logits, cache = decode_step(params, cache, tokens[:, None], cfg,
-                                active=active)
+                                active=active, tp_axis=tp_axis)
     return logits[:, 0], cache
 
 
 def prefill_slot_paged(params: dict, cache: PagedKVCache,
                        slot: jnp.ndarray, rows: jnp.ndarray,
                        tokens: jnp.ndarray, true_len: jnp.ndarray,
-                       cfg: LlamaConfig
+                       cfg: LlamaConfig, tp_axis: str | None = None
                        ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill ONE request into the paged cache.
 
@@ -373,10 +411,12 @@ def prefill_slot_paged(params: dict, cache: PagedKVCache,
     tp = tokens.shape[0]
     page = cache.page
     n_pg = tp // page
-    tmp = init_cache(cfg, 1, tp)
-    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg)
+    hkv = cache.k_pool.shape[3]   # local count under tp sharding
+    tmp = init_cache(cfg, 1, tp, n_kv_heads=hkv)
+    logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
+                              tp_axis=tp_axis)
     L = cache.k_pool.shape[0]
-    hkv, d = cache.k_pool.shape[3], cache.k_pool.shape[4]
+    d = cache.k_pool.shape[4]
     k_pages = tmp.k.reshape(L, n_pg, page, hkv, d)
     v_pages = tmp.v.reshape(L, n_pg, page, hkv, d)
     k_pool = cache.k_pool.at[:, rows].set(
@@ -405,7 +445,8 @@ def set_slot_pages(cache: PagedKVCache, slot: jnp.ndarray,
 
 def prefill_suffix_paged(params: dict, cache: PagedKVCache,
                          slot: jnp.ndarray, suffix_tokens: jnp.ndarray,
-                         true_len: jnp.ndarray, cfg: LlamaConfig
+                         true_len: jnp.ndarray, cfg: LlamaConfig,
+                         tp_axis: str | None = None
                          ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill a request whose first `cache.length[slot]` tokens are
     ALREADY in the cache via shared prefix pages (prefix caching): only
@@ -426,7 +467,8 @@ def prefill_suffix_paged(params: dict, cache: PagedKVCache,
     len1 = jax.lax.dynamic_slice(cache.length, (slot,), (1,))
     sub = PagedKVCache(k_pool=cache.k_pool, v_pool=cache.v_pool,
                        tables=tab1, length=len1)
-    logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg)
+    logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg,
+                              tp_axis=tp_axis)
     length = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - len1[0] - 1]
     return last, PagedKVCache(k_pool=sub.k_pool, v_pool=sub.v_pool,
@@ -643,10 +685,14 @@ def _jitted_decode_step(cfg: LlamaConfig):
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              max_new_tokens: int, max_len: int | None = None,
              temperature: float = 0.0,
-             key: jax.Array | None = None) -> jnp.ndarray:
+             key: jax.Array | None = None, mesh=None) -> jnp.ndarray:
     """Greedy (temperature=0) or sampled generation. prompt: [B, T0].
     Returns [B, T0 + max_new_tokens]. With temperature > 0 and no `key`,
-    a fixed default key is used (deterministic sampling)."""
+    a fixed default key is used (deterministic sampling).
+
+    `mesh` (with a 'tp' axis > 1) runs every step tensor-parallel over
+    the mesh — params must already be placed by
+    decode_tp.shard_decode_params (or arrive replicated; jit reshards)."""
     if temperature > 0.0 and key is None:
         key = jax.random.key(0)
     b, t0 = prompt.shape
@@ -661,9 +707,16 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
         # slots ONCE, whereas an unpadded max_len (% 128 != 0) would
         # disqualify the kernel for every subsequent decode step.
         max_len = -(-max_len // 128) * 128
-    cache = init_cache(cfg, b, max_len)
 
-    step_fn = _jitted_decode_step(cfg)
+    tp_mesh = mesh is not None and mesh.shape.get("tp", 1) > 1
+    if tp_mesh:
+        from container_engine_accelerators_tpu.models import decode_tp
+        cache = decode_tp.init_sharded_cache(
+            lambda: init_cache(cfg, b, max_len), mesh)
+        step_fn = decode_tp.jitted_decode_step(cfg, mesh)
+    else:
+        cache = init_cache(cfg, b, max_len)
+        step_fn = _jitted_decode_step(cfg)
     logits, cache = step_fn(params, cache, prompt)
 
     def pick(logits_1, k):

@@ -1,0 +1,239 @@
+"""Tensor-parallel inference wiring: shard the KV-cache decode path over
+a `jax.sharding.Mesh` 'tp' axis so serving spans chips the way the
+reference's slice-scale workloads do (reference
+demo/tpu-training/resnet-tpu.yaml:47-55 requests 8 cores; a serving path
+pinned to one chip cannot hold the flagship model class — 8B bf16 + KV
+does not fit a v5e-class chip).
+
+Design (Megatron-style TP, decode-shaped):
+  - wq/wk/wv and w_gate/w_up are COLUMN-sharded over tp (each shard owns
+    n_heads/tp query heads, n_kv_heads/tp KV heads, d_ff/tp ff lanes);
+    wo and w_down are ROW-sharded; lm_head is vocab-column-sharded.
+  - The KV cache shards on its KV-HEAD axis — each chip holds only its
+    heads' cache, so cache HBM scales down 1/tp exactly like weights.
+  - Activations (x, [B, T<=page, d_model]) stay replicated: at decode
+    T=1 there is no sequence axis worth sharding, and replicating x is
+    what makes the per-layer comm exactly two psums (after wo, after
+    w_down) + one lm_head all-gather — all riding ICI.
+  - Everything runs inside ONE shard_map per step, so the pallas decode
+    kernels see local shapes and need no changes: paging, block tables,
+    and per-slot lengths are replicated host-side state.
+
+models/decode.py stays mesh-agnostic; its `tp_axis` hooks insert the
+collectives. This module owns the PartitionSpecs, the shard_map + jit
+wrappers (cached per (cfg, mesh) like decode's per-cfg jit caches), and
+parameter placement."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.models.decode import (
+    KVCache,
+    PagedKVCache,
+    decode_step,
+    decode_step_paged,
+    decode_step_slots,
+    prefill_slot,
+    prefill_slot_paged,
+    prefill_suffix_paged,
+)
+from container_engine_accelerators_tpu.models.llama import LlamaConfig
+
+TP_AXIS = "tp"
+
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    if tp <= 1:
+        return
+    bad = [name for name, dim in [
+        ("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
+        ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)]
+        if dim % tp]
+    if bad:
+        raise ValueError(
+            f"tp={tp} must divide {bad} (cfg: n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.n_kv_heads}, d_ff={cfg.d_ff}, "
+            f"vocab_size={cfg.vocab_size})")
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "MoE decode is not implemented (decode.py's layer body is "
+            "dense-only); tp decode inherits that limit")
+
+
+def decode_param_specs() -> dict:
+    """PartitionSpec tree matching models.llama.init_params (dense).
+
+    Unlike training's llama_param_specs, nothing shards over fsdp:
+    inference has no optimizer state to ZeRO-shard and decode re-reads
+    every weight each step, so weights live fully materialised in their
+    compute layout. embed stays replicated — a [B] gather per step is
+    too small to shard profitably."""
+    col = P(None, None, TP_AXIS)   # stacked [L, d_model, heads*dh | ff]
+    row = P(None, TP_AXIS, None)   # stacked [L, heads*dh | ff, d_model]
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": col, "wk": col, "wv": col,
+            "wo": row,
+            "mlp_norm": P(None, None),
+            "w_gate": col, "w_up": col,
+            "w_down": row,
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, TP_AXIS),
+    }
+
+
+def cache_specs(paged: bool, scalar_len: bool = False):
+    """Cache PartitionSpecs: KV-head axis over tp, host-visible state
+    (lengths, block tables) replicated."""
+    if paged:
+        return PagedKVCache(
+            k_pool=P(None, None, None, TP_AXIS, None),
+            v_pool=P(None, None, None, TP_AXIS, None),
+            tables=P(None, None), length=P(None))
+    return KVCache(k=P(None, None, None, TP_AXIS, None),
+                   v=P(None, None, None, TP_AXIS, None),
+                   length=P() if scalar_len else P(None))
+
+
+def shard_decode_params(params: dict, mesh: Mesh) -> dict:
+    """Place params on the mesh in the decode TP layout."""
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), decode_param_specs(),
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings)
+
+
+def _cache_shardings(sample, mesh: Mesh):
+    paged = isinstance(sample, PagedKVCache)
+    scalar = (not paged) and sample.length.ndim == 0
+    specs = cache_specs(paged, scalar_len=scalar)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def init_sharded_cache(factory, mesh: Mesh):
+    """Allocate a fresh cache DIRECTLY in its tp-sharded layout: each
+    chip materialises only its 1/tp KV-head slice. (Building the cache
+    unsharded first would commit the full [L,B,max_len,Hkv,D] buffer to
+    one device — at the 8B/v5e scale that motivates TP, that alloc OOMs
+    before any reshard could run.) `factory` is a zero-arg init, e.g.
+    lambda: init_slot_cache(cfg, slots, max_len)."""
+    sample = jax.eval_shape(factory)
+    return jax.jit(factory, out_shardings=_cache_shardings(sample, mesh))()
+
+
+def shard_cache(cache, mesh: Mesh):
+    """Reshard an EXISTING host/device cache onto the mesh. For fresh
+    caches prefer init_sharded_cache, which never materialises the
+    unsharded buffer."""
+    return jax.device_put(cache, _cache_shardings(cache, mesh))
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    # check_vma=False: the pallas decode kernels have no replication
+    # rule, and the replication invariants here are by construction
+    # (psum/all_gather before every replicated output).
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
+    """Classic scalar-length batched decode/prefill step over the mesh
+    (generate()'s step): (params, cache, tokens[B,T]) -> (logits, cache)."""
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=False, scalar_len=True)
+    fn = _smap(
+        functools.partial(decode_step, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(None, None)),
+        out_specs=(P(None, None, None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=False)
+    fn = _smap(
+        functools.partial(decode_step_slots, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(None), P(None)),
+        out_specs=(P(None, None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=False)
+    fn = _smap(
+        functools.partial(prefill_slot, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(), P(None), P()),
+        out_specs=(P(None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=True)
+    fn = _smap(
+        functools.partial(decode_step_paged, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(None), P(None)),
+        out_specs=(P(None, None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=True)
+    fn = _smap(
+        functools.partial(prefill_slot_paged, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(), P(None), P(None), P()),
+        out_specs=(P(None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=True)
+    fn = _smap(
+        functools.partial(prefill_suffix_paged, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(), P(None), P()),
+        out_specs=(P(None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def make_inference_mesh(tp: int | None = None,
+                        devices=None) -> Mesh:
+    """1-axis ('tp',) mesh over the local devices (default: all of them).
+    Serving wants every chip on tensor parallelism — dp at serve time is
+    better expressed as replica Pods, which is the reference's serving
+    scaling model (one server per node, a Service in front)."""
+    devices = list(devices if devices is not None else jax.devices())
+    tp = tp or len(devices)
+    if tp > len(devices):
+        raise ValueError(f"tp={tp} exceeds {len(devices)} devices")
+    import numpy as np
+    return Mesh(np.array(devices[:tp]), (TP_AXIS,))

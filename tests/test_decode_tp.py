@@ -1,0 +1,128 @@
+"""Parity tests for the tensor-parallel decode path (models/decode_tp.py)
+vs the single-device path, on the virtual CPU mesh — the same
+"both ends in one process" strategy the reference uses for its gRPC
+contracts (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import decode_tp
+from container_engine_accelerators_tpu.models.decode import (
+    _jitted_decode_step_slots,
+    _jitted_prefill_slot,
+    generate,
+    init_cache,
+    init_paged_cache,
+    init_slot_cache,
+)
+from container_engine_accelerators_tpu.models.llama import (
+    init_params,
+    llama_tiny,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # f32 activations isolate the parity check from bf16 rounding: the
+    # tp path rounds each psum PARTIAL to the activation dtype before
+    # reducing, so under bf16 the two paths legitimately differ at ~1e-2
+    # (Megatron-standard bf16 all-reduce). f32 leaves only reduction
+    # order, which must agree to ~1e-6.
+    return llama_tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.key(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def tp_mesh(cfg):
+    # tp=2 divides llama_tiny's n_kv_heads=2 / n_heads=4 / d_ff=256 / 512.
+    return decode_tp.make_inference_mesh(tp=2, devices=jax.devices()[:2])
+
+
+def test_generate_parity(cfg, params, tp_mesh):
+    prompt = jnp.asarray([[5, 17, 203], [9, 1, 42]], jnp.int32)
+    ref = generate(params, prompt, cfg, max_new_tokens=8)
+    tp_params = decode_tp.shard_decode_params(params, tp_mesh)
+    out = generate(tp_params, prompt, cfg, max_new_tokens=8, mesh=tp_mesh)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_slot_path_parity(cfg, params, tp_mesh):
+    slots, max_len = 4, 64
+    prompt = jnp.asarray([3, 7, 11, 13, 17, 19, 23, 29], jnp.int32)
+
+    # Reference: single-device slot cache.
+    cache_r = init_slot_cache(cfg, slots, max_len)
+    last_r, cache_r = _jitted_prefill_slot(cfg)(
+        params, cache_r, jnp.int32(1), prompt, jnp.int32(6))
+
+    tp_params = decode_tp.shard_decode_params(params, tp_mesh)
+    # init_sharded_cache: allocated directly in the sharded layout.
+    cache_t = decode_tp.init_sharded_cache(
+        lambda: init_slot_cache(cfg, slots, max_len), tp_mesh)
+    last_t, cache_t = decode_tp.jitted_prefill_slot(cfg, tp_mesh)(
+        tp_params, cache_t, jnp.int32(1), prompt, jnp.int32(6))
+
+    np.testing.assert_allclose(np.asarray(last_r), np.asarray(last_t),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_array_equal(np.asarray(cache_r.length),
+                                  np.asarray(cache_t.length))
+
+    toks = jnp.asarray([0, 31, 0, 0], jnp.int32)
+    act = jnp.asarray([False, True, False, False])
+    log_r, cache_r = _jitted_decode_step_slots(cfg)(
+        params, cache_r, toks, act)
+    log_t, cache_t = decode_tp.jitted_decode_step_slots(cfg, tp_mesh)(
+        tp_params, cache_t, toks, act)
+    np.testing.assert_allclose(np.asarray(log_r[1]), np.asarray(log_t[1]),
+                               atol=2e-4, rtol=2e-4)
+    assert int(jnp.argmax(log_r[1])) == int(jnp.argmax(log_t[1]))
+
+
+def test_paged_path_parity(cfg, params, tp_mesh):
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_paged,
+        _jitted_prefill_slot_paged,
+    )
+
+    slots, n_pages, page, max_pages = 2, 9, 8, 4
+    prompt = jnp.asarray(list(range(2, 18)), jnp.int32)  # 16 = 2 pages
+    rows = jnp.asarray([3, 4], jnp.int32)
+
+    cache_r = init_paged_cache(cfg, slots, n_pages, page, max_pages)
+    last_r, cache_r = _jitted_prefill_slot_paged(cfg)(
+        params, cache_r, jnp.int32(0), rows, prompt, jnp.int32(15))
+
+    tp_params = decode_tp.shard_decode_params(params, tp_mesh)
+    cache_t = decode_tp.shard_cache(
+        init_paged_cache(cfg, slots, n_pages, page, max_pages), tp_mesh)
+    last_t, cache_t = decode_tp.jitted_prefill_slot_paged(cfg, tp_mesh)(
+        tp_params, cache_t, jnp.int32(0), rows, prompt, jnp.int32(15))
+    np.testing.assert_allclose(np.asarray(last_r), np.asarray(last_t),
+                               atol=2e-4, rtol=2e-4)
+
+    toks = jnp.asarray([101, 0], jnp.int32)
+    act = jnp.asarray([True, False])
+    log_r, _ = _jitted_decode_step_paged(cfg)(
+        params, cache_r, toks, act)
+    log_t, _ = decode_tp.jitted_decode_step_paged(cfg, tp_mesh)(
+        tp_params, cache_t, toks, act)
+    np.testing.assert_allclose(np.asarray(log_r[0]), np.asarray(log_t[0]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_validate_tp_rejects_indivisible(cfg):
+    with pytest.raises(ValueError, match="tp=3"):
+        decode_tp.validate_tp(cfg, 3)
+    decode_tp.validate_tp(cfg, 2)  # divides everything
+
+
+def test_cache_shards_kv_heads(cfg, tp_mesh):
+    cache = decode_tp.shard_cache(init_slot_cache(cfg, 2, 32), tp_mesh)
+    shard_shape = cache.k.addressable_shards[0].data.shape
+    assert shard_shape[3] == cfg.n_kv_heads // 2
