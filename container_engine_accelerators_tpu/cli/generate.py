@@ -29,6 +29,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel ways over the local chips "
+                        "(models/decode_tp.py)")
     args = p.parse_args(argv)
 
     import jax
@@ -59,10 +62,16 @@ def main(argv=None) -> int:
         ids = [1]
     prompt = jnp.asarray([ids], jnp.int32)
 
+    mesh = None
+    if args.tp > 1:
+        from container_engine_accelerators_tpu.models import decode_tp
+        mesh = decode_tp.make_inference_mesh(tp=args.tp)
+        params = decode_tp.shard_decode_params(params, mesh)
+
     key = jax.random.key(args.seed) if args.temperature > 0 else None
     t0 = time.perf_counter()
     out = dec.generate(params, prompt, cfg, args.max_new_tokens,
-                       temperature=args.temperature, key=key)
+                       temperature=args.temperature, key=key, mesh=mesh)
     out_ids = [int(t) for t in out[0]]
     dt = time.perf_counter() - t0
     print("token ids:", out_ids)

@@ -1,0 +1,124 @@
+"""loadgen — serving load generator with time-to-first-token metrics
+(the reference pairs its serving demo with a load generator the same
+way, reference demo/serving/; TTFT is the latency the continuous
+engine's in-flight admission exists to improve, so the pair must
+measure it).
+
+Modes:
+  default    one-shot /generate POSTs; reports request latency.
+  --stream   SSE /generate (stream=true); additionally reports TTFT =
+             first `data:` event arrival minus request start, per
+             request, as p50/p90/p99.
+
+Prints ONE human line per percentile block plus a final JSON summary
+line (machine-consumable, mirrors bench.py's one-line discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import time
+import urllib.request
+
+
+def percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
+    if not xs:
+        return {f"p{p}": float("nan") for p in ps}
+    xs = sorted(xs)
+    out = {}
+    for p in ps:
+        idx = min(int(round(p / 100 * (len(xs) - 1))), len(xs) - 1)
+        out[f"p{p}"] = xs[idx]
+    return out
+
+
+def one_request(url: str, tokens: list[int], max_new: int,
+                stream: bool, timeout: float) -> dict:
+    """Returns {"latency": s, "ttft": s|None, "tokens": n_generated}."""
+    body = {"tokens": tokens, "max_new_tokens": max_new}
+    if stream:
+        body["stream"] = True
+    req = urllib.request.Request(url + "/generate",
+                                 data=json.dumps(body).encode())
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if not stream:
+            out = json.loads(resp.read())
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            return {"latency": time.perf_counter() - t0, "ttft": None,
+                    "tokens": len(out["tokens"]) - len(tokens)}
+        ttft = None
+        n_tok = 0
+        for line in resp:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            ev = json.loads(line[len("data: "):])
+            if "error" in ev:
+                raise RuntimeError(ev["error"])
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                n_tok += 1
+            if ev.get("done"):
+                break
+        return {"latency": time.perf_counter() - t0, "ttft": ttft,
+                "tokens": n_tok}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--requests", type=int, default=50)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="in-flight requests (exercises the continuous "
+                        "engine's slot pool)")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--stream", action="store_true",
+                   help="SSE mode: measure time-to-first-token")
+    p.add_argument("--timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+
+    def req_i(i: int) -> dict:
+        tokens = [(i * 7 + j) % 100 + 1 for j in range(args.prompt_len)]
+        return one_request(args.url, tokens, args.max_new_tokens,
+                           args.stream, args.timeout)
+
+    t0 = time.perf_counter()
+    results, errors = [], 0
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        for fut in [ex.submit(req_i, i) for i in range(args.requests)]:
+            try:
+                results.append(fut.result())
+            except Exception as e:
+                errors += 1
+                print(f"request failed: {e}")
+    wall = time.perf_counter() - t0
+
+    lat = percentiles([r["latency"] for r in results])
+    print(f"{len(results)}/{args.requests} ok in {wall:.1f}s "
+          f"({len(results) / wall:.1f} req/s); latency "
+          + " ".join(f"{k}={v * 1e3:.0f}ms" for k, v in lat.items()))
+    summary = {
+        "requests_ok": len(results), "errors": errors,
+        "req_per_sec": round(len(results) / wall, 2),
+        "latency_ms": {k: round(v * 1e3, 1) for k, v in lat.items()},
+        "tokens_per_sec": round(
+            sum(r["tokens"] for r in results) / wall, 1),
+    }
+    if args.stream:
+        ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
+        tt = percentiles(ttfts)
+        print("ttft " + " ".join(f"{k}={v * 1e3:.0f}ms"
+                                 for k, v in tt.items()))
+        summary["ttft_ms"] = {k: round(v * 1e3, 1) for k, v in tt.items()}
+    print(json.dumps(summary))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -383,6 +383,44 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
 # code only ever sees static shapes.
 
 
+def prefill_suffix_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
+                        suffix_tokens: jnp.ndarray, start: jnp.ndarray,
+                        new_len: jnp.ndarray, cfg: LlamaConfig,
+                        tp_axis: str | None = None
+                        ) -> tuple[jnp.ndarray, KVCache]:
+    """(Continue) prefilling slot `slot` of a contiguous slot cache: the
+    chunk `suffix_tokens` lands at positions [start, start+Ts) — the
+    chunked-prefill building block (serve.py runs one bounded chunk
+    between decode steps so a long admission can't stall in-flight
+    decodes). `start` is explicit (not read from cache.length[slot])
+    so the first chunk needs no separate slot-reset dispatch: a freed
+    slot's stale device length is simply ignored.
+
+    suffix_tokens: [Ts] the next chunk (padded; padding rows sit beyond
+    new_len and are overwritten by later chunks/decode). new_len: the
+    slot's live length AFTER this chunk. Returns (logits of the last
+    LIVE token [vocab] f32 — meaningful on the FINAL chunk, where
+    new_len is the prompt's true length, garbage-adjacent otherwise —
+    and the updated cache). Executables key on the static Ts bucket;
+    slot/start/new_len are traced."""
+    L, _, max_len, hkv, d = cache.k.shape
+    k1 = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
+                               (L, 1, max_len, hkv, d))
+    v1 = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
+                               (L, 1, max_len, hkv, d))
+    start = jnp.asarray(start, jnp.int32)
+    sub = KVCache(k=k1, v=v1, length=start.reshape(1))
+    logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg,
+                              tp_axis=tp_axis)
+    k = jax.lax.dynamic_update_slice(cache.k, sub.k.astype(cache.k.dtype),
+                                     (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, sub.v.astype(cache.v.dtype),
+                                     (0, slot, 0, 0, 0))
+    length = cache.length.at[slot].set(new_len)
+    last = logits[0, jnp.maximum(new_len - start - 1, 0)]
+    return last, KVCache(k=k, v=v, length=length)
+
+
 def decode_step_paged(params: dict, cache: PagedKVCache,
                       tokens: jnp.ndarray, active: jnp.ndarray,
                       cfg: LlamaConfig, tp_axis: str | None = None
@@ -560,43 +598,53 @@ class PrefixIndex:
 
     Chain hashing (hash of (parent_hash, page_tokens)) makes a page's
     identity include its whole prefix, so two prompts sharing page 2's
-    tokens but differing in page 1 never collide."""
+    tokens but differing in page 1 never collide. Entries also store
+    the page's ACTUAL tokens and match() compares them: Python hash()
+    is 64-bit, and a silent collision would attach another prompt's KV
+    pages to a request — wrong completions with no error (vLLM-style
+    prefix caches verify the same way)."""
 
     def __init__(self, alloc: PageAllocator, cap: int = 256):
         import collections
         self.alloc = alloc
         self.cap = cap
-        self._lru: "collections.OrderedDict[int, int]" = \
+        # hash -> (pool row, page token tuple)
+        self._lru: "collections.OrderedDict[int, tuple[int, tuple]]" = \
             collections.OrderedDict()
 
     @staticmethod
-    def chain_hashes(tokens, page: int, n_full: int) -> list[int]:
-        hashes, h = [], 0
+    def chain_keys(tokens, page: int,
+                   n_full: int) -> list[tuple[int, tuple]]:
+        """(chain hash, page tokens) per full page of the prompt."""
+        keys, h = [], 0
         for i in range(n_full):
-            h = hash((h, tuple(tokens[i * page:(i + 1) * page])))
-            hashes.append(h)
-        return hashes
+            block = tuple(tokens[i * page:(i + 1) * page])
+            h = hash((h, block))
+            keys.append((h, block))
+        return keys
 
     def __len__(self) -> int:
         return len(self._lru)
 
-    def match(self, hashes: list[int]) -> list[int]:
+    def match(self, keys: list[tuple[int, tuple]]) -> list[int]:
         """Pool rows for the longest indexed chain prefix, one extra
-        reference taken per row (caller owns them)."""
+        reference taken per row (caller owns them). A hash hit whose
+        stored tokens differ (collision) stops the walk."""
         rows = []
-        for h in hashes:
-            row = self._lru.get(h)
-            if row is None:
+        for h, block in keys:
+            hit = self._lru.get(h)
+            if hit is None or hit[1] != block:
                 break
             self._lru.move_to_end(h)
-            rows.append(self.alloc.share(row))
+            rows.append(self.alloc.share(hit[0]))
         return rows
 
-    def insert(self, h: int, row: int) -> None:
+    def insert(self, key: tuple[int, tuple], row: int) -> None:
+        h, block = key
         if h in self._lru:
             self._lru.move_to_end(h)
             return
-        self._lru[h] = self.alloc.share(row)
+        self._lru[h] = (self.alloc.share(row), block)
         if len(self._lru) > self.cap:
             self.evict_lru()
 
@@ -605,7 +653,7 @@ class PrefixIndex:
         False when empty."""
         if not self._lru:
             return False
-        _, row = self._lru.popitem(last=False)
+        _, (row, _) = self._lru.popitem(last=False)
         self.alloc.free([row])
         return True
 
@@ -662,6 +710,12 @@ def _jitted_decode_step_slots(cfg: LlamaConfig):
 @functools.lru_cache(maxsize=32)
 def _jitted_prefill_slot(cfg: LlamaConfig):
     return jax.jit(functools.partial(prefill_slot, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_prefill_suffix_slot(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_suffix_slot, cfg=cfg),
                    donate_argnums=(1,))
 
 

@@ -41,6 +41,7 @@ from container_engine_accelerators_tpu.models.decode import (
     prefill_slot,
     prefill_slot_paged,
     prefill_suffix_paged,
+    prefill_suffix_slot,
 )
 from container_engine_accelerators_tpu.models.llama import LlamaConfig
 
@@ -182,6 +183,19 @@ def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
         functools.partial(prefill_slot, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
         in_specs=(pspecs, cspecs, P(), P(None), P()),
+        out_specs=(P(None), cspecs))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs()
+    cspecs = cache_specs(paged=False)
+    fn = _smap(
+        functools.partial(prefill_suffix_slot, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(), P(None), P(), P()),
         out_specs=(P(None), cspecs))
     return jax.jit(fn, donate_argnums=(1,))
 

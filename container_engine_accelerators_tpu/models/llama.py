@@ -90,6 +90,17 @@ class LlamaConfig:
     # never drop; smaller factors trade buffer memory/compute for a
     # dropped_fraction > 0 only under extreme router imbalance.
     moe_ep_buffer_factor: float = 2.0
+    # Expert-parallel dispatch flavor (models/moe.py _moe_dropless_ep):
+    # 'bucket' = static per-(src,dst) buckets + dense all_to_all (runs
+    # on every backend; can drop under extreme imbalance unless
+    # factor >= ep); 'ragged' = jax.lax.ragged_all_to_all moving ONLY
+    # real rows on the wire, never drops, worst-case-sized recv buffer.
+    # 'ragged' requires a backend implementing the ragged-all-to-all
+    # HLO: TPU has it, XLA:CPU does not as of jaxlib 0.9.0
+    # ("UNIMPLEMENTED ... ThunkEmitter"), which is why 'bucket' stays
+    # the default and the CPU test suite pins 'ragged' by abstract
+    # trace only.
+    moe_ep_dispatch: str = "bucket"
     moe_aux_weight: float = 0.01
     moe_z_weight: float = 0.001
 
@@ -241,7 +252,8 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
     return x + constrain(attn @ lp["wo"].astype(dt), "resid")
 
 
-def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None):
+def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None,
+         in_pipeline: bool = False):
     dt = cfg.dtype
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts:
@@ -252,7 +264,8 @@ def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None):
 
         if cfg.moe_dropless:
             out, metrics = moe_mlp_dropless(h, lp, cfg, constrain,
-                                            mesh=mesh)
+                                            mesh=mesh,
+                                            in_pipeline=in_pipeline)
         else:
             out, metrics = moe_mlp(h, lp, cfg, constrain)
         aux = (cfg.moe_aux_weight * metrics.aux_loss
@@ -321,15 +334,6 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             "to be active (pp > 1, microbatches, "
             "pipeline_schedule='circular'); deinterleave_layers the "
             "stacked params for depth-ordered use")
-    if cfg.n_experts and cfg.moe_dropless and use_pp \
-            and mesh is not None and mesh.shape.get("ep", 1) > 1:
-        # The ep-dropless dispatch is its own shard_map; nesting it
-        # inside the pipeline's 'pp'-manual region would stack partial-
-        # manual regions, which the partitioner does not support.
-        raise ValueError(
-            "moe_dropless with ep > 1 cannot run inside the pipeline "
-            "(nested shard_map); use pp=1, the capacity path, or "
-            "moe_router='expert_choice'")
     if cfg.n_experts and cfg.moe_dropless \
             and cfg.moe_router != "token_choice":
         raise ValueError(
@@ -343,11 +347,12 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     def layer_body(x, lp):
         x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
-        # mesh reaches _mlp only outside the pipeline: the ep-dropless
-        # path opens its own shard_map, which must not nest inside the
-        # pipeline's 'pp'-manual region.
-        x, aux = _mlp(x, lp, cfg, layer_constrain,
-                      mesh=None if use_pp else mesh)
+        # Inside the pipeline the ep-dropless dispatch nests via the
+        # CONTEXT mesh (in_pipeline flag): passing the concrete mesh to
+        # the inner shard_map would clash with the 'pp'-manual context
+        # (see moe._moe_dropless_ep).
+        x, aux = _mlp(x, lp, cfg, layer_constrain, mesh=mesh,
+                      in_pipeline=use_pp)
         return x, aux
 
     if cfg.remat_policy != "none":

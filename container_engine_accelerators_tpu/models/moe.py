@@ -191,13 +191,23 @@ def _ragged_ffn(rows, lp, group_sizes, dt, pad_group: bool = False):
                               group_sizes)
 
 
-def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
+def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int,
+                     in_pipeline: bool = False):
     """Expert-parallel dropless path — see the module docstring.
 
     shard_map region: 'ep' manual, every other axis automatic. Token
     rows move to their expert's owner rank and back with one static
     all_to_all each way; the FFN itself is the same ragged_dot stack as
-    the single-rank path, over a zero-expert-padded trailing group."""
+    the single-rank path, over a zero-expert-padded trailing group.
+
+    `in_pipeline`: this call sits inside the pipeline's 'pp'-manual
+    shard_map region. The inner shard_map must then pick up the CONTEXT
+    mesh (no mesh= argument): passing the concrete mesh raises
+    "context mesh ... should match the mesh passed to shard_map"
+    because the context mesh carries pp as Manual. Context pickup nests
+    cleanly on jax 0.9 (round-4 probe: psum/all_to_all/ppermute all
+    execute correctly in the nested region) — this is what unblocked
+    ROADMAP item 2's pp x ep composition."""
     b, s, d = h.shape
     e, k = cfg.n_experts, cfg.moe_top_k
     if e % ep:
@@ -209,6 +219,7 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
     n_rows = n_loc * k                      # rows a rank originates
     factor = getattr(cfg, "moe_ep_buffer_factor", 2.0)
     c_pair = min(n_rows, max(k, int(-(-n_rows * factor // ep))))
+    ragged = getattr(cfg, "moe_ep_dispatch", "bucket") == "ragged"
     dt = h.dtype
     if jax.default_backend() == "cpu" and dt == jnp.bfloat16:
         # The XLA:CPU partitioner CHECK-crashes ("invalid binary
@@ -216,16 +227,19 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
         # manual shard_map boundaries — same quirk pipeline.py works
         # around. Run the whole dispatch in f32 there; TPU stays bf16.
         out, metrics = _moe_dropless_ep(h.astype(jnp.float32), lp, cfg,
-                                        mesh, ep)
+                                        mesh, ep,
+                                        in_pipeline=in_pipeline)
         return out.astype(dt), metrics
 
-    def per_shard(h_full, w_router, w_gate, w_up, w_down):
+    def per_shard(x_loc, w_router, w_gate, w_up, w_down):
+        # x_loc: [n_loc, d] — this rank's 1/ep token slice, delivered by
+        # the in_spec (ep acts as an extra data split for the dispatch).
+        # The slice MUST come from the spec, not an axis_index dynamic
+        # slice of a replicated operand: the transpose of that pattern
+        # trips the sdy verifier when this shard_map nests inside the
+        # pipeline's 'pp'-manual region ("operates on axis 'pp' which is
+        # already bound by a parent sdy.manual_computation").
         lp_loc = {"w_gate": w_gate, "w_up": w_up, "w_down": w_down}
-        r = jax.lax.axis_index("ep")
-        x = h_full.reshape(n_tok, d)
-        # This rank routes its own 1/ep slice of the (ep-replicated)
-        # tokens — ep acts as an extra data split for the dispatch.
-        x_loc = jax.lax.dynamic_slice_in_dim(x, r * n_loc, n_loc, 0)
 
         logits = jnp.einsum("td,de->te", x_loc.astype(jnp.float32),
                             w_router.astype(jnp.float32))
@@ -237,45 +251,80 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
         token_of_row = order // k
         rows = x_loc[token_of_row].astype(dt)            # [n_rows, D]
 
-        # Destination bucketing: experts are blocked over ranks, and
-        # rows are expert-sorted, so each destination's rows are a
-        # contiguous span. mode='drop' discards bucket overflow (counted
-        # below; impossible when c_pair == n_rows).
+        # Experts are blocked over ranks, and rows are expert-sorted, so
+        # each destination rank's rows are a contiguous span.
         dest = sorted_experts // e_local                 # [n_rows]
         dcount = jnp.bincount(dest, length=ep)
         dstart = jnp.cumsum(dcount) - dcount
         within = jnp.arange(n_rows) - dstart[dest]
-        send_rows = jnp.zeros((ep, c_pair, d), dt).at[dest, within].set(
-            rows, mode="drop")
-        # Pad sentinel e_local sorts after every real local expert id.
-        send_ids = jnp.full((ep, c_pair), e_local, jnp.int32).at[
-            dest, within].set(sorted_experts % e_local, mode="drop")
-        n_dropped = jnp.sum(jnp.where(within >= c_pair, 1.0, 0.0))
 
-        recv_rows = jax.lax.all_to_all(send_rows, "ep", 0, 0, tiled=True)
-        recv_ids = jax.lax.all_to_all(send_ids, "ep", 0, 0, tiled=True)
+        if ragged:
+            # Variable-size dispatch: only REAL rows move on the wire
+            # and nothing can drop, at the cost of a worst-case-sized
+            # recv buffer (every rank routes everything to me). The
+            # count matrix C[r, j] (rows rank r sends rank j) gives
+            # every offset both directions need.
+            r_idx = jax.lax.axis_index("ep")
+            C = jax.lax.all_gather(dcount, "ep", axis=0,
+                                   tiled=False)          # [ep, ep]
+            recv_counts = C[:, r_idx]                    # [ep] into me
+            recv_offs = jnp.cumsum(recv_counts) - recv_counts
+            src_before = jnp.arange(ep)[:, None] < r_idx
+            out_offs = jnp.sum(jnp.where(src_before, C, 0), axis=0)
+            cap = n_rows * ep
+            recv_rows = jax.lax.ragged_all_to_all(
+                rows, jnp.zeros((cap, d), dt),
+                dstart, dcount, out_offs, recv_counts, axis_name="ep")
+            # Pad sentinel e_local fills unreceived capacity, sorting
+            # after every real local expert id (same pad-group trick as
+            # the bucket path).
+            flat_ids = jax.lax.ragged_all_to_all(
+                sorted_experts % e_local,
+                jnp.full((cap,), e_local, jnp.int32),
+                dstart, dcount, out_offs, recv_counts, axis_name="ep")
+            n_dropped = jnp.zeros((), jnp.float32)
+        else:
+            # Static per-(src,dst) buckets + dense all_to_all.
+            # mode='drop' discards bucket overflow (counted below;
+            # impossible when c_pair == n_rows).
+            send_rows = jnp.zeros((ep, c_pair, d), dt).at[
+                dest, within].set(rows, mode="drop")
+            send_ids = jnp.full((ep, c_pair), e_local, jnp.int32).at[
+                dest, within].set(sorted_experts % e_local, mode="drop")
+            n_dropped = jnp.sum(jnp.where(within >= c_pair, 1.0, 0.0))
+            recv_rows = jax.lax.all_to_all(
+                send_rows, "ep", 0, 0, tiled=True).reshape(-1, d)
+            flat_ids = jax.lax.all_to_all(
+                send_ids, "ep", 0, 0, tiled=True).reshape(-1)
 
-        flat_ids = recv_ids.reshape(-1)                  # [ep*c_pair]
         order2 = jnp.argsort(flat_ids, stable=True)
-        rows2 = recv_rows.reshape(-1, d)[order2]
+        rows2 = recv_rows[order2]
         gs = jnp.bincount(flat_ids, length=e_local + 1).astype(jnp.int32)
         down = _ragged_ffn(rows2, lp_loc, gs, dt, pad_group=True)
 
         # Invert the expert sort, return rows to their source rank, and
         # combine at the source with the gate weights.
         unsorted = jnp.zeros_like(down).at[order2].set(down)
-        ret = jax.lax.all_to_all(unsorted.reshape(ep, c_pair, d),
-                                 "ep", 0, 0, tiled=True)
-        res = ret[dest, jnp.clip(within, 0, c_pair - 1)]
-        res = jnp.where((within < c_pair)[:, None], res, 0.0)
+        if ragged:
+            # Return trip mirrors the dispatch: my block from source r
+            # sits at recv_offs[r], and lands back in r's expert-sorted
+            # row order at r's dest==me span start (sum of r's counts to
+            # destinations before me).
+            dst_before = jnp.arange(ep)[None, :] < r_idx
+            ret_offs = jnp.sum(jnp.where(dst_before, C, 0), axis=1)
+            res = jax.lax.ragged_all_to_all(
+                unsorted, jnp.zeros((n_rows, d), dt),
+                recv_offs, recv_counts, ret_offs, dcount, axis_name="ep")
+        else:
+            ret = jax.lax.all_to_all(unsorted.reshape(ep, c_pair, d),
+                                     "ep", 0, 0, tiled=True)
+            res = ret[dest, jnp.clip(within, 0, c_pair - 1)]
+            res = jnp.where((within < c_pair)[:, None], res, 0.0)
         weighted = res * gates_flat[order][:, None].astype(dt)
         out_loc = jnp.zeros((n_loc, d), dt).at[token_of_row].add(weighted)
-
-        # Reassemble the full token axis: rank r holds span r, so a
-        # tiled all-gather reproduces the [n_tok, d] order directly —
-        # half the collective volume of a psum over a zero-padded
-        # full-size buffer, and no temporary.
-        out = jax.lax.all_gather(out_loc, "ep", axis=0, tiled=True)
+        # Rank r holds token span r; the tiled out_spec reassembles the
+        # [n_tok, d] order with no explicit collective at all (the old
+        # in-region all_gather is gone along with the replicated input).
 
         # Aux losses must match the global (ep=1) formula exactly: the
         # load-balance term is a product of token-MEANS, so psum the
@@ -290,21 +339,24 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int):
         z = jax.lax.psum(
             jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), "ep") / ep
         dropped = jax.lax.psum(n_dropped, "ep") / (n_tok * k)
-        return out.reshape(b, s, d), aux, z, dropped
+        return out_loc, aux, z, dropped
 
     from jax.sharding import PartitionSpec as P
+    smap_kw: dict = {} if in_pipeline else {"mesh": mesh}
     out, aux, z, dropped = jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(), P(), P("ep"), P("ep"), P("ep")),
-        out_specs=(P(), P(), P(), P()),
+        per_shard,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep")),
+        out_specs=(P("ep"), P(), P(), P()),
         axis_names={"ep"},
         check_vma=False,
-    )(h, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"])
-    return out, MoeMetrics(aux, z, dropped)
+        **smap_kw,
+    )(h.reshape(n_tok, d), lp["w_router"], lp["w_gate"], lp["w_up"],
+      lp["w_down"])
+    return out.reshape(b, s, d), MoeMetrics(aux, z, dropped)
 
 
 def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None,
-                     mesh=None):
+                     mesh=None, in_pipeline: bool = False):
     """Dropless token-choice MoE via grouped matmul. Same weights and
     router as moe_mlp; every routed (token, expert) pair is computed.
 
@@ -312,10 +364,13 @@ def moe_mlp_dropless(h: jnp.ndarray, lp: dict, cfg, constrain=None,
     weights (expert-contiguous groups) -> combine by scatter-add with
     the gate weights. All shapes static; only group_sizes is data-
     dependent, which ragged_dot is built for. Meshes with ep > 1 take
-    the shard_map all-to-all dispatch path (_moe_dropless_ep)."""
+    the shard_map all-to-all dispatch path (_moe_dropless_ep);
+    `in_pipeline` marks a call from inside the pipeline's 'pp'-manual
+    region (the dispatch then nests via the context mesh)."""
     ep = mesh.shape.get("ep", 1) if mesh is not None else 1
     if ep > 1:
-        return _moe_dropless_ep(h, lp, cfg, mesh, ep)
+        return _moe_dropless_ep(h, lp, cfg, mesh, ep,
+                                in_pipeline=in_pipeline)
     b, s, d = h.shape
     e, k = cfg.n_experts, cfg.moe_top_k
     dt = h.dtype

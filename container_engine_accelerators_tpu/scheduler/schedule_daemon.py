@@ -96,35 +96,92 @@ def pod_sort_key(pod: dict):
 
 # ---------- resource accounting ----------
 
-def _pod_tpu_request(pod: dict) -> int:
-    total = 0
+_QUANT_SUFFIX = {
+    "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "E": 1e18, "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30,
+    "Ti": 2 ** 40, "Pi": 2 ** 50, "Ei": 2 ** 60,
+}
+
+
+def parse_quantity(q) -> float:
+    """Kubernetes resource quantity -> float ('500m' cpu, '4Gi' memory,
+    '123e6', plain ints) — the stdlib stand-in for kubernetes.utils.
+    quantity the reference leans on (reference gke-topology-scheduler/
+    schedule-daemon.py:245-332). Unparseable -> 0 (counts as no
+    capacity / no request, never as infinite)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    try:
+        return float(s)  # covers plain and exponent ('1e3') forms
+    except ValueError:
+        pass
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei",
+                "k", "M", "G", "T", "P", "E", "m"):
+        if s.endswith(suf):
+            try:
+                return float(s[:-len(suf)]) * _QUANT_SUFFIX[suf]
+            except ValueError:
+                return 0.0
+    return 0.0
+
+
+def _pod_requests(pod: dict) -> dict[str, float]:
+    """Sum of EVERY requested resource over the pod's containers — not
+    just TPUs. A gang placed by chip count alone can land on nodes with
+    no cpu/memory headroom and sit Pending forever after ungating (the
+    failure gang-repair can't fix, because nothing is NotReady)."""
+    total: dict[str, float] = {}
     for c in pod.get("spec", {}).get("containers", []) or []:
         req = (c.get("resources", {}) or {}).get("requests", {}) or {}
-        try:
-            total += int(req.get(TPU_RESOURCE_NAME, 0))
-        except (TypeError, ValueError):
-            pass
+        for name, qty in req.items():
+            total[name] = total.get(name, 0.0) + parse_quantity(qty)
     return total
+
+
+def _pod_tpu_request(pod: dict) -> int:
+    return int(_pod_requests(pod).get(TPU_RESOURCE_NAME, 0))
+
+
+def _fits(cap: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(cap.get(name, 0.0) >= qty - 1e-9
+               for name, qty in demand.items() if qty > 0)
+
+
+def _sub_requests(cap: dict[str, float],
+                  demand: dict[str, float]) -> dict[str, float]:
+    out = dict(cap)
+    for name, qty in demand.items():
+        out[name] = out.get(name, 0.0) - qty
+    return out
+
+
+def free_resources_by_node(nodes: list[dict], running_pods: list[dict]
+                           ) -> dict[str, dict[str, float]]:
+    """Per TPU node: every allocatable resource minus the requests of
+    pods already assigned there (reference :245-332 computes the same
+    generic vector). Nodes without TPU capacity are omitted — this
+    scheduler only places TPU gangs."""
+    free: dict[str, dict[str, float]] = {}
+    for node in nodes:
+        alloc = (node.get("status", {}).get("allocatable", {}) or {})
+        parsed = {name: parse_quantity(qty) for name, qty in alloc.items()}
+        if parsed.get(TPU_RESOURCE_NAME, 0) > 0:
+            free[node["metadata"]["name"]] = parsed
+    for pod in running_pods:
+        node = pod.get("spec", {}).get("nodeName")
+        if node in free:
+            free[node] = _sub_requests(free[node], _pod_requests(pod))
+    return free
 
 
 def free_tpus_by_node(nodes: list[dict], running_pods: list[dict]
                       ) -> dict[str, int]:
-    """Allocatable minus requests of pods already assigned (reference
-    :245-332)."""
-    free = {}
-    for node in nodes:
-        alloc = (node.get("status", {}).get("allocatable", {}) or {})
-        try:
-            cap = int(alloc.get(TPU_RESOURCE_NAME, 0))
-        except (TypeError, ValueError):
-            cap = 0
-        if cap > 0:
-            free[node["metadata"]["name"]] = cap
-    for pod in running_pods:
-        node = pod.get("spec", {}).get("nodeName")
-        if node in free:
-            free[node] -= _pod_tpu_request(pod)
-    return free
+    """TPU-count view of free_resources_by_node (kept for callers that
+    only track chips)."""
+    return {name: int(res.get(TPU_RESOURCE_NAME, 0))
+            for name, res in free_resources_by_node(
+                nodes, running_pods).items()}
 
 
 # ---------- assignment search ----------
@@ -143,22 +200,38 @@ def assign_pods(pods: list[dict], nodes: list[dict],
     `anchors` are topologies of gang members already Running (survivors
     of a partial node failure): they join the window's distance score so
     the recreated members land near the survivors instead of forming a
-    cross-rack gang."""
-    demands = [(pod["metadata"]["name"], _pod_tpu_request(pod))
-               for pod in sorted(pods, key=pod_sort_key)]
-    uniform = len({d for _, d in demands}) == 1
-    demand0 = demands[0][1] if demands else 0
+    cross-rack gang.
 
-    slots: list[tuple[NodeTopology, int]] = []
+    Demands and capacities are full RESOURCE VECTORS (tpu + cpu +
+    memory + anything requested), not chip counts: a node whose chips
+    are free but whose cpu is spoken for must not receive a gang member
+    (reference :245-332). `free` accepts either the vector form
+    (free_resources_by_node) or the legacy {node: tpu_count} ints."""
+    free_vec = {name: (v if isinstance(v, dict)
+                       else {TPU_RESOURCE_NAME: float(v)})
+                for name, v in free.items()}
+    demands = [(pod["metadata"]["name"], _pod_requests(pod))
+               for pod in sorted(pods, key=pod_sort_key)]
+    uniform = len({tuple(sorted(d.items())) for _, d in demands}) == 1
+    demand0 = demands[0][1] if demands else {}
+    tpu_dem = demand0.get(TPU_RESOURCE_NAME, 0)
+
+    # Slot capacity is the resource vector the slot can still serve; on
+    # the uniform path each slot IS one gang member's demand, and a node
+    # contributes as many slots as its scarcest requested resource
+    # allows.
+    slots: list[tuple[NodeTopology, dict]] = []
     for node in nodes:
         name = node["metadata"]["name"]
-        cap = free.get(name, 0)
-        if cap <= 0:
+        cap = free_vec.get(name)
+        if not cap or cap.get(TPU_RESOURCE_NAME, 0) <= 0:
             continue
         labels = node.get("metadata", {}).get("labels", {}) or {}
         topo = NodeTopology.from_labels(name, labels)
-        if uniform and demand0 > 0:
-            slots.extend((topo, demand0) for _ in range(cap // demand0))
+        if uniform and tpu_dem > 0:
+            n_slots = min(int(cap.get(res, 0) // qty)
+                          for res, qty in demand0.items() if qty > 0)
+            slots.extend((topo, demand0) for _ in range(n_slots))
         else:
             slots.append((topo, cap))
     if len(slots) < len(demands):
@@ -169,7 +242,7 @@ def assign_pods(pods: list[dict], nodes: list[dict],
     n, k = len(slots), len(demands)
     for start in range(n - k + 1):
         window = slots[start:start + k]
-        if any(cap < demand for (_, cap), (_, demand)
+        if any(not _fits(cap, demand) for (_, cap), (_, demand)
                in zip(window, demands)):
             continue
         score = pairwise_distance([t for t, _ in window] + list(anchors))
@@ -263,12 +336,15 @@ def _refine_selection(slots, demands, anchors,
     topos = [slots[i][0] for i in chosen]
 
     # Group slot indices by topology; within a group prefer the highest
-    # capacity so one representative answers feasibility for any demand.
+    # TPU capacity (then cpu) so better-provisioned slots are tried
+    # first; usable_index still scans the whole group, so multi-resource
+    # feasibility stays exact.
     groups: dict[tuple, list[int]] = {}
     for i, (t, _) in enumerate(slots):
         groups.setdefault(topology_sort_key(t), []).append(i)
     for g in groups.values():
-        g.sort(key=lambda i: -slots[i][1])
+        g.sort(key=lambda i: (-slots[i][1].get(TPU_RESOURCE_NAME, 0),
+                              -slots[i][1].get("cpu", 0)))
     rep_topo = {key: slots[g[0]][0] for key, g in groups.items()}
 
     def full_sum(t):
@@ -283,8 +359,8 @@ def _refine_selection(slots, demands, anchors,
 
     def usable_index(key, demand):
         for i in groups[key]:
-            if i not in in_use:
-                return i if slots[i][1] >= demand else None
+            if i not in in_use and _fits(slots[i][1], demand):
+                return i
         return None
 
     for _ in range(max_rounds):
@@ -470,7 +546,7 @@ def run_once(k8s) -> int:
                 if p.get("spec", {}).get("nodeName")
                 and p.get("status", {}).get("phase")
                 not in ("Succeeded", "Failed")]
-    free = free_tpus_by_node(ready_nodes, assigned)
+    free = free_resources_by_node(ready_nodes, assigned)
     node_topo = {n["metadata"]["name"]: NodeTopology.from_labels(
         n["metadata"]["name"],
         n.get("metadata", {}).get("labels", {}) or {}) for n in nodes}
@@ -502,7 +578,7 @@ def run_once(k8s) -> int:
             ns = pod["metadata"].get("namespace", "default")
             node = assignment[name]
             schedule_pod_on_node(k8s, ns, name, node, find_gate(pod))
-            free[node] -= _pod_tpu_request(pod)
+            free[node] = _sub_requests(free[node], _pod_requests(pod))
             scheduled += 1
         log.info("group %s: scheduled %d pods", key, len(pods))
     return scheduled + repaired

@@ -521,3 +521,103 @@ def test_assign_mixed_demands_one_pod_per_node():
     got = sd.assign_pods(pods, nodes, free)
     assert got is not None
     assert got["j-0"] != got["j-1"]
+
+
+# ---------- generic (cpu/memory/any) resource accounting ----------
+
+def rnode(name, tpus=4, cpu="8", memory="32Gi", labels=None):
+    n = node(name, tpus=tpus, labels=labels)
+    n["status"]["allocatable"].update({"cpu": cpu, "memory": memory})
+    return n
+
+
+def rpod(name, tpus=4, cpu=None, memory=None, **kw):
+    p = pod(name, tpus=tpus, **kw)
+    req = p["spec"]["containers"][0]["resources"]["requests"]
+    if cpu is not None:
+        req["cpu"] = cpu
+    if memory is not None:
+        req["memory"] = memory
+    return p
+
+
+def test_parse_quantity_forms():
+    assert sd.parse_quantity("500m") == 0.5
+    assert sd.parse_quantity("4") == 4.0
+    assert sd.parse_quantity("4Gi") == 4 * 2 ** 30
+    assert sd.parse_quantity("2M") == 2e6
+    assert sd.parse_quantity("1e3") == 1000.0
+    assert sd.parse_quantity(3) == 3.0
+    assert sd.parse_quantity("garbage") == 0.0
+
+
+def test_free_resources_subtracts_all_requests():
+    nodes = [rnode("n0", tpus=4, cpu="8", memory="32Gi")]
+    running = [rpod("r0", node="n0", gates=(), phase="Running",
+                    tpus=2, cpu="6500m", memory="8Gi")]
+    free = sd.free_resources_by_node(nodes, running)
+    assert free["n0"]["google.com/tpu"] == 2
+    assert free["n0"]["cpu"] == pytest.approx(1.5)
+    assert free["n0"]["memory"] == pytest.approx(24 * 2 ** 30)
+
+
+def test_assign_excludes_nodes_without_cpu_headroom():
+    """VERDICT r3 item 5's done-condition: a gang whose TPUs fit but
+    whose cpu does not must skip those nodes — previously it would be
+    affinity-pinned there and sit Pending forever after ungating."""
+    nodes = [
+        rnode("starved-0", labels=slice_labels("s1", "0-0")),
+        rnode("starved-1", labels=slice_labels("s1", "1-0")),
+        rnode("ok-0", labels=slice_labels("s2", "0-0", rack="r2")),
+        rnode("ok-1", labels=slice_labels("s2", "1-0", rack="r2")),
+    ]
+    # The topologically-preferred s1 nodes have chips free but cpu
+    # consumed by a running daemon; the gang requests cpu too.
+    running = [rpod("d0", node="starved-0", gates=(), phase="Running",
+                    tpus=0, cpu="7"),
+               rpod("d1", node="starved-1", gates=(), phase="Running",
+                    tpus=0, cpu="7")]
+    pods = [rpod("j-0", labels={"job-name": "j"}, cpu="2"),
+            rpod("j-1", labels={"job-name": "j"}, cpu="2")]
+    free = sd.free_resources_by_node(nodes, running)
+    got = sd.assign_pods(pods, nodes, free)
+    assert got is not None
+    assert {got["j-0"], got["j-1"]} == {"ok-0", "ok-1"}
+
+
+def test_assign_gang_unplaceable_when_cpu_short_everywhere():
+    nodes = [rnode("n0", cpu="1"), rnode("n1", cpu="1")]
+    pods = [rpod("j-0", labels={"job-name": "j"}, cpu="2"),
+            rpod("j-1", labels={"job-name": "j"}, cpu="2")]
+    free = sd.free_resources_by_node(nodes, [])
+    assert sd.assign_pods(pods, nodes, free) is None
+
+
+def test_uniform_slots_limited_by_scarcest_resource():
+    # 4 chips but cpu for only ONE 2-cpu member: the node contributes a
+    # single slot, so a 2-pod gang needs the second node.
+    nodes = [rnode("n0", tpus=4, cpu="3"), rnode("n1", tpus=4, cpu="3")]
+    pods = [rpod("j-0", labels={"job-name": "j"}, tpus=1, cpu="2"),
+            rpod("j-1", labels={"job-name": "j"}, tpus=1, cpu="2")]
+    free = sd.free_resources_by_node(nodes, [])
+    got = sd.assign_pods(pods, nodes, free)
+    assert got is not None
+    assert got["j-0"] != got["j-1"]
+
+
+def test_run_once_respects_cpu_headroom(fake_k8s, client):
+    for n in [rnode("s1-0", labels=slice_labels("s1", "0-0")),
+              rnode("s2-0", labels=slice_labels("s2", "0-0", rack="r2"))]:
+        fake_k8s.nodes[n["metadata"]["name"]] = n
+    # cpu hog pinned to the topologically-first node.
+    hog = rpod("hog", node="s1-0", gates=(), phase="Running",
+               tpus=0, cpu="7500m")
+    fake_k8s.pods[("default", "hog")] = hog
+    gang = rpod("j-0", labels={"job-name": "j"}, tpus=4, cpu="2")
+    fake_k8s.pods[("default", "j-0")] = gang
+    assert sd.run_once(client) == 1
+    placed = fake_k8s.pods[("default", "j-0")]
+    aff = placed["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"][
+        "nodeSelectorTerms"][0]["matchExpressions"][0]
+    assert aff["values"] == ["s2-0"]

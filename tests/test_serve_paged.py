@@ -276,27 +276,45 @@ def test_prefix_index_chain_and_eviction():
     a = PageAllocator(8)
     idx = PrefixIndex(a, cap=2)
     toks = list(range(32))
-    h = PrefixIndex.chain_hashes(toks, 16, 2)
+    h = PrefixIndex.chain_keys(toks, 16, 2)
     (r0,) = a.alloc(1)
     (r1,) = a.alloc(1)
     idx.insert(h[0], r0)
     idx.insert(h[1], r1)
     # Chain property: same page tokens under a DIFFERENT first page
     # must not match.
-    other = PrefixIndex.chain_hashes(list(range(100, 116)) + toks[16:],
-                                     16, 2)
-    assert other[1] != h[1]
+    other = PrefixIndex.chain_keys(list(range(100, 116)) + toks[16:],
+                                   16, 2)
+    assert other[1][0] != h[1][0]
     m = idx.match(h)
     assert m == [r0, r1] and a.refcount(r0) == 3  # alloc + index + match
     a.free(m)
     # Cap-2 LRU: the match refreshed h[0] then h[1], so after a third
     # insert the eviction victim is h[0] (least recently touched).
     (r2,) = a.alloc(1)
-    h3 = PrefixIndex.chain_hashes(list(range(50, 66)), 16, 1)
+    h3 = PrefixIndex.chain_keys(list(range(50, 66)), 16, 1)
     idx.insert(h3[0], r2)
     assert len(idx) == 2
     assert idx.match(h) == []         # h[0] evicted -> chain walk stops
     assert a.refcount(r0) == 1        # only the original alloc ref left
+
+
+def test_prefix_index_hash_collision_is_a_miss():
+    """A 64-bit hash() collision must NOT attach another prompt's KV
+    pages (ADVICE r3): entries store the page's actual tokens and
+    match() compares them, so a colliding key reads as a miss."""
+    from container_engine_accelerators_tpu.models.decode import PrefixIndex
+
+    a = PageAllocator(4)
+    idx = PrefixIndex(a, cap=4)
+    real = PrefixIndex.chain_keys(list(range(16)), 16, 1)
+    (r0,) = a.alloc(1)
+    idx.insert(real[0], r0)
+    # Forge a colliding key: same chain hash, different page tokens.
+    forged = [(real[0][0], tuple(range(100, 116)))]
+    assert idx.match(forged) == []
+    assert idx.match(real) == [r0]
+    a.free([r0])
 
 
 def test_engine_prefix_sharing_exact_and_correct(model):

@@ -6,11 +6,15 @@ device memory stats, so the gap between measured MFU and the practical
 matmul ceiling (BASELINE.md: 0.55-0.68 on this chip) is *attributed*
 rather than guessed at.
 
-The key accounting fact: bench MFU counts 6N FLOPs/token (PaLM fwd+bwd)
-but `remat_policy='dots'` (dots_with_no_batch_dims_saveable) recomputes
-nearly the whole forward during backward, so the chip executes ~8N.
-A policy that saves matmul outputs ('dots_all') removes the extra 2N at
-the cost of ~b*s*(4d+2f) bf16 residuals per layer.
+The key accounting fact (measured, round 3 — this tool's own sweep):
+'dots' (dots_with_no_batch_dims_saveable) and 'dots_all'
+(dots_saveable) compile IDENTICALLY for this model — none of its
+matmuls are batched dot_generals, so both policies already save every
+matmul output and backward recomputes only cheap elementwise ops plus
+the flash-attention forward (a pallas call, not a dot). The earlier
+"+2N recompute under 'dots'" theory was wrong; the measured remat tax
+is the one forced flash forward replay (see ops/flash_attention.py and
+BASELINE.md round-3 notes).
 
 Usage:
     python tools/mfu_sweep.py                  # default sweep
