@@ -212,7 +212,7 @@ class ContinuousEngine:
     chunks are bucket-padded so executables stay hot).
 
     This class is also the shared worker skeleton: pump queue -> admit
-    from backlog -> engine _pre_step -> one prefill chunk -> one decode
+    from backlog -> one prefill chunk -> engine _pre_step -> one decode
     step, with device-error recovery failing all in-flight AND
     backlogged work. PagedContinuousEngine overrides only the policy
     hooks (admission/page growth/preemption/release); the control flow
@@ -345,8 +345,13 @@ class ContinuousEngine:
         pass
 
     def _pre_step(self) -> bool:
-        """Between admission and the decode step (paged: page growth).
-        False = a device error was handled; skip this iteration."""
+        """Between the prefill and decode ticks (paged: page growth).
+        Must run AFTER _prefill_tick: a slot whose prompt length is an
+        exact page multiple finishes prefill with its last page full,
+        and the decode step that follows writes position len — which
+        needs the next page allocated in this same iteration or the
+        first generated token's KV lands in the trash row.
+        False = a device error was handled; skip the decode tick."""
         return True
 
     def _release_slot(self, slot_idx: int) -> None:
@@ -371,9 +376,9 @@ class ContinuousEngine:
             self._admit_phase()
             if all(sl is None for sl in self._slots):
                 continue
+            self._prefill_tick()
             if not self._pre_step():
                 continue
-            self._prefill_tick()
             self._decode_tick()
 
     def _pump_queue(self):
@@ -851,13 +856,14 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
-            deadline = time.monotonic() + 120
+            # Idle timeout, not an absolute stream deadline: a long
+            # generation is legitimate as long as tokens keep arriving;
+            # only a 120 s gap BETWEEN events means the engine is stuck.
             while True:
                 try:
-                    ev = stream_q.get(
-                        timeout=max(deadline - time.monotonic(), 0.001))
+                    ev = stream_q.get(timeout=120)
                 except queue.Empty:
-                    ev = {"error": "stream timeout"}
+                    ev = {"error": "stream idle timeout"}
                 self.wfile.write(
                     b"data: " + json.dumps(ev).encode() + b"\n\n")
                 self.wfile.flush()

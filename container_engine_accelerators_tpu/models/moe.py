@@ -219,7 +219,14 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int,
     n_rows = n_loc * k                      # rows a rank originates
     factor = getattr(cfg, "moe_ep_buffer_factor", 2.0)
     c_pair = min(n_rows, max(k, int(-(-n_rows * factor // ep))))
-    ragged = getattr(cfg, "moe_ep_dispatch", "bucket") == "ragged"
+    dispatch = getattr(cfg, "moe_ep_dispatch", "bucket")
+    if dispatch not in ("bucket", "ragged"):
+        # A typo ('Ragged', 'raggd') must not silently select the
+        # droppable bucket path (advisor r4).
+        raise ValueError(
+            f"moe_ep_dispatch must be 'bucket' or 'ragged', "
+            f"got {dispatch!r}")
+    ragged = dispatch == "ragged"
     dt = h.dtype
     if jax.default_backend() == "cpu" and dt == jnp.bfloat16:
         # The XLA:CPU partitioner CHECK-crashes ("invalid binary

@@ -194,8 +194,10 @@ def assign_pods(pods: list[dict], nodes: list[dict],
 
     Uniform per-pod demand (the TPU norm — every worker asks for the same
     chip count) expands each node into free//demand slots, so several
-    small workers can share one host; mixed demands fall back to one pod
-    per node.
+    small workers can share one host; mixed demands go through a
+    first-fit-decreasing bin-packing over a sliding node window
+    (_assign_nonuniform), which can also co-locate several members on
+    one node when their combined vector fits.
 
     `anchors` are topologies of gang members already Running (survivors
     of a partial node failure): they join the window's distance score so
@@ -206,37 +208,49 @@ def assign_pods(pods: list[dict], nodes: list[dict],
     memory + anything requested), not chip counts: a node whose chips
     are free but whose cpu is spoken for must not receive a gang member
     (reference :245-332). `free` accepts either the vector form
-    (free_resources_by_node) or the legacy {node: tpu_count} ints."""
+    (free_resources_by_node) or the legacy {node: tpu_count} ints; the
+    legacy form carries no cpu/memory information, so demands are
+    projected to the TPU resource there — otherwise any pod that also
+    requests cpu would be unplaceable against capacities that record
+    cpu as zero (advisor r4)."""
+    legacy = any(not isinstance(v, dict) for v in free.values())
     free_vec = {name: (v if isinstance(v, dict)
                        else {TPU_RESOURCE_NAME: float(v)})
                 for name, v in free.items()}
     demands = [(pod["metadata"]["name"], _pod_requests(pod))
                for pod in sorted(pods, key=pod_sort_key)]
+    if legacy:
+        demands = [(name,
+                    {TPU_RESOURCE_NAME: d.get(TPU_RESOURCE_NAME, 0.0)})
+                   for name, d in demands]
     uniform = len({tuple(sorted(d.items())) for _, d in demands}) == 1
     demand0 = demands[0][1] if demands else {}
     tpu_dem = demand0.get(TPU_RESOURCE_NAME, 0)
 
-    # Slot capacity is the resource vector the slot can still serve; on
-    # the uniform path each slot IS one gang member's demand, and a node
-    # contributes as many slots as its scarcest requested resource
-    # allows.
-    slots: list[tuple[NodeTopology, dict]] = []
+    node_caps: list[tuple[NodeTopology, dict]] = []
     for node in nodes:
         name = node["metadata"]["name"]
         cap = free_vec.get(name)
         if not cap or cap.get(TPU_RESOURCE_NAME, 0) <= 0:
             continue
         labels = node.get("metadata", {}).get("labels", {}) or {}
-        topo = NodeTopology.from_labels(name, labels)
-        if uniform and tpu_dem > 0:
-            n_slots = min(int(cap.get(res, 0) // qty)
-                          for res, qty in demand0.items() if qty > 0)
-            slots.extend((topo, demand0) for _ in range(n_slots))
-        else:
-            slots.append((topo, cap))
+        node_caps.append((NodeTopology.from_labels(name, labels), cap))
+    node_caps.sort(key=lambda t: topology_sort_key(t[0]))
+
+    if not (uniform and tpu_dem > 0):
+        return _assign_nonuniform(demands, node_caps, anchors)
+
+    # Slot capacity is the resource vector the slot can still serve; on
+    # the uniform path each slot IS one gang member's demand, and a node
+    # contributes as many slots as its scarcest requested resource
+    # allows.
+    slots: list[tuple[NodeTopology, dict]] = []
+    for topo, cap in node_caps:
+        n_slots = min(int(cap.get(res, 0) // qty)
+                      for res, qty in demand0.items() if qty > 0)
+        slots.extend((topo, demand0) for _ in range(n_slots))
     if len(slots) < len(demands):
         return None
-    slots.sort(key=lambda t: topology_sort_key(t[0]))
 
     scored: list[tuple[float, int]] = []
     n, k = len(slots), len(demands)
@@ -257,8 +271,7 @@ def assign_pods(pods: list[dict], nodes: list[dict],
     # contiguous window) so 1-exchange has no descent direction.
     scored.sort()
     starts = [list(range(start, start + k)) for _, start in scored[:3]]
-    if uniform:
-        starts.extend(_greedy_starts(slots, k, anchors))
+    starts.extend(_greedy_starts(slots, k, anchors))
     best_sel, best_score = None, None
     for sel0 in starts:
         sel = _refine_selection(slots, demands, anchors, sel0)
@@ -268,6 +281,76 @@ def assign_pods(pods: list[dict], nodes: list[dict],
             best_sel, best_score = sel, refined
     return {pod_name: slots[i][0].name
             for (pod_name, _), i in zip(demands, best_sel)}
+
+
+def _assign_nonuniform(demands: list[tuple[str, dict]],
+                       node_caps: list[tuple[NodeTopology, dict]],
+                       anchors) -> dict[str, str] | None:
+    """Place a MIXED-demand gang by bin-packing members into nodes.
+
+    The uniform path's slot expansion doesn't apply (slots would need a
+    demand to size against), so instead: from every start position in
+    the topology-sorted node list, pack members first-fit-decreasing
+    (largest tpu, then cpu, then memory demand first) into the ROTATED
+    node order start..n-1,0..start-1, splitting each node's remaining
+    vector as members land on it — so two members CAN share one node
+    whenever their combined demand fits (verdict r4 weak #6). Rotation
+    (not truncation) matters: a packing can be feasible only when a
+    later member takes a node BEFORE the start position that the FFD
+    leader skipped. Each feasible packing is scored by pairwise
+    distance over the member topologies (a co-located pair contributes
+    0) plus anchors; best start wins — scoring, not node order, is
+    what keeps gangs topologically tight. Starts are deduped by the
+    start node's topology and capped (rotations beginning at
+    interchangeable nodes pack identically), so a large fleet costs
+    O(min(N, cap) * k * N) _fits scans per pass, not O(k * N^2) —
+    and the rare path: TPU gangs are uniform by construction."""
+    if not demands:
+        return {}
+    order = sorted(
+        range(len(demands)),
+        key=lambda i: (-demands[i][1].get(TPU_RESOURCE_NAME, 0),
+                       -demands[i][1].get("cpu", 0),
+                       -demands[i][1].get("memory", 0),
+                       demands[i][0]))
+    n = len(node_caps)
+    starts, seen_topo = [], set()
+    for start in range(n):
+        key = topology_sort_key(node_caps[start][0])
+        if key not in seen_topo:
+            seen_topo.add(key)
+            starts.append(start)
+    max_starts = 32
+    if len(starts) > max_starts:
+        stride = len(starts) / max_starts
+        starts = [starts[int(j * stride)] for j in range(max_starts)]
+    best_map, best_score = None, None
+    for start in starts:
+        rotated = list(range(start, n)) + list(range(start))
+        remaining: dict[int, dict] = {}
+        placed: dict[int, int] = {}  # demand index -> node position
+        for di in order:
+            for pos in rotated:
+                cap = remaining.get(pos)
+                if cap is None:
+                    cap = dict(node_caps[pos][1])
+                if _fits(cap, demands[di][1]):
+                    remaining[pos] = _sub_requests(cap, demands[di][1])
+                    placed[di] = pos
+                    break
+            else:
+                break
+        if len(placed) < len(demands):
+            continue
+        topos = [node_caps[pos][0] for pos in placed.values()]
+        score = pairwise_distance(topos + list(anchors))
+        if best_score is None or score < best_score:
+            best_map = {demands[di][0]: node_caps[pos][0].name
+                        for di, pos in placed.items()}
+            best_score = score
+            if best_score == 0.0:
+                break  # everything co-located; no rotation beats it
+    return best_map
 
 
 def _greedy_starts(slots, k, anchors, max_seeds: int = 8

@@ -480,6 +480,24 @@ def test_moe_router_config_validation():
         forward(params, jnp.zeros((2, 8), jnp.int32), cfg2)
 
 
+def test_dropless_ep_dispatch_flavor_validated():
+    """Advisor r4: a typo like 'Ragged' must raise, not silently select
+    the droppable bucket path."""
+    mesh = make_mesh(MeshAxes(fsdp=2, ep=2, tp=2), devices=jax.devices())
+    cfg = llama_tiny(n_experts=4, moe_dropless=True, dtype=jnp.float32,
+                     moe_ep_dispatch="Ragged")
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, 32), jnp.int32)
+
+    from container_engine_accelerators_tpu.parallel import sharding as shd
+    constrain = shd.make_constrain(mesh)
+    with pytest.raises(ValueError, match="moe_ep_dispatch"):
+        jax.eval_shape(
+            lambda p, t: forward(p, t, cfg, constrain=constrain,
+                                 mesh=mesh, return_aux=True),
+            params, tokens)
+
+
 def test_dropless_ep_ragged_dispatch_traces():
     """moe_ep_dispatch='ragged' (jax.lax.ragged_all_to_all): XLA:CPU
     cannot EXECUTE the ragged-all-to-all HLO as of jaxlib 0.9.0

@@ -96,6 +96,27 @@ def test_paged_chunked_prefill_matches_unchunked(model):
         eng.stop()
 
 
+@pytest.mark.parametrize("prefill_chunk", [0, 16])
+def test_paged_page_aligned_prompt_matches_direct(model, prefill_chunk):
+    """Regression (advisor r4, high): a prompt whose length is an exact
+    page multiple finishes prefill with its last page FULL, so the very
+    first decode step writes into a page that doesn't exist yet. Page
+    growth must run between the prefill and decode ticks or that first
+    token's KV is scattered to the trash row and the completion is
+    silently wrong."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=256,
+                                page=16, pool_pages=40,
+                                max_prompt_len=128,
+                                prefill_chunk=prefill_chunk)
+    try:
+        prompt = [(5 * i) % 100 + 1 for i in range(32)]  # exactly 2 pages
+        got = eng.submit(prompt, 6, 0.0).result(timeout=120)
+        assert got == direct(params, cfg, prompt, 6)
+    finally:
+        eng.stop()
+
+
 # ---------- streaming ----------
 
 def collect_stream(q_, timeout=120):

@@ -93,7 +93,7 @@ def test_serve_batcher_stress(monkeypatch):
     calls = {"n": 0, "lock": threading.Lock()}
 
     def fake_generate(params, tokens, cfg, max_new_tokens,
-                      temperature=0.0, key=None):
+                      temperature=0.0, key=None, mesh=None):
         # Uniform-bucket invariant: one batch = one shape + one config.
         arr = np.asarray(tokens)
         assert arr.ndim == 2
