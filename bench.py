@@ -61,26 +61,65 @@ def _emit_unavailable(detail: str) -> None:
     }))
 
 
-def require_backend(attempts: int = 3, timeout_s: float = 120.0) -> bool:
+def require_backend(budget_s: float | None = None,
+                    timeout_s: float = 120.0,
+                    interval_s: float | None = None) -> bool:
     """Prove the accelerator backend can initialise before touching it
     in-process. With this environment's TPU plugin registered, a downed
     tunnel makes ANY in-process jax.devices() call hang or raise inside
     backends() with no interruptible point — so the probe runs in a
     throwaway subprocess under a hard timeout (shared with the dryrun
-    entry: __graft_entry__.probe_default_backend), with a short bounded
-    retry to ride out transient tunnel flaps. Returns True when the
-    backend is up; emits the structured outage line and returns False
+    entry: __graft_entry__.probe_default_backend).
+
+    Patience is a BUDGET, not an attempt count (verdict r4 item 4: the
+    round-4 outage outlasted the old ~6-minute retry, zeroing the
+    round's scoreboard): keep polling every `interval_s` until
+    `budget_s` wall-clock has elapsed, so only an outage longer than
+    the whole budget — not a transient flap — produces the structured
+    `tpu_unavailable` line. Defaults: 30 min budget, 150 s between
+    probes (each probe itself may block up to `timeout_s`), both
+    overridable via BENCH_BACKEND_WAIT_S / BENCH_BACKEND_POLL_S so the
+    driver can match its own wall-clock allowance. Returns True when
+    the backend is up; emits the outage line and returns False
     otherwise."""
+    import os
+
     from __graft_entry__ import probe_default_backend
 
-    last = "no attempt ran"
-    for i in range(attempts):
-        if i:
-            time.sleep(15 * i)
+    def env_float(name, default):
+        # A malformed knob must degrade to the default, not crash before
+        # the structured outage line can be emitted.
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            print(f"ignoring unparseable {name}={os.environ[name]!r}; "
+                  f"using {default}", file=sys.stderr)
+            return float(default)
+
+    if budget_s is None:
+        budget_s = env_float("BENCH_BACKEND_WAIT_S", 1800)
+    if interval_s is None:
+        interval_s = env_float("BENCH_BACKEND_POLL_S", 150)
+    deadline = time.monotonic() + budget_s
+    attempt, last = 0, "no attempt ran"
+    while True:
+        attempt += 1
         n_dev, last = probe_default_backend(timeout_s=timeout_s)
         if n_dev > 0:
+            if attempt > 1:
+                print(f"backend recovered on probe {attempt}",
+                      file=sys.stderr)
             return True
-    _emit_unavailable(last)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        print(f"backend probe {attempt} failed ({last.strip()[-120:]}); "
+              f"{remaining:.0f}s of patience left", file=sys.stderr)
+        time.sleep(min(interval_s, remaining))
+    # Truncate the raw probe error FIRST: _emit_unavailable keeps only
+    # the detail tail, which must not cut off the patience accounting.
+    _emit_unavailable(f"after {attempt} probes over {budget_s:.0f}s "
+                      f"budget: {last[-300:]}")
     return False
 
 

@@ -300,9 +300,11 @@ def _assign_nonuniform(demands: list[tuple[str, dict]],
     leader skipped. Each feasible packing is scored by pairwise
     distance over the member topologies (a co-located pair contributes
     0) plus anchors; best start wins — scoring, not node order, is
-    what keeps gangs topologically tight. Starts are deduped by the
-    start node's topology and capped (rotations beginning at
-    interchangeable nodes pack identically), so a large fleet costs
+    what keeps gangs topologically tight. Starts are deduped by
+    (topology position, capacity vector) — topology_sort_key minus the
+    name tiebreaker, plus the node's remaining resources, since two
+    co-located nodes pack identically ONLY when their free vectors
+    match too — and capped, so a large fleet costs
     O(min(N, cap) * k * N) _fits scans per pass, not O(k * N^2) —
     and the rare path: TPU gangs are uniform by construction."""
     if not demands:
@@ -316,7 +318,11 @@ def _assign_nonuniform(demands: list[tuple[str, dict]],
     n = len(node_caps)
     starts, seen_topo = [], set()
     for start in range(n):
-        key = topology_sort_key(node_caps[start][0])
+        topo, cap = node_caps[start]
+        # Drop the trailing name tiebreaker: it makes every key unique,
+        # which would turn this dedup into a no-op.
+        key = (topology_sort_key(topo)[:-1],
+               tuple(sorted(cap.items())))
         if key not in seen_topo:
             seen_topo.add(key)
             starts.append(start)
