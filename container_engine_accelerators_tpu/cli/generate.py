@@ -66,7 +66,7 @@ def main(argv=None) -> int:
     if args.tp > 1:
         from container_engine_accelerators_tpu.models import decode_tp
         mesh = decode_tp.make_inference_mesh(tp=args.tp)
-        params = decode_tp.shard_decode_params(params, mesh)
+        params = decode_tp.shard_decode_params(params, mesh, cfg)
 
     key = jax.random.key(args.seed) if args.temperature > 0 else None
     t0 = time.perf_counter()

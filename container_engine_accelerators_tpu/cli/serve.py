@@ -123,8 +123,8 @@ class BatchingEngine:
 
         if self.mesh is not None:
             from container_engine_accelerators_tpu.models import decode_tp
-            self.params = decode_tp.shard_decode_params(self.params,
-                                                        self.mesh)
+            self.params = decode_tp.shard_decode_params(
+                self.params, self.mesh, self.cfg)
 
         pending: list = []
         while not self._stop.is_set():
@@ -292,8 +292,8 @@ class ContinuousEngine:
 
         if self.mesh is not None:
             from container_engine_accelerators_tpu.models import decode_tp
-            self.params = decode_tp.shard_decode_params(self.params,
-                                                        self.mesh)
+            self.params = decode_tp.shard_decode_params(
+                self.params, self.mesh, self.cfg)
             self._step_fn = decode_tp.jitted_decode_step_slots(
                 self.cfg, self.mesh)
             self._chunk_fn = decode_tp.jitted_prefill_suffix_slot(
@@ -630,8 +630,8 @@ class PagedContinuousEngine(ContinuousEngine):
 
         if self.mesh is not None:
             from container_engine_accelerators_tpu.models import decode_tp
-            self.params = decode_tp.shard_decode_params(self.params,
-                                                        self.mesh)
+            self.params = decode_tp.shard_decode_params(
+                self.params, self.mesh, self.cfg)
             self._step_fn = decode_tp.jitted_decode_step_paged(
                 self.cfg, self.mesh)
             self._chunk_fn = decode_tp.jitted_prefill_suffix_paged(
@@ -906,7 +906,10 @@ def main(argv=None) -> int:
     p.add_argument("--batch-window-ms", type=float, default=5.0)
     p.add_argument("--engine", choices=("window", "continuous", "paged"),
                    default="window",
-                   help="window = shape-bucket batch-window engine; "
+                   help="window = shape-bucket batch-window engine "
+                        "(NOTE: emits SSE stream tokens only at batch "
+                        "completion — for real time-to-first-token "
+                        "streaming use continuous or paged); "
                         "continuous = in-flight batching over a fixed "
                         "slot pool (admits new requests into the "
                         "running decode batch); paged = continuous "
@@ -947,6 +950,9 @@ def main(argv=None) -> int:
     if args.quantize_int8:
         if args.tp > 1:
             p.error("--quantize-int8 is not supported with --tp > 1")
+        if cfg.n_experts:
+            p.error("--quantize-int8 is not supported for MoE models "
+                    "(expert weights have no int8 decode path yet)")
         from container_engine_accelerators_tpu.ops.quant import (
             quantize_llama_params,
         )

@@ -92,9 +92,21 @@ def load_hf_checkpoint(path: str):
 
 
 def load_model(checkpoint: str | None = None, seed: int = 0):
-    """Shared CLI loading policy (serve/generate): an HF checkpoint dir
-    when given, else a randomly-initialised tiny model -> (params, cfg)."""
+    """Shared CLI loading policy (serve/generate): a checkpoint dir when
+    given — a TRAINING (orbax) checkpoint from training/checkpoint.py
+    (detected by its numeric step dirs; the only route for MoE models,
+    which have no HF format) or an HF export — else a randomly-
+    initialised tiny model -> (params, cfg)."""
     if checkpoint:
+        import os
+        if any(name.isdigit() and os.path.isdir(
+                os.path.join(checkpoint, name, "state"))
+               for name in (os.listdir(checkpoint)
+                            if os.path.isdir(checkpoint) else [])):
+            from container_engine_accelerators_tpu.training.checkpoint import (
+                load_serving_params,
+            )
+            return load_serving_params(checkpoint)
         return load_hf_checkpoint(checkpoint)
     import jax
 

@@ -146,6 +146,74 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
+def _moe_ffn_decode(h2: jnp.ndarray, lp: dict, cfg: LlamaConfig,
+                    tp_axis: str | None) -> jnp.ndarray:
+    """Mixture-of-Experts FFN at decode shapes: h2 [B, T, d] normalized
+    activations -> [B, T, d] (the residual add happens in the caller).
+
+    Routing is PER-TOKEN top-k with the training router's exact gating
+    (moe._gating: softmax -> top_k -> renormalize) and NO capacity
+    dropping — i.e. the dropless token-choice semantics. This is the
+    only routing an incremental decoder can implement consistently:
+    capacity cumsums depend on the whole token population of a call, so
+    chunked prefill / continuous batching would change WHICH tokens
+    drop, making a request's output depend on engine scheduling. It
+    matches `forward` exactly for moe_dropless=True configs and for
+    capacity configs whenever nothing dropped (expert_choice models
+    decode through the same per-token gating — the non-causal
+    train/decode skew moe.py warns about lands here).
+
+    Compute is the dense all-experts einsum, not ragged grouped matmul:
+    at decode shapes (B*T of order slots, not tokens-per-batch) the
+    whole FFN stack is a few MXU tiles, and static [B,T,E,*] einsums
+    beat a sort + ragged_dot whose setup cost exceeds the FLOPs saved.
+
+    Tensor parallelism (`tp_axis` set, inside shard_map): two layouts,
+    selected by the weight shapes the specs delivered (decode_tp.
+    decode_param_specs):
+      - experts REPLICATED (w_gate [E, d, f]): every rank computes the
+        full MoE; output already replicated, no collective;
+      - experts SHARDED over tp (w_gate [E/tp, d, f],
+        cfg.moe_decode_ep): each rank computes its local experts'
+        weighted contributions and one psum sums the partials — expert
+        HBM scales 1/tp like the dense weights.
+    """
+    from container_engine_accelerators_tpu.models.moe import _gating
+
+    if isinstance(lp["w_gate"], QuantWeight):
+        # Fail at trace time with a clear message, not an AttributeError
+        # deep in an engine worker thread (cli/serve.py also rejects the
+        # combination up front).
+        raise NotImplementedError(
+            "int8-quantized expert weights are not supported on the MoE "
+            "decode path")
+    b, t, d = h2.shape
+    e, k = cfg.n_experts, cfg.moe_top_k
+    dt = h2.dtype
+    logits = jnp.einsum("btd,de->bte", h2.astype(jnp.float32),
+                        lp["w_router"].astype(jnp.float32))
+    _, gate_vals, expert_idx = _gating(logits, k)
+    # Combine weights [B, T, E]: gate weight where chosen, else 0.
+    cw = jnp.sum(jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+                 * gate_vals[..., None], axis=2)
+
+    e_loc = lp["w_gate"].shape[0]
+    if e_loc != e:
+        # Expert-sharded: keep only this rank's experts' combine weights.
+        shard = jax.lax.axis_index(tp_axis)
+        cw = jax.lax.dynamic_slice_in_dim(cw, shard * e_loc, e_loc,
+                                          axis=2)
+    gate = jax.nn.silu(jnp.einsum("btd,edf->betf", h2,
+                                  lp["w_gate"].astype(dt)))
+    up = jnp.einsum("btd,edf->betf", h2, lp["w_up"].astype(dt))
+    down = jnp.einsum("betf,efd->betd", gate * up,
+                      lp["w_down"].astype(dt))
+    out = jnp.einsum("bte,betd->btd", cw.astype(dt), down)
+    if e_loc != e:
+        out = jax.lax.psum(out, tp_axis)
+    return out
+
+
 def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
                 cfg: LlamaConfig, active: jnp.ndarray | None = None,
                 tp_axis: str | None = None
@@ -268,9 +336,12 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         attn = attend(q.astype(dt), k_cache, v_cache)
         x = x + proj(attn.reshape(b, t, -1), lp["wo"], reduce=True)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(proj(h2, lp["w_gate"]))
-        up = proj(h2, lp["w_up"])
-        x = x + proj(gate * up, lp["w_down"], reduce=True)
+        if cfg.n_experts:
+            x = x + _moe_ffn_decode(h2, lp, cfg, tp_axis)
+        else:
+            gate = jax.nn.silu(proj(h2, lp["w_gate"]))
+            up = proj(h2, lp["w_up"])
+            x = x + proj(gate * up, lp["w_down"], reduce=True)
         return x, (k_cache, v_cache)
 
     # Scan over layers with stacked params + stacked caches as xs — one

@@ -60,32 +60,52 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
             f"tp={tp} must divide {bad} (cfg: n_heads={cfg.n_heads}, "
             f"n_kv_heads={cfg.n_kv_heads}, d_ff={cfg.d_ff}, "
             f"vocab_size={cfg.vocab_size})")
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "MoE decode is not implemented (decode.py's layer body is "
-            "dense-only); tp decode inherits that limit")
+    if cfg.n_experts and cfg.moe_decode_ep and cfg.n_experts % tp:
+        raise ValueError(
+            f"moe_decode_ep shards experts over tp: tp={tp} must "
+            f"divide n_experts={cfg.n_experts} (or replicate experts "
+            f"with moe_decode_ep=False)")
 
 
-def decode_param_specs() -> dict:
-    """PartitionSpec tree matching models.llama.init_params (dense).
+def decode_param_specs(cfg: LlamaConfig | None = None,
+                       moe: bool = False) -> dict:
+    """PartitionSpec tree matching models.llama.init_params.
 
     Unlike training's llama_param_specs, nothing shards over fsdp:
     inference has no optimizer state to ZeRO-shard and decode re-reads
     every weight each step, so weights live fully materialised in their
     compute layout. embed stays replicated — a [B] gather per step is
-    too small to shard profitably."""
+    too small to shard profitably.
+
+    MoE layers (cfg.n_experts, or `moe=True` when no cfg is at hand)
+    swap the dense FFN weights for expert-stacked [L, E, d, f] ones:
+      - cfg.moe_decode_ep=False (default): experts REPLICATED on every
+        tp rank — the FFN output needs no collective;
+      - cfg.moe_decode_ep=True: experts sharded over tp on the expert
+        axis (decode.py._moe_ffn_decode psums the partial combines) —
+        expert HBM scales 1/tp.
+    The router stays replicated either way (it is [d, E] — tiny — and
+    every rank needs every expert's gate weight for the combine)."""
     col = P(None, None, TP_AXIS)   # stacked [L, d_model, heads*dh | ff]
     row = P(None, TP_AXIS, None)   # stacked [L, heads*dh | ff, d_model]
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": col, "wk": col, "wv": col,
+        "wo": row,
+        "mlp_norm": P(None, None),
+    }
+    has_moe = bool(cfg.n_experts) if cfg is not None else moe
+    if has_moe:
+        exp = (P(None, TP_AXIS, None, None)
+               if cfg is not None and cfg.moe_decode_ep
+               else P(None, None, None, None))
+        layers.update({"w_router": P(None, None, None),
+                       "w_gate": exp, "w_up": exp, "w_down": exp})
+    else:
+        layers.update({"w_gate": col, "w_up": col, "w_down": row})
     return {
         "embed": P(None, None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": col, "wk": col, "wv": col,
-            "wo": row,
-            "mlp_norm": P(None, None),
-            "w_gate": col, "w_up": col,
-            "w_down": row,
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, TP_AXIS),
     }
@@ -104,10 +124,14 @@ def cache_specs(paged: bool, scalar_len: bool = False):
                    length=P() if scalar_len else P(None))
 
 
-def shard_decode_params(params: dict, mesh: Mesh) -> dict:
-    """Place params on the mesh in the decode TP layout."""
+def shard_decode_params(params: dict, mesh: Mesh,
+                        cfg: LlamaConfig | None = None) -> dict:
+    """Place params on the mesh in the decode TP layout. Pass `cfg` for
+    MoE models so moe_decode_ep selects the expert placement; without
+    one, MoE params (detected by their router) get replicated experts."""
+    specs = decode_param_specs(cfg, moe="w_router" in params["layers"])
     shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), decode_param_specs(),
+        lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
     return jax.device_put(params, shardings)
 
@@ -151,7 +175,7 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
     """Classic scalar-length batched decode/prefill step over the mesh
     (generate()'s step): (params, cache, tokens[B,T]) -> (logits, cache)."""
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=False, scalar_len=True)
     fn = _smap(
         functools.partial(decode_step, cfg=cfg, tp_axis=TP_AXIS),
@@ -164,7 +188,7 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=False)
     fn = _smap(
         functools.partial(decode_step_slots, cfg=cfg, tp_axis=TP_AXIS),
@@ -177,7 +201,7 @@ def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=False)
     fn = _smap(
         functools.partial(prefill_slot, cfg=cfg, tp_axis=TP_AXIS),
@@ -190,7 +214,7 @@ def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=False)
     fn = _smap(
         functools.partial(prefill_suffix_slot, cfg=cfg, tp_axis=TP_AXIS),
@@ -203,7 +227,7 @@ def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=True)
     fn = _smap(
         functools.partial(decode_step_paged, cfg=cfg, tp_axis=TP_AXIS),
@@ -216,7 +240,7 @@ def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=True)
     fn = _smap(
         functools.partial(prefill_slot_paged, cfg=cfg, tp_axis=TP_AXIS),
@@ -229,7 +253,7 @@ def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs()
+    pspecs = decode_param_specs(cfg)
     cspecs = cache_specs(paged=True)
     fn = _smap(
         functools.partial(prefill_suffix_paged, cfg=cfg, tp_axis=TP_AXIS),

@@ -103,6 +103,14 @@ class LlamaConfig:
     moe_ep_dispatch: str = "bucket"
     moe_aux_weight: float = 0.01
     moe_z_weight: float = 0.001
+    # DECODE-path expert placement under tensor parallelism
+    # (models/decode_tp.py): False = experts replicated on every tp rank
+    # (full expert weights per chip — simplest, right when the dense
+    # trunk dominates HBM); True = experts sharded over the tp axis
+    # (n_experts/tp experts per rank + one psum combine — expert HBM
+    # scales 1/tp like the dense weights). Training placement is
+    # unaffected (its experts shard over the separate 'ep' mesh axis).
+    moe_decode_ep: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -165,6 +173,27 @@ def llama_tiny(**overrides) -> LlamaConfig:
               n_kv_heads=2, d_ff=256, max_seq_len=256, remat_policy="none")
     kw.update(overrides)
     return LlamaConfig(**kw)
+
+
+def cfg_to_json_dict(cfg: LlamaConfig) -> dict:
+    """LlamaConfig -> JSON-serializable dict (dtypes become their numpy
+    names). Recorded inside training checkpoints so serving can rebuild
+    the exact model class without a side-channel config file."""
+    d = dataclasses.asdict(cfg)
+    for key in ("dtype", "param_dtype"):
+        d[key] = jnp.dtype(d[key]).name
+    return d
+
+
+def cfg_from_json_dict(d: dict) -> LlamaConfig:
+    """Inverse of cfg_to_json_dict. Unknown keys are dropped so configs
+    saved by NEWER builds (with extra fields) still load."""
+    d = dict(d)
+    for key in ("dtype", "param_dtype"):
+        if isinstance(d.get(key), str):
+            d[key] = jnp.dtype(d[key]).type
+    known = {f.name for f in dataclasses.fields(LlamaConfig)}
+    return LlamaConfig(**{k: v for k, v in d.items() if k in known})
 
 
 def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:

@@ -77,17 +77,23 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState, force: bool = False,
-             layout: dict | None = None) -> bool:
+             layout: dict | None = None, cfg=None) -> bool:
         """`layout` is the layer-storage tag the state was built under
         (training/train.py state_layer_layout); omitted means depth
-        order."""
-        saved = self._mngr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state._asdict()),
-                layout=ocp.args.JsonSave(layout or _DEPTH_ORDER),
-            ),
-            force=force)
+        order. `cfg` (a LlamaConfig) is recorded as JSON so the
+        checkpoint is self-describing — load_serving_params can rebuild
+        the model without a side-channel config."""
+        items = {
+            "state": ocp.args.StandardSave(state._asdict()),
+            "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
+        }
+        if cfg is not None:
+            from container_engine_accelerators_tpu.models.llama import (
+                cfg_to_json_dict,
+            )
+            items["cfg"] = ocp.args.JsonSave(cfg_to_json_dict(cfg))
+        saved = self._mngr.save(step, args=ocp.args.Composite(**items),
+                                force=force)
         return bool(saved)
 
     def wait(self):
@@ -143,3 +149,80 @@ class CheckpointManager:
 
     def close(self):
         self._mngr.close()
+
+
+def load_serving_params(directory: str, step: int | None = None):
+    """Load (params, cfg) from a TRAINING checkpoint for INFERENCE —
+    the bridge that makes "the models the stack trains are the models
+    it serves" real for checkpoints that never leave this framework
+    (MoE configs have no HF export format; reference workload symmetry:
+    demo/tpu-training/ pairs with demo/serving/).
+
+    Restores ONLY the params subtree — the optimizer moments (2x the
+    params' bytes for adam) are marked ocp.PLACEHOLDER and never read,
+    so a serving host sized for inference doesn't pay a 3x load-time
+    memory spike. Structure-agnostic: any optimizer state shape works,
+    because the skip-tree is built from the checkpoint's own metadata,
+    not from a reconstructed TrainState. Params deserialize as host
+    numpy (ignoring the saved training mesh's shardings — serving
+    re-places them on its own tp mesh). De-interleaves layer storage to
+    depth order if the checkpoint was written under the circular
+    pipeline's interleaved layout. Requires the checkpoint to carry a
+    cfg record (CheckpointManager.save(..., cfg=cfg)); older
+    checkpoints without one must be served via an explicit config."""
+    import numpy as np
+
+    directory = os.path.abspath(directory)
+    mngr = ocp.CheckpointManager(directory)
+    try:
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps in {directory}")
+        step_dir = os.path.join(directory, str(step))
+        if not os.path.isdir(os.path.join(step_dir, "cfg")):
+            raise ValueError(
+                f"checkpoint step {step} in {directory} has no cfg "
+                "record; re-save with CheckpointManager.save(..., "
+                "cfg=cfg) or serve from an HF export")
+        meta = mngr.restore(
+            step, args=ocp.args.Composite(
+                layout=ocp.args.JsonRestore(),
+                cfg=ocp.args.JsonRestore(),
+            ))
+    finally:
+        mngr.close()
+
+    ckptr = ocp.PyTreeCheckpointer()
+    state_dir = os.path.join(step_dir, "state")
+    try:
+        tree_meta = ckptr.metadata(state_dir).item_metadata.tree
+        is_meta = lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+        item, restore_args = {}, {}
+        for key, sub in tree_meta.items():
+            if key == "params":
+                item[key] = jax.tree.map(lambda m: 0, sub,
+                                         is_leaf=is_meta)
+                restore_args[key] = jax.tree.map(
+                    lambda m: ocp.RestoreArgs(restore_type=np.ndarray),
+                    sub, is_leaf=is_meta)
+            else:
+                item[key] = jax.tree.map(lambda m: ocp.PLACEHOLDER, sub,
+                                         is_leaf=is_meta)
+                restore_args[key] = jax.tree.map(
+                    lambda m: ocp.RestoreArgs(), sub, is_leaf=is_meta)
+        restored = ckptr.restore(state_dir, ocp.args.PyTreeRestore(
+            item=item, restore_args=restore_args))
+    finally:
+        ckptr.close()
+
+    from container_engine_accelerators_tpu.models.llama import (
+        cfg_from_json_dict,
+    )
+    cfg = cfg_from_json_dict(dict(meta["cfg"]))
+    params = dict(restored["params"])
+    saved_layout = dict(meta["layout"])
+    if normalize_layout(saved_layout) != normalize_layout(_DEPTH_ORDER):
+        params["layers"] = relayout_layers(params["layers"],
+                                           saved_layout, None)
+    params = jax.tree.map(jnp.asarray, params)
+    return params, cfg

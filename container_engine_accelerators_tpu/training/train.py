@@ -248,7 +248,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         state, metrics = step_fn(state, batch)
         cur = int(jax.device_get(state.step))
         if mngr is not None:
-            mngr.save(cur, state, layout=layout)
+            mngr.save(cur, state, layout=layout, cfg=cfg)
         if log_every and i % log_every == 0:
             m = jax.device_get(metrics)
             log_fn(f"step {cur} loss {float(m['loss']):.4f}")
@@ -256,7 +256,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     if mngr is not None:
         final = int(jax.device_get(state.step))
         if mngr.latest_step() != final:
-            mngr.save(final, state, force=True, layout=layout)
+            mngr.save(final, state, force=True, layout=layout, cfg=cfg)
         mngr.wait()
         mngr.close()
     return state, metrics
