@@ -30,6 +30,48 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
+# Causal grid shape: 'rect' walks the full (num_q x num_k) rectangle and
+# predicates away blocks above the diagonal — but pallas still DMAs
+# every skipped block's K/V into VMEM (the pipeline issues block copies
+# per grid step regardless of pl.when), so causal attention fetches ~2x
+# the K/V bytes it needs. 'tri' enumerates ONLY the lower-triangle
+# block pairs in a flattened third grid dim (integer-exact index
+# arithmetic in the BlockSpec maps), halving K/V traffic and grid steps
+# at long S. Requires block_q == block_k (silently falls back to rect
+# otherwise). Default stays 'rect' until tools/flash_sweep.py measures
+# 'tri' on real hardware — mosaic must lower the sqrt-based index maps.
+DEFAULT_CAUSAL_GRID = "rect"
+
+
+def _tri_qk(t, n):
+    """Invert t = qi*(qi+1)/2 + ki over the lower triangle (0<=ki<=qi<n)
+    — the flattened enumeration that scans ki innermost per q row, the
+    same traversal order the rect grid uses minus the skipped cells.
+    Float sqrt seeds the root; the two integer fix-ups make it exact for
+    any block count that fits f32's integer range (n < ~4000)."""
+    tf = t.astype(jnp.float32)
+    qi = ((jnp.sqrt(8.0 * tf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    qi = jnp.where((qi + 1) * (qi + 2) // 2 <= t, qi + 1, qi)
+    qi = jnp.where(qi * (qi + 1) // 2 > t, qi - 1, qi)
+    ki = t - qi * (qi + 1) // 2
+    return qi, ki
+
+
+def _tri_kq(t, n):
+    """Invert t = ki*n - ki*(ki-1)/2 + (qi - ki) over qi>=ki (the dk/dv
+    kernel's traversal: qi innermost per k row)."""
+    tf = t.astype(jnp.float32)
+    a = 2.0 * n + 1.0
+    ki = ((a - jnp.sqrt(a * a - 8.0 * tf)) * 0.5).astype(jnp.int32)
+
+    def off(k):
+        return k * n - k * (k - 1) // 2
+
+    ki = jnp.where(off(ki + 1) <= t, ki + 1, ki)
+    ki = jnp.where(off(ki) > t, jnp.maximum(ki - 1, 0), ki)
+    qi = t - off(ki) + ki
+    return ki, qi
+
 
 def supported(q, k, v) -> bool:
     """Shape gate for the kernel: lane-dim and sublane-dim tiling limits."""
@@ -50,11 +92,19 @@ def _pick_block(requested: int, s: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
                 acc, m_scr, l_scr, *, block_q: int,
-                block_k: int, causal: bool, segmented: bool):
+                block_k: int, causal: bool, segmented: bool,
+                tri: bool = False, n_blocks: int = 0):
     # q arrives pre-scaled by 1/sqrt(d) (one cheap [S, d] pass in the
     # wrapper instead of a [bq, bk] VPU pass per block here).
-    ki = pl.program_id(3)
-    num_k = pl.num_programs(3)
+    if tri:
+        # Flattened lower-triangle grid: only scheduled (qi, ki) pairs
+        # exist, so nothing is predicated away — init on the row's first
+        # block, finalize on its diagonal block.
+        qi, ki = _tri_qk(pl.program_id(2), n_blocks)
+        last_k = qi
+    else:
+        qi, ki = pl.program_id(2), pl.program_id(3)
+        last_k = pl.num_programs(3) - 1
 
     @pl.when(ki == 0)
     def _init():
@@ -62,7 +112,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    qi = pl.program_id(2)
     q_start = qi * block_q
     k_start = ki * block_k
 
@@ -74,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
         # masking passes entirely — for long S most running blocks are
         # interior, and the [bq, bk] elementwise passes are what bound
         # this kernel (the MXU work is ~3 passes' worth at d=128).
-        run = q_start + block_q - 1 >= k_start
+        run = True if tri else q_start + block_q - 1 >= k_start
         needs_causal_mask = k_start + block_k - 1 > q_start
 
     def _body(mask_causal: bool):
@@ -116,7 +165,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
     else:
         _body(False)  # non-causal: only the segment mask (inside _body)
 
-    @pl.when(ki == num_k - 1)
+    @pl.when(ki == last_k)
     def _finalize():
         l = l_scr[:, :1]
         # Rows with no attended keys (can't happen causally) would have l=0.
@@ -125,41 +174,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
         lse_ref[0, 0, :, 0] = (m_scr[:, 0] + jnp.log(l[:, 0]))
 
 
+def _use_tri(causal, causal_grid, block_q, block_k) -> bool:
+    return causal and causal_grid == "tri" and block_q == block_k
+
+
 def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
-         segmented):
+         segmented, causal_grid=DEFAULT_CAUSAL_GRID):
     b, h, s, d = q.shape
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     block_q = _pick_block(block_q, s)
     block_k = _pick_block(block_k, s)
-    grid = (b, h, s // block_q, s // block_k)
+    tri = _use_tri(causal, causal_grid, block_q, block_k)
+    n_blocks = s // block_q
 
-    def qmap(bi, hi, qi, ki):
-        return (bi, hi, qi, 0)
+    if tri:
+        grid = (b, h, n_blocks * (n_blocks + 1) // 2)
 
-    def kmap(bi, hi, qi, ki):
-        return (bi, hi, ki, 0)
+        def qmap(bi, hi, t):
+            return (bi, hi, _tri_qk(t, n_blocks)[0], 0)
+
+        def kmap(bi, hi, t):
+            return (bi, hi, _tri_qk(t, n_blocks)[1], 0)
+
+        seg_q = lambda bi, hi, t: (bi, _tri_qk(t, n_blocks)[0], 0)
+        seg_k = lambda bi, hi, t: (bi, _tri_qk(t, n_blocks)[1], 0)
+        lse_map = lambda bi, hi, t: (bi, hi, _tri_qk(t, n_blocks)[0], 0)
+    else:
+        grid = (b, h, s // block_q, s // block_k)
+
+        def qmap(bi, hi, qi, ki):
+            return (bi, hi, qi, 0)
+
+        def kmap(bi, hi, qi, ki):
+            return (bi, hi, ki, 0)
+
+        seg_q = lambda bi, hi, qi, ki: (bi, qi, 0)
+        seg_k = lambda bi, hi, qi, ki: (bi, ki, 0)
+        lse_map = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
-                          segmented=segmented),
+                          segmented=segmented, tri=tri,
+                          n_blocks=n_blocks),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda bi, hi, qi, ki: (bi, qi, 0)),
-            pl.BlockSpec((1, block_k, 1),
-                         lambda bi, hi, qi, ki: (bi, ki, 0)),
+            pl.BlockSpec((1, block_q, 1), seg_q),
+            pl.BlockSpec((1, block_k, 1), seg_k),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
             # Stats carry a trailing singleton lane dim: TPU lowering needs
             # the last two block dims divisible by (8, 128) or equal to the
             # array dims.
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lse_map),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
@@ -177,22 +248,27 @@ def _fwd(q, k, v, seg, *, scale, causal, block_q, block_k, interpret,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_acc, *,
-                   block_q, block_k, causal, segmented):
+                   block_q, block_k, causal, segmented,
+                   tri: bool = False, n_blocks: int = 0):
     # q arrives pre-scaled; the kernel's dq is w.r.t. scaled q, and the
     # wrapper multiplies by scale once at the end ([S, d] pass).
-    ki = pl.program_id(3)
-    num_k = pl.num_programs(3)
+    if tri:
+        qi, ki = _tri_qk(pl.program_id(2), n_blocks)
+        last_k = qi
+    else:
+        qi, ki = pl.program_id(2), pl.program_id(3)
+        last_k = pl.num_programs(3) - 1
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q_start = pl.program_id(2) * block_q
+    q_start = qi * block_q
     k_start = ki * block_k
     run = True
     needs_causal_mask = False
     if causal:
-        run = q_start + block_q - 1 >= k_start
+        run = True if tri else q_start + block_q - 1 >= k_start
         needs_causal_mask = k_start + block_k - 1 > q_start
 
     def _body(mask_causal: bool):
@@ -231,30 +307,37 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
     else:
         _body(False)
 
-    @pl.when(ki == num_k - 1)
+    @pl.when(ki == last_k)
     def _finalize():
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    block_q, block_k, causal, segmented):
+                    block_q, block_k, causal, segmented,
+                    tri: bool = False, n_blocks: int = 0):
     # q arrives pre-scaled, which makes dk = ds^T @ q_scaled directly
     # correct (s = q_scaled . k, so ds/dk carries the scale via q).
-    qi = pl.program_id(3)
-    num_q = pl.num_programs(3)
+    if tri:
+        # (ki, qi) with qi scanning ki..n-1: init on the diagonal block,
+        # finalize on the row's last q block.
+        ki, qi = _tri_kq(pl.program_id(2), n_blocks)
+        first_q, last_q = ki, n_blocks - 1
+    else:
+        ki, qi = pl.program_id(2), pl.program_id(3)
+        first_q, last_q = 0, pl.num_programs(3) - 1
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first_q)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     q_start = qi * block_q
-    k_start = pl.program_id(2) * block_k
+    k_start = ki * block_k
     run = True
     needs_causal_mask = False
     if causal:
-        run = q_start + block_q - 1 >= k_start
+        run = True if tri else q_start + block_q - 1 >= k_start
         needs_causal_mask = k_start + block_k - 1 > q_start
 
     def _body(mask_causal: bool):
@@ -298,14 +381,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, do_ref,
     else:
         _body(False)
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(qi == last_q)
     def _finalize():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, seg, causal, block_q, block_k, interpret, segmented):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seg, causal, block_q, block_k, interpret, segmented,
+           causal_grid):
     # NOTE (round-3 finding): under `jax.checkpoint` the backward pass
     # replays this forward kernel to rebuild the (out, lse) residuals —
     # and no remat policy can prevent it: policies select values from
@@ -318,20 +402,22 @@ def _flash(q, k, v, seg, causal, block_q, block_k, interpret, segmented):
     # cost — a bad trade at current HBM headroom.
     scale = q.shape[-1] ** -0.5
     out, _ = _fwd(q, k, v, seg, scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=interpret, segmented=segmented)
+                  block_k=block_k, interpret=interpret, segmented=segmented,
+                  causal_grid=causal_grid)
     return out
 
 
 def _flash_fwd_rule(q, k, v, seg, causal, block_q, block_k, interpret,
-                    segmented):
+                    segmented, causal_grid):
     scale = q.shape[-1] ** -0.5
     out, lse = _fwd(q, k, v, seg, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k, interpret=interpret,
-                    segmented=segmented)
+                    segmented=segmented, causal_grid=causal_grid)
     return out, (q, k, v, seg, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented,
+                    causal_grid, res, do):
     q, k, v, seg, out, lse = res
     b, h, s, d = q.shape
     scale = d ** -0.5
@@ -340,31 +426,48 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     block_q = _pick_block(block_q, s)
     block_k = _pick_block(block_k, s)
+    tri = _use_tri(causal, causal_grid, block_q, block_k)
+    n_blocks = s // block_q
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B,H,S,1]
 
-    def qmap(bi, hi, qi, ki):
-        return (bi, hi, qi, 0)
+    if tri:
+        dq_grid = (b, h, n_blocks * (n_blocks + 1) // 2)
 
-    def kmap(bi, hi, qi, ki):
-        return (bi, hi, ki, 0)
+        def qmap(bi, hi, t):
+            return (bi, hi, _tri_qk(t, n_blocks)[0], 0)
 
-    def qvecmap(bi, hi, qi, ki):
-        return (bi, hi, qi, 0)
+        def kmap(bi, hi, t):
+            return (bi, hi, _tri_qk(t, n_blocks)[1], 0)
+
+        seg_q = lambda bi, hi, t: (bi, _tri_qk(t, n_blocks)[0], 0)
+        seg_k = lambda bi, hi, t: (bi, _tri_qk(t, n_blocks)[1], 0)
+        qvecmap = qmap
+    else:
+        dq_grid = (b, h, s // block_q, s // block_k)
+
+        def qmap(bi, hi, qi, ki):
+            return (bi, hi, qi, 0)
+
+        def kmap(bi, hi, qi, ki):
+            return (bi, hi, ki, 0)
+
+        seg_q = lambda bi, hi, qi, ki: (bi, qi, 0)
+        seg_k = lambda bi, hi, qi, ki: (bi, ki, 0)
+        qvecmap = qmap
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
-                          segmented=segmented),
-        grid=(b, h, s // block_q, s // block_k),
+                          segmented=segmented, tri=tri,
+                          n_blocks=n_blocks),
+        grid=dq_grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
             pl.BlockSpec((1, 1, block_k, d), kmap),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda bi, hi, qi, ki: (bi, qi, 0)),
-            pl.BlockSpec((1, block_k, 1),
-                         lambda bi, hi, qi, ki: (bi, ki, 0)),
+            pl.BlockSpec((1, block_q, 1), seg_q),
+            pl.BlockSpec((1, block_k, 1), seg_k),
             pl.BlockSpec((1, 1, block_q, d), qmap),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap),
@@ -375,29 +478,44 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, segmented, res, do):
         interpret=interpret,
     )(q, k, v, seg, seg, do, lse, delta)
 
-    # dk/dv: grid puts K blocks in dim 2, Q scan innermost.
-    def kmap2(bi, hi, ki, qi):
-        return (bi, hi, ki, 0)
+    # dk/dv: K blocks in the outer position, Q scan innermost.
+    if tri:
+        dkv_grid = (b, h, n_blocks * (n_blocks + 1) // 2)
 
-    def qmap2(bi, hi, ki, qi):
-        return (bi, hi, qi, 0)
+        def kmap2(bi, hi, t):
+            return (bi, hi, _tri_kq(t, n_blocks)[0], 0)
 
-    def qvecmap2(bi, hi, ki, qi):
-        return (bi, hi, qi, 0)
+        def qmap2(bi, hi, t):
+            return (bi, hi, _tri_kq(t, n_blocks)[1], 0)
+
+        seg_q2 = lambda bi, hi, t: (bi, _tri_kq(t, n_blocks)[1], 0)
+        seg_k2 = lambda bi, hi, t: (bi, _tri_kq(t, n_blocks)[0], 0)
+        qvecmap2 = qmap2
+    else:
+        dkv_grid = (b, h, s // block_k, s // block_q)
+
+        def kmap2(bi, hi, ki, qi):
+            return (bi, hi, ki, 0)
+
+        def qmap2(bi, hi, ki, qi):
+            return (bi, hi, qi, 0)
+
+        seg_q2 = lambda bi, hi, ki, qi: (bi, qi, 0)
+        seg_k2 = lambda bi, hi, ki, qi: (bi, ki, 0)
+        qvecmap2 = qmap2
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, causal=causal,
-                          segmented=segmented),
-        grid=(b, h, s // block_k, s // block_q),
+                          segmented=segmented, tri=tri,
+                          n_blocks=n_blocks),
+        grid=dkv_grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), qmap2),
             pl.BlockSpec((1, 1, block_k, d), kmap2),
             pl.BlockSpec((1, 1, block_k, d), kmap2),
-            pl.BlockSpec((1, block_q, 1),
-                         lambda bi, hi, ki, qi: (bi, qi, 0)),
-            pl.BlockSpec((1, block_k, 1),
-                         lambda bi, hi, ki, qi: (bi, ki, 0)),
+            pl.BlockSpec((1, block_q, 1), seg_q2),
+            pl.BlockSpec((1, block_k, 1), seg_k2),
             pl.BlockSpec((1, 1, block_q, d), qmap2),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
             pl.BlockSpec((1, 1, block_q, 1), qvecmap2),
@@ -427,12 +545,15 @@ def flash_attention(q, k, v, causal: bool = True,
                     segment_ids=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    causal_grid: str = DEFAULT_CAUSAL_GRID):
     """q: [B, S, Hq, D]; k, v: [B, S, Hkv, D]. Returns [B, S, Hq, D].
 
     Transposes to heads-major internally, repeats KV heads for GQA.
     `segment_ids` ([B, S] int) masks attention across packed-sequence
     boundaries (tokens attend only within their own segment).
+    `causal_grid='tri'` schedules only lower-triangle blocks (see
+    DEFAULT_CAUSAL_GRID notes; needs block_q == block_k).
     """
     from container_engine_accelerators_tpu.ops.attention import _repeat_kv
 
@@ -450,5 +571,5 @@ def flash_attention(q, k, v, causal: bool = True,
     else:
         seg = jnp.zeros((q.shape[0], q.shape[1], 1), jnp.float32)
     out = _flash(qt, kt, vt, seg, causal, block_q, block_k, interpret,
-                 segmented)
+                 segmented, causal_grid)
     return jnp.swapaxes(out, 1, 2)

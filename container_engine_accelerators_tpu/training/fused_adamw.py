@@ -1,0 +1,134 @@
+"""Fused AdamW with inline global-norm clipping — the optimizer as ONE
+HBM pass.
+
+Why (round-3 step-time attribution, BASELINE.md): the optimizer +
+global-norm tax is ~25-30 ms of a 0.342 s bench step and is pure HBM
+bandwidth — adam touches params, grads and both moments once each, so
+its floor is (4 reads + 3 writes) x N floats. The optax chain
+`clip_by_global_norm -> adamw` layered on `apply_updates` gives XLA a
+graph with THREE tree-shaped intermediates (clipped grads, adam
+updates, decayed+scaled updates) and a SECOND full read of the grads
+for the metrics' global norm. XLA's fusion usually collapses most of
+it, but "usually" is not a contract; this module makes the minimal
+traffic structural:
+
+  - the clip scale folds into the moment updates (no clipped-grad tree);
+  - weight decay and the lr schedule fold into the update expression
+    (no separate decayed/scaled trees);
+  - the global norm is computed ONCE and stashed in the optimizer state
+    (`FusedAdamWState.gnorm`), so the train step's metrics read a
+    scalar instead of re-reducing every gradient (one full N-float read
+    saved per step);
+  - `mu_dtype=jnp.bfloat16` (optional) halves first-moment traffic the
+    way optax's own mu_dtype does — moments are read/written every
+    step, so this saves ~N bytes x 2 per step at a precision cost that
+    is standard practice for momentum (the second moment stays f32:
+    rsqrt amplifies its quantization).
+
+Semantics mirror `optax.chain(clip_by_global_norm(c),
+adamw(schedule, b1, b2, weight_decay=wd))` EXACTLY (pinned by
+tests/test_fused_optim.py): same clip trigger select, same bias
+corrections (count+1), same lr = schedule(count-before-increment),
+same eps placement. Only the state LAYOUT differs — a flat
+FusedAdamWState instead of optax's nested chain tuple — so checkpoints
+written with the old chain do not resume into this optimizer (round-5
+break, noted in BASELINE.md; re-train or keep the old make_optimizer
+call for legacy runs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedAdamWState(NamedTuple):
+    count: jnp.ndarray   # [] int32 — steps applied so far
+    mu: Any              # first moment (mu_dtype)
+    nu: Any              # second moment (f32)
+    gnorm: jnp.ndarray   # [] f32 — PRE-clip global grad norm of the
+    #                      last update (metrics read this scalar
+    #                      instead of re-reducing all grads)
+
+
+def grad_norm_metric(opt_state, grads) -> jnp.ndarray:
+    """The train step's grad_norm metric: the scalar the fused state
+    already carries, or a fresh reduction for any other optimizer (one
+    full read of every gradient — exactly what the fused path avoids).
+    Single source of the rule for train.py and tools/optim_bench.py."""
+    if isinstance(opt_state, FusedAdamWState):
+        return opt_state.gnorm
+    return optax.global_norm(grads)
+
+
+def fused_adamw(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 1e-4,
+                grad_clip: float | None = None,
+                mu_dtype: Optional[Any] = None
+                ) -> optax.GradientTransformation:
+    """learning_rate: float or schedule (count -> lr)."""
+    schedule = (learning_rate if callable(learning_rate)
+                else (lambda _: learning_rate))
+
+    def init_fn(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype),
+            params)
+        nu = jax.tree.map(jnp.zeros_like, params)
+        return FusedAdamWState(count=jnp.zeros((), jnp.int32), mu=mu,
+                               nu=nu, gnorm=jnp.zeros((), jnp.float32))
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adamw requires params (weight decay)")
+        gnorm = optax.global_norm(grads)
+        if grad_clip is not None:
+            # optax.clip_by_global_norm's exact form: select, not
+            # min(1, c/norm) — the trigger select keeps the no-clip
+            # path free of a divide.
+            trigger = gnorm < grad_clip
+            scale = jax.lax.select(
+                trigger, jnp.ones((), jnp.float32),
+                grad_clip / gnorm.astype(jnp.float32))
+        else:
+            scale = jnp.ones((), jnp.float32)
+        count_inc = optax.safe_increment(state.count)
+        lr = schedule(state.count)  # optax scale_by_schedule: pre-inc
+        bc1 = 1.0 - b1 ** count_inc.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count_inc.astype(jnp.float32)
+
+        # Per-leaf fused math. Three tree maps share subexpressions
+        # (m_new, v_new); under one jit XLA CSE merges them, and each
+        # leaf's whole chain is a single elementwise fusion: read
+        # (g, p, mu, nu) once, write (update, mu', nu') once.
+        def m_new(g, m):
+            # NOTE: b1 * m runs in m's dtype (weak-typed scalar), as in
+            # optax.tree.update_moment — under mu_dtype=bf16 the decay
+            # product rounds in bf16 BEFORE the f32 add, and parity
+            # with optax requires reproducing that rounding.
+            g = g.astype(jnp.float32) * scale
+            return b1 * m + (1.0 - b1) * g
+
+        def v_new(g, v):
+            g = g.astype(jnp.float32) * scale
+            return b2 * v + (1.0 - b2) * (g * g)
+
+        def upd(g, p, m, v):
+            mhat = m_new(g, m) / bc1
+            vhat = v_new(g, v) / bc2
+            u = mhat / (jnp.sqrt(vhat) + eps)
+            u = u + weight_decay * p.astype(jnp.float32)
+            return (-lr * u).astype(p.dtype)
+
+        updates = jax.tree.map(upd, grads, params, state.mu, state.nu)
+        new_mu = jax.tree.map(
+            lambda g, m: m_new(g, m).astype(mu_dtype or m.dtype),
+            grads, state.mu)
+        new_nu = jax.tree.map(v_new, grads, state.nu)
+        return updates, FusedAdamWState(count=count_inc, mu=new_mu,
+                                        nu=new_nu, gnorm=gnorm)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -21,6 +21,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from container_engine_accelerators_tpu.models import llama
 from container_engine_accelerators_tpu.parallel import sharding as shd
+from container_engine_accelerators_tpu.training.fused_adamw import (
+    grad_norm_metric,
+)
 
 
 class TrainState(NamedTuple):
@@ -33,14 +36,36 @@ def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
                    b1: float = 0.9, b2: float = 0.95,
                    grad_clip: float = 1.0,
                    warmup_steps: int = 100,
-                   decay_steps: int = 10_000) -> optax.GradientTransformation:
+                   decay_steps: int = 10_000,
+                   mu_dtype=None,
+                   fused: bool = True) -> optax.GradientTransformation:
+    """The training update rule: global-norm clip -> AdamW on a
+    warmup-cosine schedule.
+
+    `fused=True` (default since round 5) takes the single-HBM-pass
+    implementation (training/fused_adamw.py): identical math to the
+    optax chain — pinned by tests/test_fused_optim.py — with the clip
+    scale, weight decay, and lr folded into one per-leaf expression and
+    the pre-clip grad norm stashed in the state so the train step's
+    metrics don't re-reduce every gradient. `mu_dtype=jnp.bfloat16`
+    additionally halves first-moment HBM traffic. `fused=False` keeps
+    the legacy optax chain (its state layout matches pre-round-5
+    checkpoints)."""
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=learning_rate,
         warmup_steps=warmup_steps, decay_steps=decay_steps,
         end_value=learning_rate * 0.1)
+    if fused:
+        from container_engine_accelerators_tpu.training.fused_adamw import (
+            fused_adamw,
+        )
+        return fused_adamw(schedule, b1=b1, b2=b2,
+                           weight_decay=weight_decay,
+                           grad_clip=grad_clip, mu_dtype=mu_dtype)
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 
@@ -146,7 +171,9 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
+        # Fused path: the state carries the norm; re-reducing here would
+        # read every gradient a second time for a scalar.
+        gnorm = grad_norm_metric(new_opt, grads)
         metrics = {"loss": loss, "grad_norm": gnorm,
                    "tokens": jnp.sum((batch["targets"] >= 0).astype(jnp.int32))}
         return TrainState(state.step + 1, new_params, new_opt), metrics

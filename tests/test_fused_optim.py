@@ -1,0 +1,141 @@
+"""Fused AdamW parity: training/fused_adamw.py must compute EXACTLY the
+optax.chain(clip_by_global_norm, adamw) update it replaces — the perf
+rewrite (verdict r4 next #1, optimizer HBM tax) is only shippable if
+the math is bit-for-bit-level pinned."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from container_engine_accelerators_tpu.training.fused_adamw import (
+    FusedAdamWState,
+    fused_adamw,
+)
+
+
+def _tree(key, scale=1.0):
+    ks = jax.random.split(key, 4)
+    return {
+        "a": jax.random.normal(ks[0], (16, 8)) * scale,
+        "b": {"w": jax.random.normal(ks[1], (4, 4, 4)) * scale,
+              "bias": jax.random.normal(ks[2], (8,)) * scale},
+        "c": jax.random.normal(ks[3], (1,)) * scale,
+    }
+
+
+def _reference(schedule, b1, b2, wd, clip, mu_dtype=None):
+    return optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=wd,
+                    mu_dtype=mu_dtype))
+
+
+@pytest.mark.parametrize("grad_scale", [1.0, 100.0])  # no-clip / clip
+def test_fused_matches_optax_chain(grad_scale):
+    """5 steps, both sides jitted, gradients re-drawn each step; the
+    grad_scale=100 case forces the clip path (global norm >> 1)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, 3e-4, warmup_steps=2, decay_steps=10, end_value=3e-5)
+    b1, b2, wd, clip = 0.9, 0.95, 0.1, 1.0
+    fused = fused_adamw(schedule, b1=b1, b2=b2, weight_decay=wd,
+                        grad_clip=clip)
+    ref = _reference(schedule, b1, b2, wd, clip)
+
+    params_f = _tree(jax.random.key(0))
+    params_r = jax.tree.map(jnp.copy, params_f)
+    sf, sr = fused.init(params_f), ref.init(params_r)
+
+    @jax.jit
+    def step_f(p, s, g):
+        u, s = fused.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    @jax.jit
+    def step_r(p, s, g):
+        u, s = ref.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    for i in range(5):
+        g = _tree(jax.random.key(100 + i), scale=grad_scale)
+        params_f, sf = step_f(params_f, sf, g)
+        params_r, sr = step_r(params_r, sr, g)
+
+    for lf, lr_ in zip(jax.tree.leaves(params_f),
+                       jax.tree.leaves(params_r)):
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(lr_),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_fused_gnorm_matches_global_norm():
+    """The stashed gnorm is the PRE-clip global norm — what the train
+    step's grad_norm metric reported before this change."""
+    fused = fused_adamw(1e-3, grad_clip=1.0, weight_decay=0.0)
+    params = _tree(jax.random.key(1))
+    state = fused.init(params)
+    g = _tree(jax.random.key(2), scale=50.0)
+    _, state = fused.update(g, state, params)
+    assert isinstance(state, FusedAdamWState)
+    np.testing.assert_allclose(float(state.gnorm),
+                               float(optax.global_norm(g)), rtol=1e-6)
+
+
+def test_fused_mu_dtype_matches_optax():
+    """bf16 first moment: parity vs optax's own mu_dtype handling
+    (compute in f32 from the cast-stored moment, cast after)."""
+    schedule = 1e-3
+    fused = fused_adamw(schedule, b1=0.9, b2=0.95, weight_decay=0.1,
+                        grad_clip=1.0, mu_dtype=jnp.bfloat16)
+    ref = _reference(lambda _: schedule, 0.9, 0.95, 0.1, 1.0,
+                     mu_dtype=jnp.bfloat16)
+    params_f = _tree(jax.random.key(3))
+    params_r = jax.tree.map(jnp.copy, params_f)
+    sf, sr = fused.init(params_f), ref.init(params_r)
+    assert jax.tree.leaves(sf.mu)[0].dtype == jnp.bfloat16
+    for i in range(3):
+        g = _tree(jax.random.key(200 + i))
+        uf, sf = fused.update(g, sf, params_f)
+        ur, sr = ref.update(g, sr, params_r)
+        params_f = optax.apply_updates(params_f, uf)
+        params_r = optax.apply_updates(params_r, ur)
+    for lf, lr_ in zip(jax.tree.leaves(params_f),
+                       jax.tree.leaves(params_r)):
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(lr_),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_train_step_uses_fused_by_default(cpu_devices):
+    """make_optimizer defaults to the fused path; a train step runs,
+    the grad_norm metric comes from the stashed scalar, and loss
+    decreases over a few steps on a tiny overfit batch."""
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes,
+        make_mesh,
+    )
+    from container_engine_accelerators_tpu.training import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from container_engine_accelerators_tpu.training.train import (
+        shard_batch,
+    )
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    mesh = make_mesh(MeshAxes(fsdp=2, tp=2), devices=cpu_devices[:4])
+    opt = make_optimizer(warmup_steps=1, decay_steps=50)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    assert isinstance(state.opt_state, FusedAdamWState)
+    step = make_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                                cfg.vocab_size)
+    batch = shard_batch({"inputs": tokens,
+                         "targets": jnp.roll(tokens, -1, axis=1)}, mesh)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        assert float(metrics["grad_norm"]) > 0.0
+    assert losses[-1] < losses[0]

@@ -110,6 +110,82 @@ def test_flash_attention_grads_match_reference():
         np.testing.assert_allclose(a, b_, rtol=5e-4, atol=5e-4)
 
 
+def test_tri_index_inversions_exact():
+    """The sqrt-seeded integer inversions behind the triangular causal
+    grid must be EXACT for every flattened index — an off-by-one maps a
+    block to the wrong (qi, ki) pair and silently corrupts attention."""
+    for n in [1, 2, 3, 7, 16, 64, 317]:
+        t = jnp.arange(n * (n + 1) // 2)
+        qi, ki = fa._tri_qk(t, n)
+        expect = [(q_, k_) for q_ in range(n) for k_ in range(q_ + 1)]
+        got = list(zip(np.asarray(qi).tolist(), np.asarray(ki).tolist()))
+        assert got == expect, f"_tri_qk wrong at n={n}"
+        ki2, qi2 = fa._tri_kq(t, n)
+        expect2 = [(k_, q_) for k_ in range(n) for q_ in range(k_, n)]
+        got2 = list(zip(np.asarray(ki2).tolist(),
+                        np.asarray(qi2).tolist()))
+        assert got2 == expect2, f"_tri_kq wrong at n={n}"
+
+
+@pytest.mark.parametrize("s", [256, 640])
+def test_flash_tri_grid_matches_rect(s):
+    """causal_grid='tri' (lower-triangle-only scheduling) computes the
+    same function as the rect grid, forward and backward — it only
+    drops the blocks the rect grid predicates away (plus their K/V
+    DMAs)."""
+    b, hq, hkv, d = 1, 2, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, d), jnp.float32)
+
+    def loss(grid):
+        def f(q, k, v):
+            o = fa.flash_attention(q, k, v, causal=True, block_q=128,
+                                   block_k=128, interpret=True,
+                                   causal_grid=grid)
+            return jnp.sum(o * jnp.cos(o)), o
+        return f
+
+    (l_r, o_r), g_r = jax.value_and_grad(loss("rect"), argnums=(0, 1, 2),
+                                         has_aux=True)(q, k, v)
+    (l_t, o_t), g_t = jax.value_and_grad(loss("tri"), argnums=(0, 1, 2),
+                                         has_aux=True)(q, k, v)
+    np.testing.assert_allclose(o_t, o_r, rtol=1e-6, atol=1e-6)
+    for a, b_ in zip(g_t, g_r):
+        np.testing.assert_allclose(a, b_, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_tri_grid_segment_ids():
+    """tri grid composes with packed-sequence segment masking."""
+    b, s, h, d = 1, 256, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    seg = jnp.concatenate([jnp.zeros((b, 128), jnp.int32),
+                           jnp.ones((b, 128), jnp.int32)], axis=1)
+    got = fa.flash_attention(q, k, v, causal=True, segment_ids=seg,
+                             block_q=128, block_k=128, interpret=True,
+                             causal_grid="tri")
+    expect = fa.flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                block_q=128, block_k=128, interpret=True,
+                                causal_grid="rect")
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+
+
+def test_flash_tri_falls_back_on_unequal_blocks():
+    # block_q != block_k can't flatten to one triangle; must still be
+    # correct (silently rect).
+    b, s, h, d = 1, 256, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.float32)
+    got = fa.flash_attention(q, k, v, causal=True, block_q=128,
+                             block_k=256, interpret=True,
+                             causal_grid="tri")
+    expect = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+
 def test_flash_supported_gate():
     mk = lambda s, d: jnp.zeros((1, s, 1, d))
     assert fa.supported(mk(256, 128), mk(256, 128), mk(256, 128))

@@ -1,0 +1,128 @@
+"""Flash-attention block/grid autotune at the bench shapes — the
+one-command measurement the round-4 verdict asked to have staged for
+the moment the TPU tunnel returns (next #1).
+
+Sweeps (block_q, block_k) aspect ratios and the causal grid shape
+('rect' vs the round-5 'tri' lower-triangle scheduling) for the fwd
+kernel and the full fwd+bwd train path, scan-amortized inside one jit
+(tunnel discipline: no per-step fences, scalar reduction fetched).
+
+Prints one JSON line per config with achieved TFLOP/s, plus a final
+"winner" line naming the best (block_q, block_k, grid) for fwd and
+train — feed those into ops/flash_attention.py DEFAULT_* if they beat
+the current 1024/1024/rect defaults.
+
+Usage:  python tools/flash_sweep.py [--seq 2048] [--iters 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+PEAK = 197e12
+B, HQ, HKV, D = 5, 16, 8, 128
+LAYERS = 8  # scan length, amortizes dispatch like a stacked-layer model
+
+
+def timed_scalar(sfn, *args, iters=6, warmup=2):
+    for _ in range(warmup):
+        jax.device_get(sfn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(sfn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main():
+    from container_engine_accelerators_tpu.ops import flash_attention as fa
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--blocks", default="256,512,1024,2048")
+    args = ap.parse_args()
+    s = args.seq
+    blocks = [int(x) for x in args.blocks.split(",") if int(x) <= s]
+
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, s, HQ, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, s, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, s, HKV, D), jnp.bfloat16)
+    # Causal effective FLOPs: 2 matmuls x 2*S^2*D MACs, halved by the
+    # causal mask. bwd re-does ~2.5x the fwd matmul work (dq, dk, dv,
+    # plus the recomputed scores).
+    fwd_flops = LAYERS * 2 * B * HQ * s * s * D
+    bwd_flops = int(fwd_flops * 3.5)
+
+    results = []
+    for bq, bk in itertools.product(blocks, blocks):
+        grids = ["rect"] + (["tri"] if bq == bk else [])
+        for grid in grids:
+            def attn(q, k, v, bq=bq, bk=bk, grid=grid):
+                def body(c, _):
+                    o = fa.flash_attention(c, k, v, causal=True,
+                                           block_q=bq, block_k=bk,
+                                           causal_grid=grid)
+                    return o.astype(c.dtype), None
+                y, _ = jax.lax.scan(body, q, jnp.arange(LAYERS))
+                return y
+
+            sfwd = jax.jit(
+                lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32)))
+
+            def train_loss(q, k, v):
+                return jnp.sum(attn(q, k, v).astype(jnp.float32))
+
+            def train_step(q, k, v):
+                # Reduce the GRADS into the fetched scalar: discarding
+                # them would let XLA DCE all three backward kernels and
+                # time a forward-only program as "train".
+                loss, (dq, dk, dv) = jax.value_and_grad(
+                    train_loss, argnums=(0, 1, 2))(q, k, v)
+                return (loss
+                        + jnp.sum(dq.astype(jnp.float32))
+                        + jnp.sum(dk.astype(jnp.float32))
+                        + jnp.sum(dv.astype(jnp.float32)))
+
+            strain = jax.jit(train_step)
+
+            row = {"block_q": bq, "block_k": bk, "grid": grid, "seq": s}
+            try:
+                t = timed_scalar(sfwd, q, k, v, iters=args.iters)
+                row["fwd_tflops"] = round(fwd_flops / t / 1e12, 1)
+                row["fwd_frac_peak"] = round(fwd_flops / t / PEAK, 3)
+                t = timed_scalar(strain, q, k, v, iters=args.iters)
+                row["train_tflops"] = round(bwd_flops / t / 1e12, 1)
+                row["train_frac_peak"] = round(bwd_flops / t / PEAK, 3)
+            except Exception as e:  # a config the backend can't compile
+                row["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps(row), flush=True)
+            results.append(row)
+
+    ok = [r for r in results if "error" not in r]
+    if ok:
+        best_f = max(ok, key=lambda r: r["fwd_tflops"])
+        best_t = max(ok, key=lambda r: r["train_tflops"])
+        print(json.dumps({
+            "winner_fwd": {k_: best_f[k_] for k_ in
+                           ("block_q", "block_k", "grid", "fwd_tflops")},
+            "winner_train": {k_: best_t[k_] for k_ in
+                             ("block_q", "block_k", "grid",
+                              "train_tflops")},
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Optimizer step-time comparison: fused AdamW vs the legacy optax
+chain, at the bench model's parameter scale — the measurement half of
+the round-5 optimizer rewrite (round-3 attribution: ~25-30 ms of
+HBM-bound optimizer + global-norm per 0.342 s step).
+
+Times ONLY the update (grads held fixed), scan-amortized in one jit,
+for three variants:
+  chain      optax.chain(clip_by_global_norm, adamw)  [pre-round-5]
+  fused      training/fused_adamw.py, f32 moments
+  fused_bf16 fused with mu_dtype=bfloat16 (halves first-moment traffic)
+
+Prints one JSON line each with median ms and implied HBM GB/s, plus the
+metrics-side saving (the fused state carries the grad norm, so the
+train step stops re-reducing every gradient).
+
+Usage:  python tools/optim_bench.py [--iters 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, ".")
+
+
+def timed(sfn, *args, iters=8, warmup=2):
+    for _ in range(warmup):
+        jax.device_get(sfn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(sfn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main():
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.training import make_optimizer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--repeat", type=int, default=4,
+                    help="updates chained per timed call (amortizes "
+                         "dispatch)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="llama_tiny params — CPU smoke test of the "
+                         "harness, not a measurement")
+    args = ap.parse_args()
+
+    # The bench config's exact parameter tree.
+    cfg = llama.llama_tiny() if args.tiny else llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    grads = jax.tree.map(
+        lambda p: (p.astype(jnp.float32) * 1e-3), params)
+
+    variants = {
+        "chain": make_optimizer(fused=False),
+        "fused": make_optimizer(fused=True),
+        "fused_bf16mu": make_optimizer(fused=True,
+                                       mu_dtype=jnp.bfloat16),
+    }
+    for name, opt in variants.items():
+        state = jax.jit(opt.init)(params)
+
+        from container_engine_accelerators_tpu.training.fused_adamw import (
+            grad_norm_metric,
+        )
+
+        def run(params, state, grads, opt=opt):
+            def body(carry, _):
+                p, s = carry
+                # Tie the step's grads to the carry with a no-op-scale
+                # scalar: loop-INVARIANT grads would let XLA hoist the
+                # metrics norm out of the loop, under-charging the
+                # chain variant for the re-reduce its real train step
+                # (fresh grads every step) pays.
+                sc = 1.0 + 0.0 * jnp.sum(
+                    p["final_norm"].astype(jnp.float32))
+                g_i = jax.tree.map(lambda g: g * sc, grads)
+                u, s = opt.update(g_i, s, p)
+                p = optax.apply_updates(p, u)
+                # Charge each variant the metrics read its train step
+                # actually pays (fused: the stashed scalar).
+                return (p, s), grad_norm_metric(s, g_i)
+
+            (p, _), gs = jax.lax.scan(body, (params, state),
+                                      jnp.arange(args.repeat))
+            # Anchor EVERY param leaf in the output: reducing only one
+            # leaf would make the other leaves' whole update chains
+            # dead scan carries that XLA strips from the timed loop.
+            return jnp.sum(gs) + optax.global_norm(p)
+
+        sfn = jax.jit(run)
+        t = timed(sfn, params, state, grads,
+                  iters=args.iters) / args.repeat
+        # Traffic floor: read g, p, mu, nu + write p, mu, nu (f32),
+        # with mu halved under bf16.
+        mu_bytes = 2 if name.endswith("bf16mu") else 4
+        floor = n_params * (4 * 4 + 2 * 4 + 2 * mu_bytes)
+        print(json.dumps({
+            "variant": name, "ms": round(t * 1e3, 2),
+            "params_m": round(n_params / 1e6, 1),
+            "floor_gb": round(floor / 1e9, 2),
+            "implied_gbps": round(floor / t / 1e9, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

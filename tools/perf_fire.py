@@ -1,0 +1,86 @@
+"""One-command perf measurement for the first minutes of TPU
+availability (verdict r4 next #1's staging requirement).
+
+Probes the backend (subprocess-isolated, bounded), then runs in order:
+  1. bench.py                — the headline MFU number
+  2. tools/optim_bench.py    — fused-vs-chain optimizer step time
+  3. tools/flash_sweep.py    — flash block/grid autotune
+and collects every JSON line into PERF_RESULTS.json with a pass/fail
+status per stage, so ONE command turns tunnel uptime into the full
+measurement set:
+
+    python tools/perf_fire.py            # everything, ~15 min
+    python tools/perf_fire.py --quick    # bench + optimizer only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_stage(name, cmd, timeout, results):
+    print(f"--- {name}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+    t0 = time.time()
+    try:
+        # cwd=REPO: stage paths are repo-relative, and the tool must
+        # work from any cwd — a wasted uptime window is the one failure
+        # mode it exists to prevent.
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        results[name] = {"status": "timeout", "timeout_s": timeout}
+        return
+    lines = []
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                lines.append(json.loads(ln))
+            except ValueError:
+                pass
+    results[name] = {
+        "status": "ok" if proc.returncode == 0 else f"rc={proc.returncode}",
+        "seconds": round(time.time() - t0, 1),
+        "lines": lines,
+        "stderr_tail": proc.stderr[-500:],
+    }
+    for ln in lines:
+        print(json.dumps(ln), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="PERF_RESULTS.json")
+    ap.add_argument("--probe-budget", type=float, default=300.0)
+    args = ap.parse_args()
+
+    import bench
+    if not bench.require_backend(budget_s=args.probe_budget):
+        print("backend unavailable; PERF_RESULTS not written",
+              file=sys.stderr)
+        return 1
+
+    py = sys.executable
+    results = {}
+    run_stage("bench", [py, "bench.py"], 900, results)
+    run_stage("optim", [py, "tools/optim_bench.py"], 600, results)
+    if not args.quick:
+        run_stage("flash_sweep", [py, "tools/flash_sweep.py"], 1800,
+                  results)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
