@@ -1,0 +1,77 @@
+"""Worker for the CROSS-PROCESS tensor-parallel decode test: two OS
+processes joined via jax.distributed (gRPC — the DCN transport), one
+virtual CPU device each, with the decode tp mesh spanning BOTH — so
+every per-layer psum and the lm_head all-gather crosses a real process
+boundary. Prints one RESULT line with the generated tokens; the parent
+(tests/test_multiprocess.py) asserts exact parity with the replicated
+single-process path and between the two processes.
+
+This is the serving-side analog of multiproc_worker.py's train step —
+the reference's standard cross-host validation shape (2-host test pod
+pair, reference gpudirect-tcpxo/nccl-test-latest.yaml:15-31)."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.models import decode_tp
+from container_engine_accelerators_tpu.models.decode import generate
+from container_engine_accelerators_tpu.models.llama import (
+    init_params,
+    llama_tiny,
+)
+from container_engine_accelerators_tpu.parallel.distributed import (
+    initialize_from_env,
+)
+
+
+def main():
+    assert initialize_from_env(), "distributed init did not activate"
+    devices = jax.devices()
+    assert len(devices) == 2 and jax.process_count() == 2, (
+        f"expected 2 procs x 1 device, got {len(devices)} devices / "
+        f"{jax.process_count()} procs")
+
+    # f32 keeps token-level parity exact (see tests/test_decode_tp.py).
+    cfg = llama_tiny(dtype=jnp.float32)
+    prompt_np = np.asarray([[5, 17, 203], [9, 1, 42]], np.int32)
+
+    # Single-process reference on THIS process's local device.
+    params = init_params(jax.random.key(2), cfg)
+    ref = generate(params, jnp.asarray(prompt_np), cfg, max_new_tokens=6)
+    ref_toks = np.asarray(jax.device_get(ref)).tolist()
+
+    # tp=2 mesh spanning the two processes; params initialised DIRECTLY
+    # into their global sharded layout (same seed -> same values as the
+    # local reference init).
+    mesh = decode_tp.make_inference_mesh(tp=2, devices=devices)
+    specs = decode_tp.decode_param_specs(cfg)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    tp_params = jax.jit(lambda: init_params(jax.random.key(2), cfg),
+                        out_shardings=shardings)()
+    prompt = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(None, None)), prompt_np)
+    out = generate(tp_params, prompt, cfg, max_new_tokens=6, mesh=mesh)
+    out_toks = np.asarray(jax.device_get(out)).tolist()
+
+    match = out_toks == ref_toks
+    print(f"RESULT proc={jax.process_index()} match={match} "
+          f"tokens={out_toks}", flush=True)
+    if not match:
+        print(f"ref={ref_toks}", flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

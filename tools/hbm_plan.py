@@ -1,0 +1,193 @@
+"""Offline HBM planner (verdict r4 next #6): does a (config, mesh,
+batch) fit the chip? Answered BEFORE burning a compile — and wired into
+CI (tests/test_hbm_plan.py) so config drift that OOMs the flagship
+fails a test instead of a v5p-64 reservation.
+
+Accounting model (per chip):
+
+TRAINING (training/train.py + parallel/sharding.py shardings):
+  state     = params_f32 + mu + nu, sharded per llama_param_specs
+              (d_model->fsdp, heads/ff/vocab->tp, layers->pp, experts->ep)
+  grads     = one f32 params-sized tree (transient; peaks AFTER the
+              saved activations are freed, so the model takes
+              max(activations+logits, grads), not their sum)
+  acts      = remat='dots' saved dot outputs per layer per token
+              (qkv/o + gate/up/down + layer-boundary residuals), bf16,
+              tokens sharded over dp*fsdp*sp, heads/ff over tp
+  logits    = f32 logits + xent intermediates (x2), tokens over
+              dp*fsdp*sp, vocab over tp
+
+SERVING (models/decode_tp.py specs):
+  weights   = bf16 decode copy: layers + lm_head over tp, embed
+              replicated, experts replicated or /tp (moe_decode_ep)
+  kv        = the paged pool (pool_pages x page) or the slot
+              reservation (slots x max_len), KV heads over tp
+
+Calibration: the model reproduces the two measured v5e facts
+(BASELINE.md): bench batch 5 @ seq 2048 fits the 16 GB chip, batch 8
+does not. Treat answers within ~15% of the budget as "measure first".
+
+Usage:
+  python tools/hbm_plan.py                 # the three shipped plans
+  python tools/hbm_plan.py --json          # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GB = 1e9
+CHIP_HBM = {"v5e": 16e9, "v5p": 95e9, "v4": 32e9, "v6e": 32e9}
+
+
+def _layer_param_elems(cfg) -> tuple[int, int, int]:
+    """(attn+norm elems, dense-mlp elems, moe elems) per layer."""
+    hd = cfg.head_dim
+    attn = (cfg.d_model * hd * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
+            + 2 * cfg.d_model)
+    if cfg.n_experts:
+        moe = (cfg.n_experts * 3 * cfg.d_model * cfg.d_ff
+               + cfg.d_model * cfg.n_experts)
+        return attn, 0, moe
+    return attn, 3 * cfg.d_model * cfg.d_ff, 0
+
+
+def plan_training(cfg, *, dp=1, fsdp=1, pp=1, tp=1, sp=1, ep=1,
+                  batch_size=1, seq_len=2048, mu_bytes=4,
+                  chip="v5p") -> dict:
+    """Per-chip HBM breakdown for a training step. Mirrors
+    parallel/sharding.py llama_param_specs shard factors."""
+    attn, mlp, moe = _layer_param_elems(cfg)
+    L = cfg.n_layers
+    # Global parameter count, then per-chip via each term's own shard
+    # factor (un-sharding with one blanket multiplier would double-count
+    # vocab params under pp/ep).
+    vocab_total = 2 * cfg.vocab_size * cfg.d_model
+    dense_total = L * (attn + mlp)
+    moe_total = L * moe
+    params_total = vocab_total + dense_total + moe_total + cfg.d_model
+    p_chip = (vocab_total / (tp * fsdp)
+              + dense_total / (pp * fsdp * tp)
+              + moe_total / (pp * ep * fsdp * tp)
+              + cfg.d_model)  # final_norm replicated
+    state = p_chip * (4 + mu_bytes + 4)       # params f32 + mu + nu
+    grads = p_chip * 4
+
+    # Saved activations (dots policy), bf16, per token per layer:
+    # residual-stream saves (layer in, attn out, mlp out) are d_model
+    # wide and NOT tp-sharded; qkv and ff saves shard over tp.
+    hd = cfg.head_dim
+    per_tok_layer = (3 * cfg.d_model
+                     + (cfg.d_model + 2 * cfg.n_kv_heads * hd) / tp
+                     + 2 * (cfg.d_ff * (cfg.moe_top_k if cfg.n_experts
+                                        else 1)) / tp)
+    tokens_chip = batch_size * seq_len / (dp * fsdp * sp)
+    # A pipeline stage holds its own layers' saves for the microbatches
+    # in flight (~pp of them for gpipe) — the L/pp and x pp cancel, so
+    # the full-L product stands as-is.
+    acts = per_tok_layer * 2 * tokens_chip * L
+    logits = tokens_chip * cfg.vocab_size / tp * 4 * 2  # + xent temps
+
+    total = state + max(acts + logits, grads)
+    cap = CHIP_HBM[chip]
+    return {
+        "kind": "train", "chip": chip, "hbm_gb": round(cap / GB, 1),
+        "mesh": {"dp": dp, "fsdp": fsdp, "pp": pp, "tp": tp, "sp": sp,
+                 "ep": ep},
+        "batch": batch_size, "seq": seq_len,
+        "params_b": round(params_total / 1e9, 2),
+        "state_gb": round(state / GB, 2),
+        "grads_gb": round(grads / GB, 2),
+        "acts_gb": round(acts / GB, 2),
+        "logits_gb": round(logits / GB, 2),
+        "total_gb": round(total / GB, 2),
+        "headroom_gb": round((cap - total) / GB, 2),
+        "fits": bool(total < cap),
+    }
+
+
+def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
+                 pool_fraction=0.5, weight_bytes=2,
+                 chip="v5p") -> dict:
+    """Per-chip HBM for the paged serving deployment (cli/serve.py
+    defaults: pool = half the full slots x max_len reservation)."""
+    attn, mlp, moe = _layer_param_elems(cfg)
+    L = cfg.n_layers
+    embed = cfg.vocab_size * cfg.d_model          # replicated (decode)
+    lm_head = cfg.vocab_size * cfg.d_model / tp
+    moe_div = tp if (cfg.n_experts and cfg.moe_decode_ep) else 1
+    layers = L * ((attn + mlp) / tp + moe / moe_div)
+    weights = (embed + lm_head + layers + cfg.d_model) * weight_bytes
+
+    hd = cfg.head_dim
+    kv_full = (L * max_slots * max_len * 2
+               * (cfg.n_kv_heads / tp) * hd * weight_bytes)
+    kv = kv_full * pool_fraction
+    total = weights + kv
+    cap = CHIP_HBM[chip]
+    return {
+        "kind": "serve", "chip": chip, "hbm_gb": round(cap / GB, 1),
+        "tp": tp, "slots": max_slots, "max_len": max_len,
+        "weights_gb": round(weights / GB, 2),
+        "kv_pool_gb": round(kv / GB, 2),
+        "total_gb": round(total / GB, 2),
+        "headroom_gb": round((cap - total) / GB, 2),
+        "fits": bool(total < cap),
+    }
+
+
+def shipped_plans() -> list[dict]:
+    """The plans this repo ships and CI guards (tests/test_hbm_plan.py)."""
+    from container_engine_accelerators_tpu.models import llama
+
+    cfg8b = llama.LlamaConfig()  # defaults ARE Llama-3-8B
+    bench = llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=2048)
+    return [
+        # North star: Llama-3-8B training on v5p-64 (BASELINE.json).
+        plan_training(cfg8b, fsdp=64, batch_size=64, seq_len=8192,
+                      chip="v5p"),
+        # The serving demo's claim: 8B at tp=4 (demo/serving/*.yaml) —
+        # on the v5p host and on a 4-chip v5e node.
+        plan_serving(cfg8b, tp=4, max_slots=16, max_len=8192,
+                     chip="v5p"),
+        plan_serving(cfg8b, tp=4, max_slots=8, max_len=4096,
+                     chip="v5e"),
+        # Calibration pair: the bench config on the one real v5e chip —
+        # batch 5 fits (measured), batch 8 does not (measured compile
+        # failure). If a model change flips either, re-fit the model.
+        plan_training(bench, batch_size=5, seq_len=2048, chip="v5e"),
+        plan_training(bench, batch_size=8, seq_len=2048, chip="v5e"),
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    for plan in shipped_plans():
+        if args.json:
+            print(json.dumps(plan))
+        else:
+            head = (f"{plan['kind']:5s} {plan['chip']:4s} "
+                    f"total {plan['total_gb']:7.2f} GB / "
+                    f"{plan['hbm_gb']:5.1f} GB  "
+                    f"{'FITS' if plan['fits'] else 'DOES NOT FIT'} "
+                    f"(headroom {plan['headroom_gb']:.1f} GB)")
+            print(head)
+            detail = {k: v for k, v in plan.items()
+                      if k.endswith("_gb") and k not in
+                      ("hbm_gb", "total_gb", "headroom_gb")}
+            print("      " + "  ".join(f"{k}={v}" for k, v in
+                                       detail.items()))
+
+
+if __name__ == "__main__":
+    main()
