@@ -22,7 +22,7 @@ QUICK_TESTS = tests/test_deviceplugin.py tests/test_healthcheck.py \
     tests/test_partitioned_stack.py tests/test_manifests.py \
     tests/test_nri.py tests/test_native.py tests/test_dataset.py \
     tests/test_real_log_fixtures.py tests/test_installers.py \
-    tests/test_nri_golden.py
+    tests/test_nri_golden.py tests/test_hbm_plan.py
 
 test-quick:
 	$(PYTHON) -m pytest $(QUICK_TESTS) -q
@@ -40,6 +40,16 @@ presubmit:
 bench:
 	$(PYTHON) bench.py
 
+# One-command perf measurement for a TPU-uptime window: bench +
+# optimizer comparison + flash block/grid sweep -> PERF_RESULTS.json.
+perf:
+	$(PYTHON) tools/perf_fire.py
+
+# Offline HBM budgets for the shipped flagship configs (CI-guarded by
+# tests/test_hbm_plan.py).
+hbm-plan:
+	$(PYTHON) tools/hbm_plan.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
@@ -49,4 +59,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
-    dryrun clean
+    perf hbm-plan dryrun clean

@@ -941,12 +941,30 @@ def main(argv=None) -> int:
     p.add_argument("--quantize-int8", action="store_true",
                    help="serve int8-quantized weights (halves weight HBM "
                         "traffic on the decode path)")
+    p.add_argument("--moe-decode-ep", action="store_true",
+                   help="with --tp > 1 on an MoE model: shard experts "
+                        "over the tp axis (n_experts/tp per chip + one "
+                        "psum) instead of replicating them — expert HBM "
+                        "scales 1/tp (models/decode_tp.py)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     from container_engine_accelerators_tpu.models.convert import load_model
 
     params, cfg = load_model(None if args.tiny else args.checkpoint)
+    if args.moe_decode_ep:
+        if not cfg.n_experts:
+            p.error("--moe-decode-ep requires an MoE model")
+        import dataclasses
+        cfg = dataclasses.replace(cfg, moe_decode_ep=True)
+        # Validate tp-divisibility HERE, not in the engine's worker
+        # thread — a ValueError there kills the worker while /healthz
+        # stays green and requests hang.
+        from container_engine_accelerators_tpu.models import decode_tp
+        try:
+            decode_tp.validate_tp(cfg, args.tp)
+        except ValueError as e:
+            p.error(str(e))
     if args.quantize_int8:
         if args.tp > 1:
             p.error("--quantize-int8 is not supported with --tp > 1")
