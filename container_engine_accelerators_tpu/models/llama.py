@@ -8,7 +8,9 @@ Design notes (why this is not a torch translation):
   so XLA tiles them onto the MXU; activations default to bfloat16 with
   float32 softmax/norm statistics.
 - Rematerialisation is `jax.checkpoint` around the scanned layer body with
-  a configurable policy ('none' | 'dots' | 'full').
+  a configurable policy ('none' | 'dots' | 'dots_all' | 'full'), plus the
+  structural 'dots_save_attn' variant that hoists the attention core
+  outside the rematted halves (see REMAT_SPLIT_ATTN).
 - Sharding is applied from outside via NamedSharding on params plus
   `with_sharding_constraint` hints on activations (parallel/sharding.py);
   the model itself is mesh-agnostic.
@@ -44,7 +46,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16          # activations
     param_dtype: Any = jnp.float32     # master weights
-    remat_policy: str = "dots"         # 'none'|'dots_all'|'dots'|'full'
+    # 'none'|'dots'|'dots_all'|'full', or 'dots_save_attn' (attention
+    # hoisted outside remat: no flash fwd replay in the backward, at
+    # ~170 MB/layer of saved residuals at 8B bench shapes — see
+    # REMAT_SPLIT_ATTN; intended for flash-kernel configs).
+    remat_policy: str = "dots"
     use_flash: bool | None = None      # None = auto by platform
     # Sequence/context parallelism over the 'sp' mesh axis; enabled by
     # the training layer when the mesh has sp > 1. Mode 'ring' rotates
@@ -244,7 +250,8 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     }
 
 
-def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
+def _attention_qkv(x, lp, cfg: LlamaConfig, cos, sin, constrain):
+    """Pre-attention half: norm + q/k/v projections + rope."""
     b, s, d = x.shape
     hd = cfg.head_dim
     dt = cfg.dtype
@@ -254,31 +261,43 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
     v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    q = constrain(q, "qkv")
-    k = constrain(k, "qkv")
-    v = constrain(v, "qkv")
+    return (constrain(q, "qkv"), constrain(k, "qkv"),
+            constrain(v, "qkv"))
+
+
+def _attention_core(q, k, v, cfg: LlamaConfig, mesh):
+    """The attention contraction itself (flash / ring / ulysses)."""
     if cfg.sequence_parallel:
         if cfg.sequence_parallel_mode == "ulysses":
             from container_engine_accelerators_tpu.parallel import (
                 ulysses as ul,
             )
-            attn = ul.ulysses_attention(q, k, v, axis_name="sp",
+            return ul.ulysses_attention(q, k, v, axis_name="sp",
                                         mesh=mesh,
                                         use_flash=cfg.use_flash)
         elif cfg.sequence_parallel_mode == "ring":
             from container_engine_accelerators_tpu.parallel import (
                 ring_attention as ra,
             )
-            attn = ra.ring_attention(q, k, v, axis_name="sp", mesh=mesh)
-        else:
-            raise ValueError(
-                f"unknown sequence_parallel_mode "
-                f"{cfg.sequence_parallel_mode!r}; valid: ring, ulysses")
-    else:
-        attn = multi_head_attention(q, k, v, causal=True,
-                                    use_flash=cfg.use_flash)
-    attn = attn.reshape(b, s, cfg.n_heads * hd)
-    return x + constrain(attn @ lp["wo"].astype(dt), "resid")
+            return ra.ring_attention(q, k, v, axis_name="sp", mesh=mesh)
+        raise ValueError(
+            f"unknown sequence_parallel_mode "
+            f"{cfg.sequence_parallel_mode!r}; valid: ring, ulysses")
+    return multi_head_attention(q, k, v, causal=True,
+                                use_flash=cfg.use_flash)
+
+
+def _attention_out(x, attn, lp, cfg: LlamaConfig, constrain):
+    """Post-attention half: output projection + residual add."""
+    b, s, d = x.shape
+    attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    return x + constrain(attn @ lp["wo"].astype(cfg.dtype), "resid")
+
+
+def _attention(x, lp, cfg: LlamaConfig, cos, sin, constrain, mesh):
+    q, k, v = _attention_qkv(x, lp, cfg, cos, sin, constrain)
+    attn = _attention_core(q, k, v, cfg, mesh)
+    return _attention_out(x, attn, lp, cfg, constrain)
 
 
 def _mlp(x, lp, cfg: LlamaConfig, constrain, mesh=None,
@@ -323,8 +342,26 @@ _REMAT_POLICIES = {
     "full": "nothing_saveable",
 }
 
+# Structural variant, not a saveable-policy name: the layer body splits
+# into TWO 'dots'-rematted halves with the attention core OUTSIDE the
+# rematted regions. Why: flash attention's custom_vjp residuals
+# (q/k/v/out/lse) materialize only in the backward replay of its fwd
+# rule, so no remat POLICY can keep the backward from re-running the
+# fwd kernel once per layer (round-3 finding, ops/flash_attention.py
+# NOTE). Hoisting the call out of jax.checkpoint saves those residuals
+# normally — trading ~4*S*(2*Hq+2*Hkv... repeated: 4 head-major
+# [B,H,S,D] bf16 tensors + lse) of HBM per layer (~170 MB at bench
+# shapes) for one fwd flash kernel per layer per step (~1.3 ms x L).
+# Opt-in: needs the HBM headroom (tools/hbm_plan.py; pair with
+# mu_dtype=bfloat16 on 16 GB chips).
+REMAT_SPLIT_ATTN = "dots_save_attn"
+
 
 def _resolve_remat_policy(name: str):
+    if name not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; valid: "
+            f"{sorted(_REMAT_POLICIES)} or {REMAT_SPLIT_ATTN!r}")
     policy_name = _REMAT_POLICIES[name]
     if policy_name is None:
         return None
@@ -374,19 +411,57 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     # GSPMD still shards the stage internals from the param shardings.
     layer_constrain = (lambda y, kind: y) if use_pp else constrain
 
-    def layer_body(x, lp):
-        x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
-        # Inside the pipeline the ep-dropless dispatch nests via the
-        # CONTEXT mesh (in_pipeline flag): passing the concrete mesh to
-        # the inner shard_map would clash with the 'pp'-manual context
-        # (see moe._moe_dropless_ep).
-        x, aux = _mlp(x, lp, cfg, layer_constrain, mesh=mesh,
-                      in_pipeline=use_pp)
-        return x, aux
+    if cfg.remat_policy == REMAT_SPLIT_ATTN:
+        # Attention OUTSIDE the rematted regions: its custom_vjp
+        # residuals (incl. lse) save normally, so the backward replays
+        # no flash fwd kernel. Both halves still remat with 'dots'.
+        flash_engages = (cfg.head_dim % 128 == 0
+                         and (cfg.use_flash is True
+                              or (cfg.use_flash is None
+                                  and jax.default_backend()
+                                  not in ("cpu", "gpu"))))
+        if not flash_engages and not cfg.sequence_parallel:
+            # Without the flash kernel, the hoisted XLA attention saves
+            # its [B,H,S,S] probability residuals per layer — GBs, not
+            # the ~170 MB/layer this policy budgets for. Warn, don't
+            # raise: CPU parity tests legitimately run this config.
+            import warnings
+            warnings.warn(
+                "remat_policy='dots_save_attn' without the flash "
+                "kernel (use_flash resolves False or head_dim % 128 "
+                "!= 0) pins O(B*H*S^2) attention probabilities per "
+                "layer — use 'dots' instead", stacklevel=2)
+        inner = _resolve_remat_policy("dots")
 
-    if cfg.remat_policy != "none":
-        policy = _resolve_remat_policy(cfg.remat_policy)
-        layer_body = jax.checkpoint(layer_body, policy=policy)
+        def _pre(x, lp):
+            return _attention_qkv(x, lp, cfg, cos, sin, layer_constrain)
+
+        def _post(x, attn, lp):
+            x = _attention_out(x, attn, lp, cfg, layer_constrain)
+            return _mlp(x, lp, cfg, layer_constrain, mesh=mesh,
+                        in_pipeline=use_pp)
+
+        pre_ck = jax.checkpoint(_pre, policy=inner)
+        post_ck = jax.checkpoint(_post, policy=inner)
+
+        def layer_body(x, lp):
+            q, k, v = pre_ck(x, lp)
+            attn = _attention_core(q, k, v, cfg, mesh)
+            return post_ck(x, attn, lp)
+    else:
+        def layer_body(x, lp):
+            x = _attention(x, lp, cfg, cos, sin, layer_constrain, mesh)
+            # Inside the pipeline the ep-dropless dispatch nests via the
+            # CONTEXT mesh (in_pipeline flag): passing the concrete mesh
+            # to the inner shard_map would clash with the 'pp'-manual
+            # context (see moe._moe_dropless_ep).
+            x, aux = _mlp(x, lp, cfg, layer_constrain, mesh=mesh,
+                          in_pipeline=use_pp)
+            return x, aux
+
+        if cfg.remat_policy != "none":
+            policy = _resolve_remat_policy(cfg.remat_policy)
+            layer_body = jax.checkpoint(layer_body, policy=policy)
 
     if use_pp:
         v = (cfg.pipeline_circular_repeats

@@ -43,7 +43,7 @@ def _sync(x):
 
 def measure(policy: str, batch_size: int, *, seq_len: int = 2048,
             use_flash=None, steps: int = 10, warmup: int = 2,
-            fwd_only_too: bool = True) -> dict:
+            fwd_only_too: bool = True, mu_dtype=None) -> dict:
     from bench import detect_peak_flops
     from container_engine_accelerators_tpu.models import llama
     from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
@@ -61,7 +61,8 @@ def measure(policy: str, batch_size: int, *, seq_len: int = 2048,
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
                      devices=jax.devices())
-    opt = make_optimizer(warmup_steps=10, decay_steps=1000)
+    opt = make_optimizer(warmup_steps=10, decay_steps=1000,
+                         mu_dtype=mu_dtype)
     state = create_train_state(jax.random.key(0), cfg, mesh, opt)
     step_fn = make_train_step(cfg, mesh, opt)
     batches = [shard_batch(b, mesh) for b in synthetic_batches(
@@ -88,7 +89,8 @@ def measure(policy: str, batch_size: int, *, seq_len: int = 2048,
 
     result = {
         "variant": f"{policy}:b{batch_size}:s{seq_len}"
-                   + ("" if use_flash is None else f":flash={use_flash}"),
+                   + ("" if use_flash is None else f":flash={use_flash}")
+                   + ("" if mu_dtype is None else ":bf16mu"),
         "step_s": round(median, 4),
         "hbm_peak_gb": round(peak_gb, 2),
     }
@@ -119,14 +121,21 @@ def measure(policy: str, batch_size: int, *, seq_len: int = 2048,
 
 
 def main():
+    # Spec: policy:batch[:seq][:bf16mu]. dots_save_attn (round 5) needs
+    # bf16mu to fit b5 on the 16 GB v5e (tools/hbm_plan.py headroom
+    # math), so the default runs it WITH the bf16 first moment;
+    # dots_all:8 stays as the measured-OOM calibration point the HBM
+    # planner pins against.
     variants = sys.argv[1:] or [
-        "dots:5", "dots_all:5", "dots_all:8", "none:5"]
+        "dots:5", "dots_save_attn:5:2048:bf16mu", "dots:5:2048:bf16mu",
+        "dots_all:5", "dots_all:8", "none:5"]
     for spec in variants:
         parts = spec.split(":")
         policy, bs = parts[0], int(parts[1])
-        seq = int(parts[2]) if len(parts) > 2 else 2048
+        seq = int(parts[2]) if len(parts) > 2 and parts[2] else 2048
+        mu = jnp.bfloat16 if "bf16mu" in parts[3:] else None
         try:
-            r = measure(policy, bs, seq_len=seq)
+            r = measure(policy, bs, seq_len=seq, mu_dtype=mu)
         except Exception as e:  # OOM is an expected, informative outcome
             r = {"variant": spec, "error": f"{type(e).__name__}: {e}"[:200]}
         print(json.dumps(r), flush=True)
