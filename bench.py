@@ -48,6 +48,17 @@ def detect_peak_flops() -> float:
     return 197e12  # conservative default
 
 
+def _is_outage(msg: str) -> bool:
+    """True for accelerator-backend outage signatures (tunnel down /
+    reset mid-run) — NOT for compile/OOM config failures, which merely
+    mention a backend. Shared by the config-ladder fallback and the
+    __main__ handler so the two can never disagree about what counts
+    as an outage."""
+    low = msg.lower()
+    return ("UNAVAILABLE" in msg or "backend init" in low
+            or "failed to initialize" in low)
+
+
 def _emit_unavailable(detail: str) -> None:
     """One structured JSON line so a backend outage reads as an outage in
     BENCH_r*.json, not a crash with parsed=null (round-3 verdict item 1)."""
@@ -124,6 +135,45 @@ def require_backend(budget_s: float | None = None,
 
 
 def main():
+    """Measure the best of a CONFIG LADDER, newest levers first.
+
+    Round 5 added three step-time levers whose math is CPU-pinned but
+    whose on-chip speed is unmeasured (the tunnel was down): the
+    triangular causal flash grid, the dots_save_attn remat split, and
+    the bf16 first moment. The bench tries them stacked, falling back a
+    rung on ANY failure (mosaic lowering, OOM, anything) so the
+    headline number can only improve over the round-4 baseline config —
+    a failed experiment costs one compile, never the round's number.
+    The emitted JSON names the rung that ran (`config`)."""
+    ladder = [
+        ("tri+save_attn+bf16mu", dict(remat_policy="dots_save_attn",
+                                      flash_causal_grid="tri"),
+         jnp.bfloat16),
+        ("save_attn+bf16mu", dict(remat_policy="dots_save_attn"),
+         jnp.bfloat16),
+        ("baseline-dots", dict(remat_policy="dots"), None),
+    ]
+    last_err = None
+    for name, cfg_over, mu_dtype in ladder:
+        try:
+            _run_one(name, cfg_over, mu_dtype)
+            return
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            if _is_outage(msg):
+                raise  # outage, not a config failure — no point retrying
+            print(f"bench config {name} failed ({msg[:200]}); "
+                  "falling back", file=sys.stderr)
+            # Drop the traceback frames: they pin the failed rung's
+            # device buffers (state/opt/batches) alive, which would
+            # OOM the very fallback this ladder exists to protect.
+            import traceback
+            traceback.clear_frames(e.__traceback__)
+            last_err = RuntimeError(f"{name}: {msg[:300]}")
+    raise last_err
+
+
+def _run_one(config_name, cfg_overrides, mu_dtype):
     from container_engine_accelerators_tpu.models import llama
     from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
     from container_engine_accelerators_tpu.training import (
@@ -133,8 +183,8 @@ def main():
 
     cfg = llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
-        n_kv_heads=8, d_ff=8192, max_seq_len=2048, remat_policy="dots",
-        dtype=jnp.bfloat16)
+        n_kv_heads=8, d_ff=8192, max_seq_len=2048,
+        dtype=jnp.bfloat16, **cfg_overrides)
     batch_size, seq_len = 5, 2048
     warmup_steps = 3
     # 5 windows: the median still reads true with up to two windows hit
@@ -145,7 +195,8 @@ def main():
     mesh = make_mesh(MeshAxes(dp=1, fsdp=n_dev, sp=1, tp=1),
                      devices=jax.devices())
 
-    opt = make_optimizer(warmup_steps=10, decay_steps=1000)
+    opt = make_optimizer(warmup_steps=10, decay_steps=1000,
+                         mu_dtype=mu_dtype)
     state = create_train_state(jax.random.key(0), cfg, mesh, opt)
     step_fn = make_train_step(cfg, mesh, opt)
 
@@ -207,6 +258,7 @@ def main():
         "estimator": "median-window-pipelined",
         "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
         "wallclock_mfu": round(wall_mfu, 3),
+        "config": config_name,
     }))
 
 
@@ -217,7 +269,7 @@ if __name__ == "__main__":
         main()
     except Exception as e:  # mid-run flap: still emit the structured line
         msg = f"{type(e).__name__}: {e}"
-        if "UNAVAILABLE" in msg or "backend" in msg.lower():
+        if _is_outage(msg):
             _emit_unavailable(msg)
             sys.exit(0)
         raise

@@ -52,6 +52,10 @@ class LlamaConfig:
     # REMAT_SPLIT_ATTN; intended for flash-kernel configs).
     remat_policy: str = "dots"
     use_flash: bool | None = None      # None = auto by platform
+    # Flash kernel's causal grid: 'rect' (default) or 'tri' — triangle-
+    # only block scheduling, halves causal K/V DMA traffic
+    # (ops/flash_attention.py DEFAULT_CAUSAL_GRID notes).
+    flash_causal_grid: str = "rect"
     # Sequence/context parallelism over the 'sp' mesh axis; enabled by
     # the training layer when the mesh has sp > 1. Mode 'ring' rotates
     # KV blocks via ppermute (parallel/ring_attention.py); 'ulysses'
@@ -272,9 +276,10 @@ def _attention_core(q, k, v, cfg: LlamaConfig, mesh):
             from container_engine_accelerators_tpu.parallel import (
                 ulysses as ul,
             )
-            return ul.ulysses_attention(q, k, v, axis_name="sp",
-                                        mesh=mesh,
-                                        use_flash=cfg.use_flash)
+            return ul.ulysses_attention(
+                q, k, v, axis_name="sp", mesh=mesh,
+                use_flash=cfg.use_flash,
+                causal_grid=cfg.flash_causal_grid)
         elif cfg.sequence_parallel_mode == "ring":
             from container_engine_accelerators_tpu.parallel import (
                 ring_attention as ra,
@@ -284,7 +289,8 @@ def _attention_core(q, k, v, cfg: LlamaConfig, mesh):
             f"unknown sequence_parallel_mode "
             f"{cfg.sequence_parallel_mode!r}; valid: ring, ulysses")
     return multi_head_attention(q, k, v, causal=True,
-                                use_flash=cfg.use_flash)
+                                use_flash=cfg.use_flash,
+                                causal_grid=cfg.flash_causal_grid)
 
 
 def _attention_out(x, attn, lp, cfg: LlamaConfig, constrain):

@@ -51,11 +51,20 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          causal: bool = True,
-                         use_flash: bool | None = None) -> jnp.ndarray:
+                         use_flash: bool | None = None,
+                         causal_grid: str | None = None) -> jnp.ndarray:
     """Dispatch: pallas flash attention on TPU, XLA reference elsewhere.
 
     `use_flash=None` auto-selects based on the default backend platform.
+    `causal_grid` forwards to the flash kernel's causal scheduling
+    ('rect' | 'tri'; None = the kernel's default).
     """
+    if causal_grid not in (None, "rect", "tri"):
+        # Validate even when the kernel doesn't engage: a typo like
+        # 'triangular' silently measuring the rect schedule would
+        # mis-attribute a benchmark headline.
+        raise ValueError(f"causal_grid must be 'rect' or 'tri', "
+                         f"got {causal_grid!r}")
     if use_flash is None:
         platform = jax.default_backend()
         use_flash = platform not in ("cpu", "gpu")
@@ -63,5 +72,7 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         from container_engine_accelerators_tpu.ops import flash_attention as fa
 
         if fa.supported(q, k, v):
-            return fa.flash_attention(q, k, v, causal=causal)
+            kw = {} if causal_grid is None else {
+                "causal_grid": causal_grid}
+            return fa.flash_attention(q, k, v, causal=causal, **kw)
     return reference_attention(q, k, v, causal=causal)

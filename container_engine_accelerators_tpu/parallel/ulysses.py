@@ -29,7 +29,8 @@ from container_engine_accelerators_tpu.ops import multi_head_attention
 
 
 def _ulysses_body(q, k, v, *, axis_name: str, causal: bool,
-                  use_flash: bool | None):
+                  use_flash: bool | None,
+                  causal_grid: str | None = None):
     """Per-shard body. q: [B, S/sp, Hq, D]; k/v: [B, S/sp, Hkv, D]."""
     sp = int(jax.lax.psum(1, axis_name))  # static axis size
     for name, arr in (("q heads", q), ("kv heads", k)):
@@ -45,7 +46,8 @@ def _ulysses_body(q, k, v, *, axis_name: str, causal: bool,
     # preserved ((Hq/sp) / (Hkv/sp) == Hq/Hkv), and the flash kernel
     # gate sees the full sequence length.
     out = multi_head_attention(qg, kg, vg, causal=causal,
-                               use_flash=use_flash)
+                               use_flash=use_flash,
+                               causal_grid=causal_grid)
     # Gather heads back, scatter sequence: [B, S/sp, Hq, D].
     return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -53,13 +55,15 @@ def _ulysses_body(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, axis_name: str = "sp",
                       causal: bool = True, mesh: Mesh | None = None,
-                      use_flash: bool | None = None):
+                      use_flash: bool | None = None,
+                      causal_grid: str | None = None):
     """q: [B, S, Hq, D] (globally shaped, sequence sharded on
     `axis_name`); k/v: [B, S, Hkv, D]. Call inside an existing shard_map
     context (mesh=None) or at jit level with `mesh` given — the same
     calling contract as ring_attention."""
     body = functools.partial(_ulysses_body, axis_name=axis_name,
-                             causal=causal, use_flash=use_flash)
+                             causal=causal, use_flash=use_flash,
+                             causal_grid=causal_grid)
     if mesh is None:
         return body(q, k, v)
 

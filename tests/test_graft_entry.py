@@ -131,6 +131,43 @@ def test_bench_emits_structured_outage_line(monkeypatch, capsys):
     assert "tunnel down" in rec["detail"]
 
 
+def test_bench_config_ladder_falls_back(monkeypatch):
+    """bench.main tries the round-5 lever stack first and falls back a
+    rung on any non-outage failure — a failed experiment must cost one
+    compile, never the round's number."""
+    import bench
+
+    calls = []
+
+    def fake_run(name, over, mu):
+        calls.append(name)
+        if name != "baseline-dots":
+            raise RuntimeError("RESOURCE_EXHAUSTED: hbm oom")
+
+    monkeypatch.setattr(bench, "_run_one", fake_run)
+    bench.main()
+    assert calls == ["tri+save_attn+bf16mu", "save_attn+bf16mu",
+                     "baseline-dots"]
+
+
+def test_bench_config_ladder_aborts_on_outage(monkeypatch):
+    """An outage mid-run is NOT a config failure: re-raise immediately
+    (the __main__ handler emits the structured line) instead of burning
+    two more doomed compiles."""
+    import bench
+
+    calls = []
+
+    def fake_run(name, over, mu):
+        calls.append(name)
+        raise RuntimeError("UNAVAILABLE: tunnel reset")
+
+    monkeypatch.setattr(bench, "_run_one", fake_run)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        bench.main()
+    assert calls == ["tri+save_attn+bf16mu"]
+
+
 def test_bench_patience_rides_out_transient_outage(monkeypatch, capsys):
     """Verdict r4 item 4: patience is a wall-clock BUDGET. A probe that
     recovers on attempt 4 must yield True (and no outage line) as long

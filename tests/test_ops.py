@@ -186,6 +186,28 @@ def test_flash_tri_falls_back_on_unequal_blocks():
     np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
 
 
+def test_flash_causal_grid_threads_from_config(monkeypatch):
+    """cfg.flash_causal_grid reaches the kernel through
+    multi_head_attention — the bench ladder's tri rung depends on this
+    plumbing."""
+    from container_engine_accelerators_tpu.models import llama
+
+    seen = {}
+    orig = fa.flash_attention
+
+    def spy(q, k, v, **kw):
+        seen["grid"] = kw.get("causal_grid")
+        return orig(q, k, v, **{**kw, "interpret": True})
+
+    monkeypatch.setattr(fa, "flash_attention", spy)
+    cfg = llama.llama_tiny(d_model=256, n_heads=2, n_kv_heads=2,
+                           d_ff=256, vocab_size=128, use_flash=True,
+                           dtype=jnp.float32, flash_causal_grid="tri")
+    params = llama.init_params(jax.random.key(0), cfg)
+    llama.forward(params, jnp.zeros((1, 256), jnp.int32), cfg)
+    assert seen["grid"] == "tri"
+
+
 def test_flash_supported_gate():
     mk = lambda s, d: jnp.zeros((1, s, 1, d))
     assert fa.supported(mk(256, 128), mk(256, 128), mk(256, 128))
