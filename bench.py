@@ -56,7 +56,8 @@ def _is_outage(msg: str) -> bool:
     as an outage."""
     low = msg.lower()
     return ("UNAVAILABLE" in msg or "backend init" in low
-            or "failed to initialize" in low)
+            or "failed to initialize" in low
+            or "initialize backend" in low)  # jax's init-failure text
 
 
 def _emit_unavailable(detail: str) -> None:

@@ -412,6 +412,19 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             "moe_dropless implements token-choice routing; it cannot "
             "combine with moe_router='expert_choice' (which is already "
             "dropless — drop the moe_dropless flag)")
+    if cfg.flash_causal_grid not in ("rect", "tri"):
+        raise ValueError(
+            f"flash_causal_grid must be 'rect' or 'tri', got "
+            f"{cfg.flash_causal_grid!r}")
+    if (cfg.flash_causal_grid == "tri" and cfg.sequence_parallel
+            and cfg.sequence_parallel_mode == "ring"):
+        # Ring attention never reaches the flash causal grid (it runs
+        # its own blockwise schedule); silently measuring non-tri under
+        # a tri config would mis-attribute a benchmark.
+        raise ValueError(
+            "flash_causal_grid='tri' has no effect under "
+            "sequence_parallel_mode='ring'; use 'rect' (ring schedules "
+            "its own KV rotation) or ulysses sequence parallelism")
     # Inside the pipelined shard_map region ('pp' manual, others auto),
     # with_sharding_constraint over auto axes trips the XLA partitioner;
     # GSPMD still shards the stage internals from the param shardings.

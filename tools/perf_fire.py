@@ -2,9 +2,11 @@
 availability (verdict r4 next #1's staging requirement).
 
 Probes the backend (subprocess-isolated, bounded), then runs in order:
-  1. bench.py                — the headline MFU number
+  1. bench.py                — the headline MFU number (config ladder)
   2. tools/optim_bench.py    — fused-vs-chain optimizer step time
   3. tools/flash_sweep.py    — flash block/grid autotune
+  4. tools/serve_bench.py    — decode steps/sec (slot + paged engines)
+  5. tools/mfu_sweep.py      — remat-policy / batch whole-step sweep
 and collects every JSON line into PERF_RESULTS.json with a pass/fail
 status per stage, so ONE command turns tunnel uptime into the full
 measurement set:
@@ -71,10 +73,17 @@ def main():
 
     py = sys.executable
     results = {}
-    run_stage("bench", [py, "bench.py"], 900, results)
+    # 900s per ladder rung: bench.py may compile up to three configs
+    # before producing its number, and a stage timeout here would lose
+    # the headline the ladder exists to protect.
+    run_stage("bench", [py, "bench.py"], 2700, results)
     run_stage("optim", [py, "tools/optim_bench.py"], 600, results)
     if not args.quick:
         run_stage("flash_sweep", [py, "tools/flash_sweep.py"], 1800,
+                  results)
+        run_stage("serve_bench", [py, "tools/serve_bench.py"], 900,
+                  results)
+        run_stage("mfu_sweep", [py, "tools/mfu_sweep.py"], 1800,
                   results)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

@@ -1,0 +1,113 @@
+"""Serving-side decode throughput bench — the inference analog of
+bench.py, staged for the tunnel-uptime window (runs as a perf_fire
+stage).
+
+Measures steady-state DECODE steps/sec of the slot and paged engines'
+hot path (decode_step_slots / decode_step_paged, jitted once, donated
+cache) on the bench-sized model (634M params — fits one v5e with
+room), at several slot counts. Reports tokens/s (= slots x steps/s)
+and per-step latency; tunnel discipline throughout (steps enqueued
+back-to-back, one scalar fence per window).
+
+Usage:  python tools/serve_bench.py [--slots 8,16,32] [--steps 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", default="8,16,32")
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=2048)
+    ap.add_argument("--page", type=int, default=128)
+    ap.add_argument("--tiny", action="store_true",
+                    help="llama_tiny on the CPU backend — a smoke test "
+                         "of the harness, not a measurement")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.tiny:
+        # In-process force: the env var alone does not override this
+        # environment's TPU platform plugin, and a downed tunnel would
+        # hang the smoke test (BASELINE.md tunnel notes).
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_paged,
+        _jitted_decode_step_slots,
+        init_paged_cache,
+        init_slot_cache,
+    )
+
+    cfg = llama.llama_tiny() if args.tiny else llama.LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq_len=args.max_len,
+        dtype=jnp.bfloat16)
+    params = llama.init_params(jax.random.key(0), cfg)
+    max_len = 256 if args.tiny else args.max_len
+
+    for n_slots in [int(s) for s in args.slots.split(",")]:
+        for engine in ("slot", "paged"):
+            if engine == "slot":
+                cache = init_slot_cache(cfg, n_slots, max_len)
+                step = _jitted_decode_step_slots(cfg)
+            else:
+                max_pages = max_len // args.page
+                n_pages = n_slots * max_pages // 2 + 1
+                cache = init_paged_cache(cfg, n_slots, n_pages,
+                                         args.page, max_pages)
+                # Point every slot at distinct pages so writes hit real
+                # rows, as in steady-state serving.
+                import numpy as np
+                tables = np.zeros((n_slots, max_pages), np.int32)
+                flat = 1
+                for s_ in range(n_slots):
+                    for p_ in range(max_pages):
+                        tables[s_, p_] = flat if flat < n_pages else 0
+                        flat += 1
+                cache = cache._replace(tables=jnp.asarray(tables))
+                step = _jitted_decode_step_paged(cfg)
+            # Occupy every slot mid-sequence (the steady state).
+            cache = cache._replace(
+                length=jnp.full((n_slots,), max_len // 2, jnp.int32))
+            toks = jnp.ones((n_slots,), jnp.int32)
+            active = jnp.ones((n_slots,), bool)
+
+            # Warmup (compile) + fence.
+            logits, cache = step(params, cache, toks, active)
+            float(jnp.sum(logits))
+            cache = cache._replace(
+                length=jnp.full((n_slots,), max_len // 2, jnp.int32))
+
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(args.steps):
+                last, cache = step(params, cache, toks, active)
+                # Chain tokens through the cache dependency; greedy pick
+                # on-device keeps the loop fence-free.
+                toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            float(jnp.sum(last))
+            dt = (time.perf_counter() - t0) / args.steps
+            print(json.dumps({
+                "engine": engine, "slots": n_slots,
+                "step_ms": round(dt * 1e3, 3),
+                "tokens_per_s": round(n_slots / dt, 1),
+                "max_len": max_len,
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
