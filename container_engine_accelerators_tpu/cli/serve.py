@@ -941,6 +941,14 @@ def main(argv=None) -> int:
     p.add_argument("--quantize-int8", action="store_true",
                    help="serve int8-quantized weights (halves weight HBM "
                         "traffic on the decode path)")
+    p.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16",
+                   help="KV-cache storage dtype for ALL engines: int8 "
+                        "stores K/V as int8 with per-(token, head) f32 "
+                        "scales and dequantizes inside the decode "
+                        "kernels — roughly halves decode-step cache HBM "
+                        "traffic and doubles the slots that fit "
+                        "(tools/hbm_plan.py prices it); orthogonal to "
+                        "--quantize-int8, which quantizes WEIGHTS")
     p.add_argument("--moe-decode-ep", action="store_true",
                    help="with --tp > 1 on an MoE model: shard experts "
                         "over the tp axis (n_experts/tp per chip + one "
@@ -965,6 +973,13 @@ def main(argv=None) -> int:
             decode_tp.validate_tp(cfg, args.tp)
         except ValueError as e:
             p.error(str(e))
+    if args.kv_dtype != "bf16":
+        # One cfg field threads the mode through every engine: the
+        # cache allocators (init_*_cache), the jit caches (keyed on
+        # cfg), and the tp cache specs all read it.
+        import dataclasses
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
+        log.info("serving an int8 KV cache (fused in-kernel dequant)")
     if args.quantize_int8:
         if args.tp > 1:
             p.error("--quantize-int8 is not supported with --tp > 1")

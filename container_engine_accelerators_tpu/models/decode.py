@@ -22,7 +22,9 @@ from container_engine_accelerators_tpu.models.llama import LlamaConfig
 from container_engine_accelerators_tpu.ops import rms_norm, rope_frequencies
 from container_engine_accelerators_tpu.ops.quant import (
     QuantWeight,
+    dequantize_kv,
     int8_matmul,
+    quantize_kv,
 )
 from container_engine_accelerators_tpu.ops.rope import apply_rope
 
@@ -31,6 +33,12 @@ class KVCache(NamedTuple):
     k: jnp.ndarray       # [L, B, max_len, Hkv, D]
     v: jnp.ndarray       # [L, B, max_len, Hkv, D]
     length: jnp.ndarray  # [] int32 — tokens already cached
+    # Int8 mode (cfg.kv_cache_dtype='int8'): k/v hold int8 and these
+    # hold the per-(token, head) f32 dequant scales, head-major so the
+    # decode kernels tile positions on the 128-lane axis
+    # (ops/quant.quantize_kv). None in the bf16 mode.
+    k_scales: jnp.ndarray | None = None  # [L, B, Hkv, max_len] f32
+    v_scales: jnp.ndarray | None = None
 
 
 class PagedKVCache(NamedTuple):
@@ -47,33 +55,68 @@ class PagedKVCache(NamedTuple):
     v_pool: jnp.ndarray  # [L, n_pages, page, Hkv, D]
     tables: jnp.ndarray  # [slots, max_pages] int32 pool row per page
     length: jnp.ndarray  # [slots] int32 live length per slot
+    # Int8 mode: per-(token, head) f32 dequant scales in their own
+    # pools, indexed by the SAME tables — the page indirection covers
+    # scales for free (KVCache scale notes). None in the bf16 mode.
+    k_scales: jnp.ndarray | None = None  # [L, n_pages, Hkv, page] f32
+    v_scales: jnp.ndarray | None = None
 
     @property
     def page(self) -> int:
         return self.k_pool.shape[2]
 
 
+def _kv_dtype(cfg: LlamaConfig):
+    """The cache storage dtype cfg asks for (decode-path gate for the
+    int8 KV mode; llama.py validates the field on the training path)."""
+    if cfg.kv_cache_dtype == "int8":
+        return jnp.int8
+    if cfg.kv_cache_dtype != "bf16":
+        raise ValueError(
+            f"kv_cache_dtype must be 'bf16' or 'int8', got "
+            f"{cfg.kv_cache_dtype!r}")
+    return cfg.dtype
+
+
+def _is_int8(dtype) -> bool:
+    return jnp.dtype(dtype) == jnp.int8
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
                dtype=None, n_kv_heads: int | None = None) -> KVCache:
     """`n_kv_heads` overrides cfg's count — the tensor-parallel path
-    allocates per-shard caches holding only the shard's local KV heads."""
-    dtype = dtype or cfg.dtype
+    allocates per-shard caches holding only the shard's local KV heads.
+    `dtype` overrides cfg.kv_cache_dtype/cfg.dtype; int8 (explicit or
+    via cfg) allocates the per-(token, head) f32 scale planes too."""
+    dtype = dtype or _kv_dtype(cfg)
     hkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
     shape = (cfg.n_layers, batch, max_len, hkv, cfg.head_dim)
+    ks = vs = None
+    if _is_int8(dtype):
+        sshape = (cfg.n_layers, batch, hkv, max_len)
+        ks, vs = jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape,
+                                                           jnp.float32)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   length=jnp.zeros((), jnp.int32))
+                   length=jnp.zeros((), jnp.int32),
+                   k_scales=ks, v_scales=vs)
 
 
 def init_paged_cache(cfg: LlamaConfig, slots: int, n_pages: int,
                      page: int, max_pages: int, dtype=None) -> PagedKVCache:
     """n_pages POOL pages (row 0 reserved as trash) shared by `slots`
     slots of logical capacity max_pages * page tokens each."""
-    dtype = dtype or cfg.dtype
+    dtype = dtype or _kv_dtype(cfg)
     shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+    ks = vs = None
+    if _is_int8(dtype):
+        sshape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page)
+        ks, vs = jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape,
+                                                           jnp.float32)
     return PagedKVCache(
         k_pool=jnp.zeros(shape, dtype), v_pool=jnp.zeros(shape, dtype),
         tables=jnp.zeros((slots, max_pages), jnp.int32),
-        length=jnp.zeros((slots,), jnp.int32))
+        length=jnp.zeros((slots,), jnp.int32),
+        k_scales=ks, v_scales=vs)
 
 
 def _kernel_eligible(cfg: LlamaConfig) -> bool:
@@ -89,41 +132,63 @@ def _kernel_eligible(cfg: LlamaConfig) -> bool:
 
 
 def _paged_attention(q, k_pool, v_pool, cache_len, tables,
-                     cfg: LlamaConfig):
+                     cfg: LlamaConfig, k_scales=None, v_scales=None):
     """Paged-path attention: q [slots, T, Hq, D]; pools
     [n_pages, page, Hkv, D]; tables [slots, max_pages]. The pallas paged
     kernel indirects pool rows through the table; off-TPU the pages are
     gathered back to a contiguous per-slot cache and the XLA fallback
     runs (test/CPU path — gathering defeats paging's memory point, which
-    only matters where the kernel runs anyway)."""
+    only matters where the kernel runs anyway). k_scales/v_scales
+    ([n_pages, Hkv, page] f32) switch on the int8 cache: the kernel
+    dequantizes page tiles in VMEM, the fallback gathers the scale
+    pages through the same tables and dequantizes on read."""
     from container_engine_accelerators_tpu.ops import decode_attention as da
 
     if _kernel_eligible(cfg) and da.paged_supported(q, k_pool,
                                                     k_pool.shape[1]):
         interpret = jax.default_backend() != "tpu"
         return da.paged_decode_attention(q, k_pool, v_pool, cache_len,
-                                         tables, interpret=interpret)
+                                         tables, interpret=interpret,
+                                         k_scales=k_scales,
+                                         v_scales=v_scales)
     slots, max_pages = tables.shape
     n_pages, page, hkv, d = k_pool.shape
     k_c = k_pool[tables].reshape(slots, max_pages * page, hkv, d)
     v_c = v_pool[tables].reshape(slots, max_pages * page, hkv, d)
-    return _cached_attention(q, k_c, v_c, cache_len, cfg)
+    ks_c = vs_c = None
+    if k_scales is not None:
+        ks_c = k_scales[tables].transpose(0, 2, 1, 3).reshape(
+            slots, hkv, max_pages * page)
+        vs_c = v_scales[tables].transpose(0, 2, 1, 3).reshape(
+            slots, hkv, max_pages * page)
+    return _cached_attention(q, k_c, v_c, cache_len, cfg,
+                             k_scales=ks_c, v_scales=vs_c)
 
 
-def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
+def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig,
+                      k_scales=None, v_scales=None):
     """q: [B, T, Hq, D] for T new tokens at positions
     [cache_len, cache_len+T); caches: [B, max_len, Hkv, D].
 
     Routes to the pallas decode kernel (ops/decode_attention.py) when
     shapes allow: it streams the cache once in its native GQA layout
     instead of repeating KV heads and materialising [B, Hq, T, max_len]
-    logits — the difference dominates at long max_len."""
+    logits — the difference dominates at long max_len.
+
+    k_scales/v_scales ([B, Hkv, max_len] f32) mark an int8 cache. The
+    kernel fuses the dequant into its VMEM loads; this fallback
+    dequantizes on read with the SAME scale multiply, so kernel
+    eligibility can never change semantics — only speed."""
     from container_engine_accelerators_tpu.ops import decode_attention as da
 
     if _kernel_eligible(cfg) and da.supported(q, k_cache):
         interpret = jax.default_backend() != "tpu"
         return da.decode_attention(q, k_cache, v_cache, cache_len,
-                                   interpret=interpret)
+                                   interpret=interpret,
+                                   k_scales=k_scales, v_scales=v_scales)
+    if k_scales is not None:
+        k_cache = dequantize_kv(k_cache, k_scales, q.dtype)
+        v_cache = dequantize_kv(v_cache, v_scales, q.dtype)
     b, t, hq, d = q.shape
     max_len = k_cache.shape[1]
     n_rep = hq // k_cache.shape[2]
@@ -144,6 +209,9 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig):
     del max_len
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+_MOE_DECODE_SKEW_WARNED = False
 
 
 def _moe_ffn_decode(h2: jnp.ndarray, lp: dict, cfg: LlamaConfig,
@@ -180,6 +248,22 @@ def _moe_ffn_decode(h2: jnp.ndarray, lp: dict, cfg: LlamaConfig,
     """
     from container_engine_accelerators_tpu.models.moe import _gating
 
+    global _MOE_DECODE_SKEW_WARNED
+    if (not cfg.moe_dropless and cfg.moe_router == "token_choice"
+            and not _MOE_DECODE_SKEW_WARNED):
+        # Once per process, not per trace: serving a capacity-dropping
+        # training config through decode silently switches the routing
+        # semantics (decode ALWAYS computes dropless per-token top-k),
+        # so any train-time drops become train/serve skew.
+        _MOE_DECODE_SKEW_WARNED = True
+        import warnings
+        warnings.warn(
+            "decoding an n_experts config with moe_dropless=False: the "
+            "decode path always routes dropless per-token top-k, so "
+            "outputs match training only where training dropped no "
+            "tokens (capacity-factor cumsums cannot be reproduced "
+            "incrementally). Train with moe_dropless=True to make the "
+            "semantics identical.", stacklevel=2)
     if isinstance(lp["w_gate"], QuantWeight):
         # Fail at trace time with a clear message, not an AttributeError
         # deep in an engine worker thread (cli/serve.py also rejects the
@@ -245,6 +329,10 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     else:
         max_len = cache.k.shape[2]
     dt = cfg.dtype
+    # Int8 KV mode keys off the CACHE, not cfg: whoever allocated the
+    # cache (init_*_cache honoring cfg.kv_cache_dtype, or an explicit
+    # dtype override) decided, and a mismatch would corrupt silently.
+    quantized = _is_int8((cache.k_pool if paged else cache.k).dtype)
     per_slot = jnp.ndim(cache.length) > 0
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
     if per_slot:
@@ -294,35 +382,69 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             w_rows = jnp.where(active[:, None], w_rows, 0)
         w_offs = w_pos % page
 
-        def write(pool, new):
+        def write(pool, spool, new):
             hkv_d = new.shape[2:]
-            return pool.at[w_rows.reshape(-1), w_offs.reshape(-1)].set(
-                new.reshape(b * t, *hkv_d).astype(pool.dtype))
+            if not quantized:
+                return pool.at[w_rows.reshape(-1),
+                               w_offs.reshape(-1)].set(
+                    new.reshape(b * t, *hkv_d).astype(pool.dtype)), None
+            # Quantize the appended tokens and scatter values + scales
+            # through the same (row, offset) pairs — inactive slots'
+            # scales land in the trash row alongside their values.
+            q_vals, q_scales = quantize_kv(new)  # [B,T,h,d], [B,h,T]
+            pool = pool.at[w_rows.reshape(-1), w_offs.reshape(-1)].set(
+                q_vals.reshape(b * t, *hkv_d))
+            spool = spool.at[w_rows.reshape(-1), :,
+                             w_offs.reshape(-1)].set(
+                q_scales.transpose(0, 2, 1).reshape(b * t, -1))
+            return pool, spool
 
-        def attend(q, k_pool, v_pool):
+        def attend(q, k_pool, v_pool, ks, vs):
+            if quantized:
+                return _paged_attention(q, k_pool, v_pool, att_len,
+                                        cache.tables, cfg,
+                                        k_scales=ks, v_scales=vs)
             return _paged_attention(q, k_pool.astype(dt),
                                     v_pool.astype(dt), att_len,
                                     cache.tables, cfg)
     else:
-        def write(c, new):
+        def write(c, s, new):
+            if not quantized:
+                if per_slot:
+                    # Per-row scatter: row b's T new entries land at
+                    # row_len[b].
+                    return jax.vmap(
+                        lambda cb, nb, st: jax.lax.dynamic_update_slice(
+                            cb, nb.astype(cb.dtype), (st, 0, 0)))(
+                                c, new, row_len), None
+                return jax.lax.dynamic_update_slice(
+                    c, new.astype(c.dtype), (0, cache.length, 0, 0)), None
+            q_vals, q_scales = quantize_kv(new)  # [B,T,h,d], [B,h,T]
             if per_slot:
-                # Per-row scatter: row b's T new entries land at
-                # row_len[b].
-                return jax.vmap(
+                c = jax.vmap(
                     lambda cb, nb, st: jax.lax.dynamic_update_slice(
-                        cb, nb.astype(cb.dtype), (st, 0, 0)))(
-                            c, new, row_len)
-            return jax.lax.dynamic_update_slice(
-                c, new.astype(c.dtype), (0, cache.length, 0, 0))
+                        cb, nb, (st, 0, 0)))(c, q_vals, row_len)
+                s = jax.vmap(
+                    lambda sb, nb, st: jax.lax.dynamic_update_slice(
+                        sb, nb, (0, st)))(s, q_scales, row_len)
+            else:
+                c = jax.lax.dynamic_update_slice(
+                    c, q_vals, (0, cache.length, 0, 0))
+                s = jax.lax.dynamic_update_slice(
+                    s, q_scales, (0, 0, cache.length))
+            return c, s
 
-        def attend(q, k_cache, v_cache):
+        def attend(q, k_cache, v_cache, ks, vs):
+            if quantized:
+                return _cached_attention(q, k_cache, v_cache, att_len,
+                                         cfg, k_scales=ks, v_scales=vs)
             return _cached_attention(q, k_cache.astype(dt),
                                      v_cache.astype(dt), att_len, cfg)
 
     att_len = row_len if per_slot else cache.length
 
     def layer_body(x, scanned):
-        lp, k_cache_in, v_cache_in = scanned
+        lp, k_cache_in, v_cache_in, ks_in, vs_in = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         # Head counts come from the weights, not cfg: under tp the
         # column-sharded wq/wk/wv produce only this shard's heads.
@@ -331,9 +453,9 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         v = proj(h, lp["wv"]).reshape(b, t, -1, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
-        k_cache = write(k_cache_in, k)
-        v_cache = write(v_cache_in, v)
-        attn = attend(q.astype(dt), k_cache, v_cache)
+        k_cache, ks = write(k_cache_in, ks_in, k)
+        v_cache, vs = write(v_cache_in, vs_in, v)
+        attn = attend(q.astype(dt), k_cache, v_cache, ks, vs)
         x = x + proj(attn.reshape(b, t, -1), lp["wo"], reduce=True)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.n_experts:
@@ -342,13 +464,16 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             gate = jax.nn.silu(proj(h2, lp["w_gate"]))
             up = proj(h2, lp["w_up"])
             x = x + proj(gate * up, lp["w_down"], reduce=True)
-        return x, (k_cache, v_cache)
+        return x, (k_cache, v_cache, ks, vs)
 
     # Scan over layers with stacked params + stacked caches as xs — one
     # layer traced once regardless of depth, caches updated in place.
+    # Scale planes ride as extra xs; in bf16 mode they are None (empty
+    # pytrees), which scan passes through untouched.
     kv_in = ((cache.k_pool, cache.v_pool) if paged
              else (cache.k, cache.v))
-    x, (new_k, new_v) = jax.lax.scan(
+    kv_in = kv_in + (cache.k_scales, cache.v_scales)
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
         layer_body, x, (params["layers"],) + kv_in)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -376,9 +501,11 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             new_len = jnp.where(active, new_len, cache.length)
     if paged:
         new_cache = PagedKVCache(k_pool=new_k, v_pool=new_v,
-                                 tables=cache.tables, length=new_len)
+                                 tables=cache.tables, length=new_len,
+                                 k_scales=new_ks, v_scales=new_vs)
     else:
-        new_cache = KVCache(k=new_k, v=new_v, length=new_len)
+        new_cache = KVCache(k=new_k, v=new_v, length=new_len,
+                            k_scales=new_ks, v_scales=new_vs)
     return logits, new_cache
 
 
@@ -396,10 +523,8 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
 def init_slot_cache(cfg: LlamaConfig, slots: int, max_len: int,
                     dtype=None) -> KVCache:
     """KVCache with per-slot lengths ([slots] int32, all zero)."""
-    dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   length=jnp.zeros((slots,), jnp.int32))
+    cache = init_cache(cfg, slots, max_len, dtype=dtype)
+    return cache._replace(length=jnp.zeros((slots,), jnp.int32))
 
 
 def decode_step_slots(params: dict, cache: KVCache, tokens: jnp.ndarray,
@@ -429,18 +554,28 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
     Returns (logits of the last LIVE token [vocab] f32, updated cache).
     """
     tp = tokens.shape[0]
-    # Local-KV-head count derives from the PASSED cache, so the same code
-    # serves the replicated and tp-sharded (shard_map) paths.
-    tmp = init_cache(cfg, 1, tp, n_kv_heads=cache.k.shape[3])
+    # Local-KV-head count AND storage dtype derive from the PASSED
+    # cache, so the same code serves the replicated, tp-sharded, and
+    # int8-quantized paths (the temp cache quantizes its writes the
+    # same way the slot cache does).
+    tmp = init_cache(cfg, 1, tp, dtype=cache.k.dtype,
+                     n_kv_heads=cache.k.shape[3])
     logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
                               tp_axis=tp_axis)
     k = jax.lax.dynamic_update_slice(
         cache.k, tmp.k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(
         cache.v, tmp.v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    ks, vs = cache.k_scales, cache.v_scales
+    if ks is not None:
+        ks = jax.lax.dynamic_update_slice(ks, tmp.k_scales,
+                                          (0, slot, 0, 0))
+        vs = jax.lax.dynamic_update_slice(vs, tmp.v_scales,
+                                          (0, slot, 0, 0))
     length = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - 1]
-    return last, KVCache(k=k, v=v, length=length)
+    return last, KVCache(k=k, v=v, length=length,
+                         k_scales=ks, v_scales=vs)
 
 
 # ---------- paged KV (page-pool) API ----------
@@ -479,17 +614,31 @@ def prefill_suffix_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
                                (L, 1, max_len, hkv, d))
     v1 = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
                                (L, 1, max_len, hkv, d))
+    ks1 = vs1 = None
+    if cache.k_scales is not None:
+        ks1 = jax.lax.dynamic_slice(cache.k_scales, (0, slot, 0, 0),
+                                    (L, 1, hkv, max_len))
+        vs1 = jax.lax.dynamic_slice(cache.v_scales, (0, slot, 0, 0),
+                                    (L, 1, hkv, max_len))
     start = jnp.asarray(start, jnp.int32)
-    sub = KVCache(k=k1, v=v1, length=start.reshape(1))
+    sub = KVCache(k=k1, v=v1, length=start.reshape(1),
+                  k_scales=ks1, v_scales=vs1)
     logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg,
                               tp_axis=tp_axis)
     k = jax.lax.dynamic_update_slice(cache.k, sub.k.astype(cache.k.dtype),
                                      (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(cache.v, sub.v.astype(cache.v.dtype),
                                      (0, slot, 0, 0, 0))
+    ks, vs = cache.k_scales, cache.v_scales
+    if ks is not None:
+        ks = jax.lax.dynamic_update_slice(ks, sub.k_scales,
+                                          (0, slot, 0, 0))
+        vs = jax.lax.dynamic_update_slice(vs, sub.v_scales,
+                                          (0, slot, 0, 0))
     length = cache.length.at[slot].set(new_len)
     last = logits[0, jnp.maximum(new_len - start - 1, 0)]
-    return last, KVCache(k=k, v=v, length=length)
+    return last, KVCache(k=k, v=v, length=length,
+                         k_scales=ks, v_scales=vs)
 
 
 def decode_step_paged(params: dict, cache: PagedKVCache,
@@ -521,7 +670,8 @@ def prefill_slot_paged(params: dict, cache: PagedKVCache,
     page = cache.page
     n_pg = tp // page
     hkv = cache.k_pool.shape[3]   # local count under tp sharding
-    tmp = init_cache(cfg, 1, tp, n_kv_heads=hkv)
+    tmp = init_cache(cfg, 1, tp, dtype=cache.k_pool.dtype,
+                     n_kv_heads=hkv)
     logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
                               tp_axis=tp_axis)
     L = cache.k_pool.shape[0]
@@ -532,12 +682,22 @@ def prefill_slot_paged(params: dict, cache: PagedKVCache,
         k_pages.astype(cache.k_pool.dtype))
     v_pool = cache.v_pool.at[:, rows].set(
         v_pages.astype(cache.v_pool.dtype))
+    ks, vs = cache.k_scales, cache.v_scales
+    if ks is not None:
+        # tmp scales [L, 1, hkv, tp] -> per-page [L, n_pg, hkv, page].
+        k_sp = tmp.k_scales.reshape(L, hkv, n_pg, page).transpose(
+            0, 2, 1, 3)
+        v_sp = tmp.v_scales.reshape(L, hkv, n_pg, page).transpose(
+            0, 2, 1, 3)
+        ks = ks.at[:, rows].set(k_sp)
+        vs = vs.at[:, rows].set(v_sp)
     tables = jax.lax.dynamic_update_slice(
         cache.tables, rows[None, :].astype(jnp.int32), (slot, 0))
     length = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - 1]
     return last, PagedKVCache(k_pool=k_pool, v_pool=v_pool,
-                              tables=tables, length=length)
+                              tables=tables, length=length,
+                              k_scales=ks, v_scales=vs)
 
 
 def set_slot_pages(cache: PagedKVCache, slot: jnp.ndarray,
@@ -571,17 +731,21 @@ def prefill_suffix_paged(params: dict, cache: PagedKVCache,
     executables key on the static Ts bucket (slot/lengths traced)."""
     max_pages = cache.tables.shape[1]
     # b=1 view of the slot: pools are shared (writes scatter into pool
-    # rows), so running decode_step on the view fills the real cache.
+    # rows — scale pools included), so running decode_step on the view
+    # fills the real cache.
     tab1 = jax.lax.dynamic_slice(cache.tables, (slot, 0), (1, max_pages))
     len1 = jax.lax.dynamic_slice(cache.length, (slot,), (1,))
     sub = PagedKVCache(k_pool=cache.k_pool, v_pool=cache.v_pool,
-                       tables=tab1, length=len1)
+                       tables=tab1, length=len1,
+                       k_scales=cache.k_scales, v_scales=cache.v_scales)
     logits, sub = decode_step(params, sub, suffix_tokens[None, :], cfg,
                               tp_axis=tp_axis)
     length = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - len1[0] - 1]
     return last, PagedKVCache(k_pool=sub.k_pool, v_pool=sub.v_pool,
-                              tables=cache.tables, length=length)
+                              tables=cache.tables, length=length,
+                              k_scales=sub.k_scales,
+                              v_scales=sub.v_scales)
 
 
 def assign_pages(cache: PagedKVCache, page_pos: jnp.ndarray,

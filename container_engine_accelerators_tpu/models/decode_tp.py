@@ -47,6 +47,13 @@ from container_engine_accelerators_tpu.models.llama import LlamaConfig
 
 TP_AXIS = "tp"
 
+# jax >= 0.5 exposes shard_map at the top level; 0.4.x keeps it in
+# experimental. Resolve once so _smap works on both.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def validate_tp(cfg: LlamaConfig, tp: int) -> None:
     if tp <= 1:
@@ -111,17 +118,24 @@ def decode_param_specs(cfg: LlamaConfig | None = None,
     }
 
 
-def cache_specs(paged: bool, scalar_len: bool = False):
+def cache_specs(paged: bool, scalar_len: bool = False,
+                quantized: bool = False):
     """Cache PartitionSpecs: KV-head axis over tp, host-visible state
-    (lengths, block tables) replicated."""
+    (lengths, block tables) replicated. Int8 caches (`quantized`) add
+    per-(token, head) scale planes sharded on the SAME KV-head axis as
+    the values they scale — each chip dequantizes only its local heads;
+    bf16 caches carry None there (empty pytrees, matching the cache)."""
+    sc = P(None, None, TP_AXIS, None) if quantized else None
     if paged:
         return PagedKVCache(
             k_pool=P(None, None, None, TP_AXIS, None),
             v_pool=P(None, None, None, TP_AXIS, None),
-            tables=P(None, None), length=P(None))
+            tables=P(None, None), length=P(None),
+            k_scales=sc, v_scales=sc)
     return KVCache(k=P(None, None, None, TP_AXIS, None),
                    v=P(None, None, None, TP_AXIS, None),
-                   length=P() if scalar_len else P(None))
+                   length=P() if scalar_len else P(None),
+                   k_scales=sc, v_scales=sc)
 
 
 def shard_decode_params(params: dict, mesh: Mesh,
@@ -139,7 +153,8 @@ def shard_decode_params(params: dict, mesh: Mesh,
 def _cache_shardings(sample, mesh: Mesh):
     paged = isinstance(sample, PagedKVCache)
     scalar = (not paged) and sample.length.ndim == 0
-    specs = cache_specs(paged, scalar_len=scalar)
+    specs = cache_specs(paged, scalar_len=scalar,
+                        quantized=sample.k_scales is not None)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
@@ -166,8 +181,15 @@ def _smap(fn, mesh, in_specs, out_specs):
     # check_vma=False: the pallas decode kernels have no replication
     # rule, and the replication invariants here are by construction
     # (psum/all_gather before every replicated output).
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    import inspect
+    kw = {}
+    sig = inspect.signature(_shard_map)
+    if "check_vma" in sig.parameters:
+        kw["check_vma"] = False
+    elif "check_rep" in sig.parameters:   # the 0.4.x spelling
+        kw["check_rep"] = False
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
 
 
 @functools.lru_cache(maxsize=32)
@@ -176,7 +198,8 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
     (generate()'s step): (params, cache, tokens[B,T]) -> (logits, cache)."""
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=False, scalar_len=True)
+    cspecs = cache_specs(paged=False, scalar_len=True,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(decode_step, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -189,7 +212,8 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
 def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=False)
+    cspecs = cache_specs(paged=False,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(decode_step_slots, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -202,7 +226,8 @@ def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
 def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=False)
+    cspecs = cache_specs(paged=False,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(prefill_slot, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -215,7 +240,8 @@ def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
 def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=False)
+    cspecs = cache_specs(paged=False,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(prefill_suffix_slot, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -228,7 +254,8 @@ def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
 def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=True)
+    cspecs = cache_specs(paged=True,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(decode_step_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -241,7 +268,8 @@ def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
 def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=True)
+    cspecs = cache_specs(paged=True,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(prefill_slot_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -254,7 +282,8 @@ def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
 def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
     validate_tp(cfg, mesh.shape[TP_AXIS])
     pspecs = decode_param_specs(cfg)
-    cspecs = cache_specs(paged=True)
+    cspecs = cache_specs(paged=True,
+                         quantized=cfg.kv_cache_dtype == "int8")
     fn = _smap(
         functools.partial(prefill_suffix_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,

@@ -56,6 +56,13 @@ class LlamaConfig:
     # only block scheduling, halves causal K/V DMA traffic
     # (ops/flash_attention.py DEFAULT_CAUSAL_GRID notes).
     flash_causal_grid: str = "rect"
+    # KV-cache storage dtype on the DECODE path (models/decode.py):
+    # 'bf16' stores the cache in cfg.dtype; 'int8' stores K/V as int8
+    # with per-(token, head) f32 scales (ops/quant.quantize_kv) and
+    # dequantizes inside the decode kernels — roughly halves the
+    # decode-step cache HBM traffic and doubles the slots that fit
+    # (--kv-dtype on cli/serve.py; tools/hbm_plan.py prices it).
+    kv_cache_dtype: str = "bf16"
     # Sequence/context parallelism over the 'sp' mesh axis; enabled by
     # the training layer when the mesh has sp > 1. Mode 'ring' rotates
     # KV blocks via ppermute (parallel/ring_attention.py); 'ulysses'
@@ -416,6 +423,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         raise ValueError(
             f"flash_causal_grid must be 'rect' or 'tri', got "
             f"{cfg.flash_causal_grid!r}")
+    if cfg.kv_cache_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"kv_cache_dtype must be 'bf16' or 'int8', got "
+            f"{cfg.kv_cache_dtype!r}")
     if (cfg.flash_causal_grid == "tri" and cfg.sequence_parallel
             and cfg.sequence_parallel_mode == "ring"):
         # Ring attention never reaches the flash causal grid (it runs

@@ -17,7 +17,11 @@ and the intermediate footprint by G. This kernel:
     the block start vs cache_len + T, the same predication the training
     kernel uses for causal blocks);
   - masks by absolute position inside the boundary block: query i at
-    position cache_len + i sees key positions <= cache_len + i.
+    position cache_len + i sees key positions <= cache_len + i;
+  - optionally reads an INT8 cache (ops/quant.quantize_kv layout) and
+    dequantizes in VMEM: K/V tiles stream from HBM as int8 plus one f32
+    scale per (token, head) — roughly half the bf16 cache traffic — and
+    the online-softmax state stays f32 exactly as in the bf16 path.
 
 Rows are the T*G queries of one KV-head group, padded to the f32
 sublane multiple; the kernel computes in f32 throughout (the MXU is
@@ -103,9 +107,16 @@ def _pick_block(requested: int, s: int) -> int:
     return block
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
-                   *, scale: float, block_k: int, t: int, g: int,
-                   hkv: int):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
+                   scale: float, block_k: int, t: int, g: int,
+                   hkv: int, quant: bool = False):
+    if quant:
+        # Int8 cache: two extra VMEM inputs carry the per-(token, head)
+        # f32 scales, tiled head-major so positions ride the lane axis.
+        sk_ref, sv_ref, o_ref, acc, m_scr, l_scr = refs
+    else:
+        sk_ref = sv_ref = None
+        o_ref, acc, m_scr, l_scr = refs
     ki = pl.program_id(1)
     num_k = pl.num_programs(1)
     cache_len = len_ref[pl.program_id(0)]  # per-batch-row live length
@@ -130,6 +141,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
             q = q_ref[0, h, :, :].astype(jnp.float32)    # [rows, d]
             k = k_ref[0, :, h, :].astype(jnp.float32)    # [bk, d]
             v = v_ref[0, :, h, :].astype(jnp.float32)
+            if quant:
+                # Fused dequant: one f32 scale per cache position of
+                # this head, broadcast over D. Dead positions may hold
+                # zero scales (fresh cache) or stale ones — both finite
+                # (int8 payloads cannot be NaN), and the position mask
+                # below discards them either way.
+                k = k * sk_ref[0, h, :][:, None]
+                v = v * sv_ref[0, h, :][:, None]
             # Zero dead V rows: their probabilities are exactly 0, but
             # 0 * garbage = NaN if a dead cache slot holds non-finite
             # data (donated buffers make no content promises there).
@@ -170,21 +189,31 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
 
 def decode_attention(q, k_cache, v_cache, cache_len,
                      block_k: int = DEFAULT_BLOCK_K,
-                     interpret: bool = False):
+                     interpret: bool = False,
+                     k_scales=None, v_scales=None):
     """q: [B, T, Hq, D] new-token queries at positions
     [cache_len, cache_len + T); k_cache/v_cache: [B, max_len, Hkv, D]
     with the new tokens already written. Returns [B, T, Hq, D].
 
     cache_len may be a scalar (shared live length, the classic batched
     path) or a [B] vector (per-slot lengths — the continuous-batching
-    serving path, where every slot is at a different position)."""
+    serving path, where every slot is at a different position).
+
+    k_scales/v_scales ([B, Hkv, max_len] f32, ops/quant.quantize_kv
+    layout) switch on the int8 path: the caches stream as int8 and the
+    kernel dequantizes each tile in VMEM right after the DMA."""
     b, t, hq, d = q.shape
     max_len, hkv = k_cache.shape[1], k_cache.shape[2]
     g = hq // hkv
+    quant = k_scales is not None
     block_k = max(128, block_k // 128 * 128)  # lane-tile multiple
     # K + V tiles, double-buffered, must fit the scoped-VMEM budget:
-    # 2 (k,v) x 2 (buffers) x block_k x hkv x d x itemsize.
+    # 2 (k,v) x 2 (buffers) x block_k x hkv x d x itemsize — int8
+    # halves this, so the cap (and the elidable-DMA block) doubles.
+    # The scale tiles add 2 x 2 x hkv x 4 f32 bytes per position.
     per_row = 4 * hkv * d * k_cache.dtype.itemsize
+    if quant:
+        per_row += 16 * hkv
     cap = max(128, _VMEM_TILE_BUDGET // per_row // 128 * 128)
     block_k = _pick_block(min(block_k, cap), max_len)
     rows = _query_rows(t, g)
@@ -202,19 +231,32 @@ def decode_attention(q, k_cache, v_cache, cache_len,
         last_live = (len_ref[bi] + t - 1) // block_k
         return (bi, jnp.minimum(ki, last_live), 0, 0)
 
+    def scale_map(bi, ki, len_ref):
+        last_live = (len_ref[bi] + t - 1) // block_k
+        return (bi, 0, jnp.minimum(ki, last_live))
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, rows, d),
+                     lambda bi, ki, len_ref: (bi, 0, 0, 0)),
+        # K/V tiled in the cache's native layout: the trailing
+        # (hkv, d) block dims equal the array dims, which satisfies
+        # Mosaic's last-two-dims tiling rule without transposing the
+        # cache.
+        pl.BlockSpec((1, block_k, hkv, d), kv_map),
+        pl.BlockSpec((1, block_k, hkv, d), kv_map),
+    ]
+    args = [len_arr, qg, k_cache, v_cache]
+    if quant:
+        # Head-major scales put positions on the lane axis, so the
+        # (hkv, block_k) trailing dims tile like any other operand.
+        in_specs += [pl.BlockSpec((1, hkv, block_k), scale_map),
+                     pl.BlockSpec((1, hkv, block_k), scale_map)]
+        args += [k_scales, v_scales]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, max_len // block_k),
-        in_specs=[
-            pl.BlockSpec((1, hkv, rows, d),
-                         lambda bi, ki, len_ref: (bi, 0, 0, 0)),
-            # K/V tiled in the cache's native layout: the trailing
-            # (hkv, d) block dims equal the array dims, which satisfies
-            # Mosaic's last-two-dims tiling rule without transposing the
-            # cache.
-            pl.BlockSpec((1, block_k, hkv, d), kv_map),
-            pl.BlockSpec((1, block_k, hkv, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, rows, d),
                                lambda bi, ki, len_ref: (bi, 0, 0, 0)),
         scratch_shapes=_scratch_shapes(hkv, rows, d),
@@ -222,11 +264,12 @@ def decode_attention(q, k_cache, v_cache, cache_len,
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=d ** -0.5,
-                          block_k=block_k, t=t, g=g, hkv=hkv),
+                          block_k=block_k, t=t, g=g, hkv=hkv,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         interpret=interpret,
-    )(len_arr, qg, k_cache, v_cache)
+    )(*args)
 
     return _ungroup_output(out, t, g)
 
@@ -241,7 +284,8 @@ def paged_supported(q, k_pool, page: int) -> bool:
 
 
 def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           k_scales=None, v_scales=None):
     """Paged variant: the cache lives in a shared page pool and each
     slot's logical sequence is scattered across pool rows by its block
     table (vLLM-style paging, done the TPU way: the table is a second
@@ -256,11 +300,17 @@ def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
               entries past the live pages may be garbage — the index map
               clamps to the last live page and the kernel masks by
               position. Returns [slots, T, Hq, D].
+
+    k_scales/v_scales ([n_pages, Hkv, page] f32) switch on the int8
+    path: scales live in their own pool indexed by the SAME tables, so
+    the page indirection covers them for free and the kernel dequantizes
+    each page tile in VMEM.
     """
     b, t, hq, d = q.shape
     n_pages, page, hkv, _ = k_pool.shape
     max_pages = tables.shape[1]
     g = hq // hkv
+    quant = k_scales is not None
     rows = _query_rows(t, g)
     qg = _group_queries(q, hkv, g, rows)
 
@@ -279,37 +329,48 @@ def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
         row = tab_ref[bi, jnp.minimum(ki, last_live)]
         return (jnp.clip(row, 0, n_pages - 1), 0, 0, 0)
 
+    def scale_map(bi, ki, len_ref, tab_ref):
+        last_live = (len_ref[bi] + t - 1) // page
+        row = tab_ref[bi, jnp.minimum(ki, last_live)]
+        return (jnp.clip(row, 0, n_pages - 1), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, rows, d),
+                     lambda bi, ki, len_ref, tab_ref: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, page, hkv, d), kv_map),
+        pl.BlockSpec((1, page, hkv, d), kv_map),
+    ]
+    args = [len_arr, tab_arr, qg, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, hkv, page), scale_map),
+                     pl.BlockSpec((1, hkv, page), scale_map)]
+        args += [k_scales, v_scales]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, hkv, rows, d),
-                         lambda bi, ki, len_ref, tab_ref: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, page, hkv, d), kv_map),
-            pl.BlockSpec((1, page, hkv, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, rows, d),
                                lambda bi, ki, len_ref, tab_ref:
                                (bi, 0, 0, 0)),
         scratch_shapes=_scratch_shapes(hkv, rows, d),
     )
 
-    def paged_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
-                     acc, m_scr, l_scr):
+    def paged_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, *refs):
         # The contiguous kernel body runs unmodified: its per-grid-step
         # K/V block is one page, its k_start (ki * block_k) is the
         # LOGICAL page start, and its masking/online-softmax are all
         # position-based — paging only changes where the bytes come
         # from, which the index map above fully encapsulates.
-        _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                       acc, m_scr, l_scr, scale=d ** -0.5, block_k=page,
-                       t=t, g=g, hkv=hkv)
+        _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
+                       scale=d ** -0.5, block_k=page,
+                       t=t, g=g, hkv=hkv, quant=quant)
 
     out = pl.pallas_call(
         paged_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         interpret=interpret,
-    )(len_arr, tab_arr, qg, k_pool, v_pool)
+    )(*args)
 
     return _ungroup_output(out, t, g)

@@ -175,6 +175,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref, lse_ref,
 
 
 def _use_tri(causal, causal_grid, block_q, block_k) -> bool:
+    """The triangular causal grid needs square blocks; when block_q !=
+    block_k (after _pick_block's divisibility adjustment) the rect
+    schedule runs instead. That fallback is CORRECT but loses tri's
+    halved causal K/V traffic, so it must not be silent — a benchmark
+    or prod config asking for 'tri' would otherwise measure rect and
+    attribute the number to tri (the same guard strength llama.py
+    applies to the ring-attention conflict, which raises)."""
+    if causal and causal_grid == "tri" and block_q != block_k:
+        import warnings
+        warnings.warn(
+            f"flash_causal_grid='tri' requires equal q/k blocks but "
+            f"block_q={block_q} != block_k={block_k} (after sequence-"
+            f"divisibility picking): falling back to the rect schedule "
+            "— tri's halved causal K/V DMA traffic is NOT in effect. "
+            "Pass equal block_q/block_k (or a sequence length both "
+            "divide) to engage it.", stacklevel=3)
     return causal and causal_grid == "tri" and block_q == block_k
 
 

@@ -1,14 +1,21 @@
-"""Int8 weight quantization for the decode path.
+"""Int8 quantization for the decode path: weights AND the KV cache.
 
-Incremental decoding is HBM-bandwidth-bound on weight reads (one token's
-matmuls stream every parameter); per-channel symmetric int8 halves the
-bytes vs bf16 for <0.5% logit drift on Llama-family weights. The matmul
-keeps bf16 activations and dequantizes the int8 block inside the pallas
-kernel right after its VMEM load, so HBM only ever sees int8.
+Incremental decoding is HBM-bandwidth-bound on two streams — weight
+reads (one token's matmuls stream every parameter) and the KV cache
+(every decode step streams the whole live cache once). Symmetric int8
+halves the bytes of either stream vs bf16; dequantization happens
+inside the pallas kernels right after the VMEM load, so HBM only ever
+sees int8.
 
+Weights (per output channel):
   q, scales = quantize_weights(w)           # [D,F] -> int8 [D,F], f32 [F]
   y = int8_matmul(x, q, scales)             # [T,D]@[D,F] -> bf16 [T,F]
   qparams = quantize_llama_params(params)   # whole-model convenience
+
+KV cache (per token per KV head; consumed by ops/decode_attention's
+fused-dequant path and models/decode's quantized cache writes):
+  q, scales = quantize_kv(kv)               # [...,T,H,D] -> int8 + f32
+  kv = dequantize_kv(q, scales)             # exact inverse structure
 """
 
 from __future__ import annotations
@@ -41,6 +48,37 @@ def quantize_weights(w: jnp.ndarray) -> QuantWeight:
 def dequantize(qw: QuantWeight, dtype=jnp.bfloat16) -> jnp.ndarray:
     return (qw.values.astype(jnp.float32)
             * qw.scales[..., None, :]).astype(dtype)
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-(token, KV-head) int8 for KV-cache tiles.
+
+    x: [..., T, Hkv, D] -> (int8 values [..., T, Hkv, D],
+                            f32 scales [..., Hkv, T]).
+
+    The scale granularity is one token per head (absmax over D only):
+    appended decode tokens quantize independently — no read-modify-write
+    of neighbor tokens, no clipping risk when a later token's absmax
+    exceeds an earlier block's — at 4 scale bytes per 128 int8 payload
+    bytes (~3% overhead at head_dim 128). Scales come back HEAD-major
+    ([..., Hkv, T]) so the decode kernels can tile them (1, Hkv, block)
+    with positions on the 128-lane axis."""
+    x_f = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x_f), axis=-1)            # [..., T, Hkv]
+    scales = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x_f / scales[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, jnp.swapaxes(scales, -1, -2)
+
+
+def dequantize_kv(q: jnp.ndarray, scales: jnp.ndarray,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of quantize_kv: q [..., T, Hkv, D] int8 + head-major
+    scales [..., Hkv, T] -> [..., T, Hkv, D] in `dtype`. This is the
+    XLA-fallback dequant-on-read; the pallas decode kernels apply the
+    same scale multiply in VMEM instead."""
+    return (q.astype(jnp.float32)
+            * jnp.swapaxes(scales, -1, -2)[..., None]).astype(dtype)
 
 
 def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, *, block_f: int):

@@ -130,18 +130,33 @@ class CheckpointManager:
 
         abstract = jax.tree.map(to_abstract, state_like._asdict())
         step_dir = os.path.join(self._dir, str(step))
-        if os.path.isdir(os.path.join(step_dir, "state")):
-            restored = self._mngr.restore(
-                step, args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(abstract),
-                    layout=ocp.args.JsonRestore(),
-                ))
-            tree, saved_layout = restored["state"], restored["layout"]
-        else:
-            # Pre-tag checkpoint (bare StandardSave): depth order.
-            tree = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
-            saved_layout = dict(_DEPTH_ORDER)
+        try:
+            if os.path.isdir(os.path.join(step_dir, "state")):
+                restored = self._mngr.restore(
+                    step, args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        layout=ocp.args.JsonRestore(),
+                    ))
+                tree, saved_layout = restored["state"], restored["layout"]
+            else:
+                # Pre-tag checkpoint (bare StandardSave): depth order.
+                tree = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(abstract))
+                saved_layout = dict(_DEPTH_ORDER)
+        except (KeyError, ValueError, TypeError) as e:
+            # The dominant cause of a tree-structure mismatch here is
+            # the round-5 optimizer swap: fused_adamw's state is one
+            # FusedAdamWState namedtuple, the legacy optax chain's is a
+            # nested (clip, adamw, ...) tuple. Orbax's raw error names
+            # neither — point at the actual knob.
+            raise ValueError(
+                f"checkpoint step {step} in {self._dir} does not match "
+                "the target TrainState structure. If this checkpoint "
+                "was written by the legacy optax chain (pre-fused "
+                "optimizer), rebuild the train state with "
+                "make_optimizer(fused=False) so the optimizer state "
+                "layouts agree (training/train.py make_optimizer "
+                "docstring), then restore again.") from e
 
         if normalize_layout(saved_layout) != normalize_layout(layout):
             tree = _relayout_state_tree(tree, saved_layout, layout)

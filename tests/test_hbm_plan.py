@@ -84,8 +84,25 @@ def test_moe_and_pp_shard_factors():
 
 def test_shipped_plans_all_resolve():
     plans = shipped_plans()
-    assert len(plans) == 5
-    assert [p["fits"] for p in plans] == [True, True, True, True, False]
+    assert len(plans) == 6
+    assert [p["fits"] for p in plans] == [True, True, True, True, True,
+                                          False]
+
+
+def test_int8_kv_doubles_slots_in_same_pool_bytes():
+    """The --kv-dtype int8 pricing: 16 int8-KV slots cost about what 8
+    bf16 slots cost (1 payload byte + one f32 scale per (token, head)
+    vs 2 bytes per element), and the int8 plan reports its dtype."""
+    cfg = llama.LlamaConfig()
+    bf16 = plan_serving(cfg, tp=4, max_slots=8, max_len=4096,
+                        chip="v5e")
+    int8 = plan_serving(cfg, tp=4, max_slots=16, max_len=4096,
+                        chip="v5e", kv_dtype="int8")
+    assert bf16["kv_dtype"] == "bf16" and int8["kv_dtype"] == "int8"
+    assert int8["fits"]
+    # 2x slots at (1 + 4/128)/2 = 0.516x per-token bytes ≈ 1.03x pool.
+    assert int8["kv_pool_gb"] == pytest.approx(
+        bf16["kv_pool_gb"] * 2 * (128 + 4) / 256, rel=0.02)
 
 
 @pytest.mark.parametrize("chip", ["v5e", "v5p"])

@@ -1,0 +1,221 @@
+"""Int8 KV cache: quantize_kv round-trip bounds, fused-dequant kernel
+parity against the dequantized reference (contiguous AND paged), greedy
+token-identity bf16-vs-int8 across the generate/slot/paged engines, and
+a bounded logit error for long prompts."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models.decode import (
+    decode_step,
+    decode_step_paged,
+    decode_step_slots,
+    generate,
+    init_cache,
+    init_paged_cache,
+    init_slot_cache,
+    prefill_slot,
+    prefill_slot_paged,
+)
+from container_engine_accelerators_tpu.ops.decode_attention import (
+    decode_attention,
+    paged_decode_attention,
+)
+from container_engine_accelerators_tpu.ops.quant import (
+    dequantize_kv,
+    quantize_kv,
+)
+
+CFG = llama_tiny(dtype=jnp.float32, n_layers=2)
+CFG_INT8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+
+
+# ---------- quantize_kv round trip ----------
+
+def test_quantize_kv_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.key(0), (2, 16, 4, 32)) * 3.0
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert s.dtype == jnp.float32 and s.shape == (2, 4, 16)  # head-major
+    back = dequantize_kv(q, s)
+    # Symmetric absmax/127: error <= scale/2 per entry, per (tok, head).
+    bound = np.swapaxes(np.asarray(s), -1, -2)[..., None] * 0.51
+    assert np.all(np.abs(np.asarray(back) - np.asarray(x)) <= bound)
+
+
+def test_quantize_kv_zero_input_stays_finite():
+    x = jnp.zeros((1, 8, 2, 16))
+    q, s = quantize_kv(x)
+    back = dequantize_kv(q, s)
+    assert np.all(np.asarray(back) == 0.0)
+    assert np.all(np.isfinite(np.asarray(s)))
+
+
+def test_quantize_kv_per_token_scales_are_independent():
+    # A huge token must not crush a small token's precision (scales are
+    # per token per head, not per block — the append-path guarantee).
+    x = jnp.ones((1, 2, 1, 8)).at[0, 1].mul(1000.0)
+    back = dequantize_kv(*quantize_kv(x))
+    np.testing.assert_allclose(np.asarray(back[0, 0]), 1.0, rtol=0.01)
+    np.testing.assert_allclose(np.asarray(back[0, 1]), 1000.0, rtol=0.01)
+
+
+# ---------- fused-dequant kernel parity ----------
+
+def _reference(q, k_cache, v_cache, cache_len):
+    b, t, hq, d = q.shape
+    hkv = k_cache.shape[2]
+    k = jnp.repeat(k_cache, hq // hkv, axis=2)
+    v = jnp.repeat(v_cache, hq // hkv, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * d ** -0.5
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+    query_pos = cache_len + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 2)
+    logits = jnp.where(key_pos <= query_pos, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@pytest.mark.parametrize("t,cache_len", [(1, 0), (1, 100), (5, 249)])
+def test_kernel_fused_dequant_matches_dequantized_reference(t, cache_len):
+    b, hq, hkv, d, max_len = 2, 8, 2, 128, 256
+    kq, kk, kv = jax.random.split(jax.random.key(cache_len + t), 3)
+    q = jax.random.normal(kq, (b, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (b, max_len, hkv, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (b, max_len, hkv, d), jnp.float32)
+    qk, sk = quantize_kv(k_cache)
+    qv, sv = quantize_kv(v_cache)
+
+    got = decode_attention(q, qk, qv, jnp.int32(cache_len),
+                           interpret=True, k_scales=sk, v_scales=sv)
+    # The fused path must match dequant-then-attend EXACTLY in
+    # structure: the reference here runs on the dequantized cache, so
+    # the tolerance covers only accumulation order, not quantization.
+    want = _reference(q, dequantize_kv(qk, sk), dequantize_kv(qv, sv),
+                      jnp.int32(cache_len))
+    np.testing.assert_allclose(jax.device_get(got), jax.device_get(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_fused_dequant_matches_contiguous():
+    slots, t, hq, hkv, d = 2, 1, 8, 2, 128
+    page, n_pages, max_pages = 128, 9, 4
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (slots, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (slots, max_pages * page, hkv, d),
+                                jnp.float32)
+    v_cache = jax.random.normal(kv, (slots, max_pages * page, hkv, d),
+                                jnp.float32)
+    qk, sk = quantize_kv(k_cache)
+    qv, sv = quantize_kv(v_cache)
+    lengths = jnp.asarray([130, 250], jnp.int32)
+
+    # Scatter the quantized pages AND their scale pages over a shuffled
+    # pool; garbage table entries past the live pages are tolerated.
+    tables = np.full((slots, max_pages), 7, np.int32)
+    k_pool = np.zeros((n_pages, page, hkv, d), np.int8)
+    v_pool = np.zeros((n_pages, page, hkv, d), np.int8)
+    ks_pool = np.zeros((n_pages, hkv, page), np.float32)
+    vs_pool = np.zeros((n_pages, hkv, page), np.float32)
+    free = list(range(1, n_pages))
+    for s in range(slots):
+        for p in range(-(-int(lengths[s] + t) // page)):
+            tables[s, p] = free.pop()
+            sl = slice(p * page, (p + 1) * page)
+            k_pool[tables[s, p]] = np.asarray(qk)[s, sl]
+            v_pool[tables[s, p]] = np.asarray(qv)[s, sl]
+            ks_pool[tables[s, p]] = np.asarray(sk)[s, :, sl]
+            vs_pool[tables[s, p]] = np.asarray(sv)[s, :, sl]
+
+    ref = decode_attention(q, qk, qv, lengths, interpret=True,
+                           k_scales=sk, v_scales=sv)
+    got = paged_decode_attention(
+        q, jnp.asarray(k_pool), jnp.asarray(v_pool), lengths,
+        jnp.asarray(tables), interpret=True,
+        k_scales=jnp.asarray(ks_pool), v_scales=jnp.asarray(vs_pool))
+    np.testing.assert_allclose(jax.device_get(got), jax.device_get(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------- engine-level parity ----------
+
+@pytest.fixture(scope="module")
+def model():
+    return init_params(jax.random.key(0), CFG)
+
+
+def test_generate_greedy_token_identity(model):
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    out_bf16 = generate(model, prompt, CFG, max_new_tokens=8)
+    out_int8 = generate(model, prompt, CFG_INT8, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_bf16),
+                                  np.asarray(out_int8))
+
+
+def _slot_tokens(params, cfg, prompt, n_new):
+    cache = init_slot_cache(cfg, 2, 64)
+    assert (cache.k.dtype == jnp.int8) == (cfg.kv_cache_dtype == "int8")
+    padded = prompt + [0] * (8 - len(prompt))
+    last, cache = prefill_slot(params, cache, jnp.int32(0),
+                               jnp.asarray(padded, jnp.int32),
+                               jnp.int32(len(prompt)), cfg)
+    toks = [int(jnp.argmax(last))]
+    active = jnp.asarray([True, False])
+    for _ in range(n_new - 1):
+        cur = jnp.asarray([toks[-1], 0], jnp.int32)
+        logits, cache = decode_step_slots(params, cache, cur, active, cfg)
+        toks.append(int(jnp.argmax(logits[0])))
+    return toks
+
+
+def test_slot_engine_greedy_token_identity(model):
+    bf16 = _slot_tokens(model, CFG, [1, 2, 3], 6)
+    int8 = _slot_tokens(model, CFG_INT8, [1, 2, 3], 6)
+    assert bf16 == int8
+
+
+def _paged_tokens(params, cfg, prompt, n_new):
+    page, max_pages, n_pages = 128, 2, 8
+    cache = init_paged_cache(cfg, 2, n_pages, page, max_pages)
+    assert (cache.k_scales is not None) == (cfg.kv_cache_dtype == "int8")
+    tokens = jnp.zeros((page,), jnp.int32)
+    for i, tk in enumerate(prompt):
+        tokens = tokens.at[i].set(tk)
+    last, cache = prefill_slot_paged(
+        params, cache, jnp.int32(0), jnp.asarray([1], jnp.int32),
+        tokens, jnp.int32(len(prompt)), cfg)
+    toks = [int(jnp.argmax(last))]
+    active = jnp.asarray([True, False])
+    for _ in range(n_new - 1):
+        cur = jnp.asarray([toks[-1], 0], jnp.int32)
+        logits, cache = decode_step_paged(params, cache, cur, active, cfg)
+        toks.append(int(jnp.argmax(logits[0])))
+    return toks
+
+
+def test_paged_engine_greedy_token_identity(model):
+    bf16 = _paged_tokens(model, CFG, [1, 2, 3], 6)
+    int8 = _paged_tokens(model, CFG_INT8, [1, 2, 3], 6)
+    assert bf16 == int8
+    # And the three engines agree with each other on the same dtype.
+    assert bf16 == _slot_tokens(model, CFG, [1, 2, 3], 6)
+
+
+def test_long_prompt_logit_error_bounded(model):
+    """Long prefills accumulate quantization error across every cached
+    token; the claim is not token identity but a bounded drift."""
+    prompt = jax.random.randint(jax.random.key(5), (1, 96), 0,
+                                CFG.vocab_size)
+    cache_bf = init_cache(CFG, 1, 128)
+    cache_i8 = init_cache(CFG_INT8, 1, 128)
+    logits_bf, _ = decode_step(model, cache_bf, prompt, CFG)
+    logits_i8, _ = decode_step(model, cache_i8, prompt, CFG_INT8)
+    mse = float(jnp.mean((logits_bf - logits_i8) ** 2))
+    ref = float(jnp.mean(logits_bf ** 2))
+    assert mse < 1e-3 * max(ref, 1.0), (mse, ref)

@@ -1,0 +1,46 @@
+"""serve_bench harness invariants: the paged bench must measure truly
+distinct page tables (no trash-row aliasing — ADVICE r5), and the tiny
+smoke run must emit one JSON line per (engine, kv_dtype) combination."""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+from tools.serve_bench import build_page_tables, main  # noqa: E402
+
+
+def test_page_tables_are_distinct():
+    tables, n_pages = build_page_tables(4, 6)
+    assert tables.shape == (4, 6)
+    flat = tables.reshape(-1)
+    # Every (slot, page) pair gets its OWN pool row: no aliasing, and
+    # never the reserved trash row 0.
+    assert len(set(flat.tolist())) == flat.size
+    assert 0 not in flat
+    assert int(flat.max()) < n_pages and int(flat.min()) >= 1
+
+
+def test_page_tables_fit_declared_pool():
+    for n_slots, max_pages in [(1, 1), (8, 16), (3, 5)]:
+        tables, n_pages = build_page_tables(n_slots, max_pages)
+        assert n_pages >= n_slots * max_pages + 1
+        assert int(np.max(tables)) < n_pages
+
+
+def test_tiny_smoke_emits_all_engine_dtype_combos(monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv",
+                        ["serve_bench.py", "--tiny", "--slots", "2",
+                         "--steps", "2"])
+    main()
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    combos = {(ln["engine"], ln["kv_dtype"]) for ln in lines}
+    assert combos == {("slot", "bf16"), ("slot", "int8"),
+                      ("paged", "bf16"), ("paged", "int8")}
+    for ln in lines:
+        assert ln["tokens_per_s"] > 0
+        assert ln["step_ms"] > 0

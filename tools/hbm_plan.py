@@ -113,10 +113,16 @@ def plan_training(cfg, *, dp=1, fsdp=1, pp=1, tp=1, sp=1, ep=1,
 
 
 def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
-                 pool_fraction=0.5, weight_bytes=2,
+                 pool_fraction=0.5, weight_bytes=2, kv_dtype="bf16",
                  chip="v5p") -> dict:
     """Per-chip HBM for the paged serving deployment (cli/serve.py
-    defaults: pool = half the full slots x max_len reservation)."""
+    defaults: pool = half the full slots x max_len reservation).
+
+    kv_dtype='int8' prices the quantized cache (--kv-dtype int8): one
+    byte per element plus one f32 scale per (token, head) for each of
+    K and V (ops/quant.quantize_kv) — ~0.52x the bf16 cache at
+    head_dim 128, which is what lets the same pool hold ~2x the
+    slots."""
     attn, mlp, moe = _layer_param_elems(cfg)
     L = cfg.n_layers
     embed = cfg.vocab_size * cfg.d_model          # replicated (decode)
@@ -126,14 +132,18 @@ def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
     weights = (embed + lm_head + layers + cfg.d_model) * weight_bytes
 
     hd = cfg.head_dim
+    # Bytes per (token, head) of ONE of K or V: payload + scale plane.
+    kv_tok_bytes = (hd * 1 + 4 if kv_dtype == "int8"
+                    else hd * weight_bytes)
     kv_full = (L * max_slots * max_len * 2
-               * (cfg.n_kv_heads / tp) * hd * weight_bytes)
+               * (cfg.n_kv_heads / tp) * kv_tok_bytes)
     kv = kv_full * pool_fraction
     total = weights + kv
     cap = CHIP_HBM[chip]
     return {
         "kind": "serve", "chip": chip, "hbm_gb": round(cap / GB, 1),
         "tp": tp, "slots": max_slots, "max_len": max_len,
+        "kv_dtype": kv_dtype,
         "weights_gb": round(weights / GB, 2),
         "kv_pool_gb": round(kv / GB, 2),
         "total_gb": round(total / GB, 2),
@@ -160,6 +170,10 @@ def shipped_plans() -> list[dict]:
                      chip="v5p"),
         plan_serving(cfg8b, tp=4, max_slots=8, max_len=4096,
                      chip="v5e"),
+        # The int8-KV claim (--kv-dtype int8): DOUBLE the v5e node's
+        # slots in ~the same cache bytes (README serving section).
+        plan_serving(cfg8b, tp=4, max_slots=16, max_len=4096,
+                     chip="v5e", kv_dtype="int8"),
         # Calibration pair: the bench config on the one real v5e chip —
         # batch 5 fits (measured), batch 8 does not (measured compile
         # failure). If a model change flips either, re-fit the model.

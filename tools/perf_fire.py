@@ -81,7 +81,9 @@ def main():
     if not args.quick:
         run_stage("flash_sweep", [py, "tools/flash_sweep.py"], 1800,
                   results)
-        run_stage("serve_bench", [py, "tools/serve_bench.py"], 900,
+        # 2x the old allowance: the kv-dtype dimension (bf16 + int8)
+        # doubles the compile count per (slots, engine) point.
+        run_stage("serve_bench", [py, "tools/serve_bench.py"], 1800,
                   results)
         run_stage("mfu_sweep", [py, "tools/mfu_sweep.py"], 1800,
                   results)

@@ -5,23 +5,46 @@ stage).
 Measures steady-state DECODE steps/sec of the slot and paged engines'
 hot path (decode_step_slots / decode_step_paged, jitted once, donated
 cache) on the bench-sized model (634M params — fits one v5e with
-room), at several slot counts. Reports tokens/s (= slots x steps/s)
-and per-step latency; tunnel discipline throughout (steps enqueued
-back-to-back, one scalar fence per window).
+room), at several slot counts, for BOTH KV-cache dtypes (bf16 and the
+int8 fused-dequant path) so the cache-bandwidth win reads directly off
+adjacent JSON lines. Reports tokens/s (= slots x steps/s) and per-step
+latency; tunnel discipline throughout (steps enqueued back-to-back,
+one scalar fence per window).
 
 Usage:  python tools/serve_bench.py [--slots 8,16,32] [--steps 64]
+                                    [--kv-dtypes bf16,int8]
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
 import time
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def build_page_tables(n_slots: int, max_pages: int):
+    """Distinct pool rows for every (slot, page): tables [n_slots,
+    max_pages] int32 and the pool size n_pages that backs them.
+
+    Steady-state serving never aliases two live (slot, page) pairs onto
+    one pool row — the allocator hands every live page its own row. The
+    earlier bench sized the pool at the engine's oversubscribed default
+    and silently pointed the overflow at the trash row, so half the
+    "cache" collapsed into one hot page and the paged numbers measured
+    a layout serving never produces (ADVICE r5). Row 0 stays reserved
+    as the trash page, exactly like the engine's pools."""
+    n_pages = n_slots * max_pages + 1
+    tables = np.arange(1, n_pages, dtype=np.int32).reshape(
+        n_slots, max_pages)
+    return tables, n_pages
 
 
 def main():
@@ -30,6 +53,9 @@ def main():
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--page", type=int, default=128)
+    ap.add_argument("--kv-dtypes", default="bf16,int8",
+                    help="comma list of KV-cache dtypes to sweep "
+                         "(bf16, int8)")
     ap.add_argument("--tiny", action="store_true",
                     help="llama_tiny on the CPU backend — a smoke test "
                          "of the harness, not a measurement")
@@ -52,61 +78,61 @@ def main():
         init_slot_cache,
     )
 
-    cfg = llama.llama_tiny() if args.tiny else llama.LlamaConfig(
+    base_cfg = llama.llama_tiny() if args.tiny else llama.LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq_len=args.max_len,
         dtype=jnp.bfloat16)
-    params = llama.init_params(jax.random.key(0), cfg)
+    params = llama.init_params(jax.random.key(0), base_cfg)
     max_len = 256 if args.tiny else args.max_len
 
     for n_slots in [int(s) for s in args.slots.split(",")]:
         for engine in ("slot", "paged"):
-            if engine == "slot":
-                cache = init_slot_cache(cfg, n_slots, max_len)
-                step = _jitted_decode_step_slots(cfg)
-            else:
-                max_pages = max_len // args.page
-                n_pages = n_slots * max_pages // 2 + 1
-                cache = init_paged_cache(cfg, n_slots, n_pages,
-                                         args.page, max_pages)
-                # Point every slot at distinct pages so writes hit real
-                # rows, as in steady-state serving.
-                import numpy as np
-                tables = np.zeros((n_slots, max_pages), np.int32)
-                flat = 1
-                for s_ in range(n_slots):
-                    for p_ in range(max_pages):
-                        tables[s_, p_] = flat if flat < n_pages else 0
-                        flat += 1
-                cache = cache._replace(tables=jnp.asarray(tables))
-                step = _jitted_decode_step_paged(cfg)
-            # Occupy every slot mid-sequence (the steady state).
-            cache = cache._replace(
-                length=jnp.full((n_slots,), max_len // 2, jnp.int32))
-            toks = jnp.ones((n_slots,), jnp.int32)
-            active = jnp.ones((n_slots,), bool)
+            for kv_dtype in args.kv_dtypes.split(","):
+                cfg = dataclasses.replace(base_cfg,
+                                          kv_cache_dtype=kv_dtype)
+                if engine == "slot":
+                    cache = init_slot_cache(cfg, n_slots, max_len)
+                    step = _jitted_decode_step_slots(cfg)
+                else:
+                    max_pages = max_len // args.page
+                    # Every active slot's pages truly distinct — the
+                    # steady state serving produces (see
+                    # build_page_tables); aliasing them onto the trash
+                    # row would collapse the measured cache footprint.
+                    tables, n_pages = build_page_tables(n_slots,
+                                                        max_pages)
+                    cache = init_paged_cache(cfg, n_slots, n_pages,
+                                             args.page, max_pages)
+                    cache = cache._replace(tables=jnp.asarray(tables))
+                    step = _jitted_decode_step_paged(cfg)
+                # Occupy every slot mid-sequence (the steady state).
+                cache = cache._replace(
+                    length=jnp.full((n_slots,), max_len // 2, jnp.int32))
+                toks = jnp.ones((n_slots,), jnp.int32)
+                active = jnp.ones((n_slots,), bool)
 
-            # Warmup (compile) + fence.
-            logits, cache = step(params, cache, toks, active)
-            float(jnp.sum(logits))
-            cache = cache._replace(
-                length=jnp.full((n_slots,), max_len // 2, jnp.int32))
+                # Warmup (compile) + fence.
+                logits, cache = step(params, cache, toks, active)
+                float(jnp.sum(logits))
+                cache = cache._replace(
+                    length=jnp.full((n_slots,), max_len // 2, jnp.int32))
 
-            t0 = time.perf_counter()
-            last = None
-            for _ in range(args.steps):
-                last, cache = step(params, cache, toks, active)
-                # Chain tokens through the cache dependency; greedy pick
-                # on-device keeps the loop fence-free.
-                toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            float(jnp.sum(last))
-            dt = (time.perf_counter() - t0) / args.steps
-            print(json.dumps({
-                "engine": engine, "slots": n_slots,
-                "step_ms": round(dt * 1e3, 3),
-                "tokens_per_s": round(n_slots / dt, 1),
-                "max_len": max_len,
-            }), flush=True)
+                t0 = time.perf_counter()
+                last = None
+                for _ in range(args.steps):
+                    last, cache = step(params, cache, toks, active)
+                    # Chain tokens through the cache dependency; greedy
+                    # pick on-device keeps the loop fence-free.
+                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                float(jnp.sum(last))
+                dt = (time.perf_counter() - t0) / args.steps
+                print(json.dumps({
+                    "engine": engine, "slots": n_slots,
+                    "kv_dtype": kv_dtype,
+                    "step_ms": round(dt * 1e3, 3),
+                    "tokens_per_s": round(n_slots / dt, 1),
+                    "max_len": max_len,
+                }), flush=True)
 
 
 if __name__ == "__main__":
