@@ -50,6 +50,13 @@ perf:
 hbm-plan:
 	$(PYTHON) tools/hbm_plan.py
 
+# Serving-observability smoke: tiny ContinuousEngine on the CPU
+# backend, three requests, /metrics scraped over an ephemeral port,
+# TTFT/TPOT histogram counts asserted against the traffic. Fast tier-1
+# (not marked slow); runs inside plain `make test` too.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serve_metrics.py -q
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
@@ -59,4 +66,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
-    perf hbm-plan dryrun clean
+    perf hbm-plan obs-smoke dryrun clean

@@ -264,6 +264,19 @@ def _run_one(config_name, cfg_overrides, mu_dtype):
         float(last)
         window_times.append(time.perf_counter() - t0)
     wall_dt = sum(window_times)
+    # Step-time distribution through the SAME recorder the serving
+    # stack exports (metrics/request_metrics.py) rather than ad-hoc
+    # wall-clock math: one decode_step observation per window (the
+    # windows fence once, so per-step times inside a window are
+    # invisible by design — the percentiles quantify window skew, i.e.
+    # tunnel stalls, not per-step jitter).
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+    rec = RequestRecorder()
+    for w in window_times:
+        rec.observe_decode_step(w / window_steps)
+    step_pcts = rec.pct_ms("decode_step")
     window_times.sort()
     median_dt = window_times[len(window_times) // 2] / window_steps
 
@@ -294,6 +307,7 @@ def _run_one(config_name, cfg_overrides, mu_dtype):
         "estimator": "median-window-pipelined",
         "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
         "wallclock_mfu": round(wall_mfu, 3),
+        "step_ms": step_pcts,
         "config": config_name,
     }))
 

@@ -20,14 +20,26 @@ the way the reference's slice-scale workloads do.
       stream=true answers as Server-Sent Events: one
       `data: {"token": t}` per generated token (time-to-first-token is
       measurable client-side), terminated by
-      `data: {"done": true, "tokens": [...]}`.
+      `data: {"done": true, "tokens": [...]}`. Every event carries a
+      monotonic `ts` and the request id `req`, so the stream doubles
+      as a structured event log.
   GET  /healthz
+
+Observability: every engine drives a shared RequestRecorder
+(metrics/request_metrics.py) at each request lifecycle edge — TTFT,
+TPOT, queue-wait, prefill and decode-step histograms plus queue/slot/
+page occupancy gauges, exported on `--metrics-port`; the worker ticks
+are wrapped in xplane trace annotations (serve/admit,
+serve/prefill_chunk, serve/decode_tick — utils/profiling.py) so an
+xplane trace captured via TPU_PROFILE_DIR lines up with the metric
+timeline.
 """
 
 from __future__ import annotations
 
 import argparse
 import concurrent.futures
+import itertools
 import json
 import logging
 import queue
@@ -35,35 +47,59 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+)
+from container_engine_accelerators_tpu.utils.profiling import (
+    annotate,
+    maybe_profile,
+)
+
 log = logging.getLogger("tpu-serve")
 
 
-def _stream_event(stream, event: dict) -> None:
-    """Push an event to a request's stream queue (None = not streaming)."""
+def _stream_event(stream, event: dict, rid=None) -> None:
+    """Push an event to a request's stream queue (None = not streaming).
+    Every event is stamped with a monotonic timestamp and, when known,
+    the request id — the streaming protocol doubles as a structured
+    event log (timestamps within one request are monotonic, which
+    tests/test_serve_metrics.py pins)."""
     if stream is not None:
-        stream.put(event)
+        ev = dict(event)
+        ev["ts"] = time.monotonic()
+        if rid is not None:
+            ev["req"] = rid
+        stream.put(ev)
 
 
-def _fail(fut, stream, exc: Exception) -> None:
+def _fail(fut, stream, exc: Exception, rid=None, recorder=None) -> None:
     if not fut.done():
         fut.set_exception(exc)
-    _stream_event(stream, {"error": str(exc)})
+    _stream_event(stream, {"error": str(exc)}, rid)
+    if recorder is not None:
+        # No-op for requests the recorder never saw enqueued
+        # (validation rejections count via validation_failures instead).
+        recorder.fail(rid)
 
 
 def _validate_request(tokens, max_new_tokens, max_prompt_len,
-                      fut, stream) -> bool:
+                      fut, stream, rid=None, recorder=None) -> bool:
     """Shared request validation for all engines; fails `fut` (and the
     stream, so SSE clients see the error instead of a hang) and returns
     False on a bad request."""
+    err = None
     if not tokens or len(tokens) > max_prompt_len:
-        _fail(fut, stream, ValueError(
-            f"prompt length must be in [1, {max_prompt_len}]"))
-        return False
-    if max_new_tokens < 1 or max_new_tokens > 1024:
-        _fail(fut, stream, ValueError(
-            "max_new_tokens must be in [1, 1024]"))
-        return False
-    return True
+        err = ValueError(
+            f"prompt length must be in [1, {max_prompt_len}]")
+    elif max_new_tokens < 1 or max_new_tokens > 1024:
+        err = ValueError("max_new_tokens must be in [1, 1024]")
+    if err is None:
+        return True
+    if recorder is not None:
+        recorder.validation_failures.inc()
+    _fail(fut, stream, err, rid)
+    return False
 
 
 def _use_mesh(mesh):
@@ -76,14 +112,25 @@ def _use_mesh(mesh):
 class BatchingEngine:
     def __init__(self, params, cfg, max_batch: int = 8,
                  window_ms: float = 5.0, max_prompt_len: int = 1024,
-                 mesh=None):
+                 mesh=None, recorder: RequestRecorder | None = None):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
         self.window = window_ms / 1000.0
         self.max_prompt_len = max_prompt_len
         self.mesh = _use_mesh(mesh)
-        self.queue: queue.SimpleQueue = queue.SimpleQueue()
+        # One recorder can be shared across engines/processes' registry;
+        # by default each engine owns a private one.
+        self.recorder = recorder if recorder is not None \
+            else RequestRecorder()
+        self._rid = itertools.count(1)  # request ids (count() is atomic)
+        # queue.Queue, NOT SimpleQueue: the C _queue module's timed get
+        # can lose a put's wakeup and block forever (reproduced
+        # stdlib-only on this CPython; wedged seed engines ~1/10^3
+        # creations). The Condition-based Queue has no such state, and
+        # _work bounds any residual wait (submit sets it AFTER put).
+        self.queue: queue.Queue = queue.Queue()
+        self._work = threading.Event()
         self.batches_run = 0
         self.requests_served = 0
         self._stop = threading.Event()
@@ -93,18 +140,23 @@ class BatchingEngine:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float,
-               stream: queue.SimpleQueue | None = None
+               stream: queue.Queue | queue.SimpleQueue | None = None
                ) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        rid = next(self._rid)
         if not _validate_request(tokens, max_new_tokens,
-                                 self.max_prompt_len, fut, stream):
+                                 self.max_prompt_len, fut, stream,
+                                 rid=rid, recorder=self.recorder):
             return fut
+        self.recorder.enqueue(rid)
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut,
-                        stream))
+                        stream, rid))
+        self._work.set()  # after put: the worker's drain must see it
         return fut
 
     def stop(self):
         self._stop.set()
+        self._work.set()  # wake an idle worker so it can exit promptly
 
     # ---------- worker ----------
 
@@ -132,8 +184,13 @@ class BatchingEngine:
             # otherwise a bucket-mismatched request parked in `pending`
             # would starve until unrelated requests arrive.
             if not pending:
+                # Park on the Event, then drain non-blocking: no timed
+                # queue-get anywhere (see __init__ on the lost-wakeup
+                # race); a missed set costs one 0.1 s wake at most.
+                self._work.wait(0.1)
+                self._work.clear()
                 try:
-                    pending.append(self.queue.get(timeout=0.1))
+                    pending.append(self.queue.get_nowait())
                 except queue.Empty:
                     continue
             # Gather same-bucket requests for one window.
@@ -149,42 +206,64 @@ class BatchingEngine:
                     batch.append(pending.pop(i))
                 else:
                     i += 1
-            while len(batch) < self.max_batch and \
-                    time.monotonic() < deadline:
+            while len(batch) < self.max_batch:
                 try:
-                    item = self.queue.get(
-                        timeout=max(deadline - time.monotonic(), 0.001))
+                    item = self.queue.get_nowait()
                 except queue.Empty:
-                    break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    # Clear BEFORE the retry drain: a put landing after
+                    # the clear leaves the event set for the next wait.
+                    self._work.wait(min(remaining, 0.05))
+                    self._work.clear()
+                    continue
                 if self._bucket_key(item) == key:
                     batch.append(item)
                 else:
                     pending.append(item)
 
+            rec = self.recorder
+            for item in batch:
+                rec.admit(item[5])
+            rec.set_slots(active=len(batch), total=self.max_batch)
             tokens = jnp.asarray([item[0] for item in batch], jnp.int32)
             n_new, temp = batch[0][1], batch[0][2]
+            t_batch = time.monotonic()
             try:
                 key_arr = (jax.random.key(int(time.time_ns()) & 0xFFFF)
                            if temp > 0 else None)
-                out = generate(self.params, tokens, self.cfg, n_new,
-                               temperature=temp, key=key_arr,
-                               mesh=self.mesh)
-                out_host = [[int(t) for t in row] for row in out]
+                with annotate("serve/decode_tick"):
+                    out = generate(self.params, tokens, self.cfg, n_new,
+                                   temperature=temp, key=key_arr,
+                                   mesh=self.mesh)
+                    out_host = [[int(t) for t in row] for row in out]
+                batch_dt = time.monotonic() - t_batch
                 for item, row in zip(batch, out_host):
+                    rid = item[5]
                     item[3].set_result(row)
                     # Window batching has no incremental tokens: the
-                    # stream degenerates to generated-tokens + done.
+                    # stream degenerates to generated-tokens + done, the
+                    # client's real TTFT is batch completion, and TPOT
+                    # amortizes the batch time over the generated
+                    # tokens (keeps observation counts engine-uniform).
+                    rec.first_token(rid)
+                    n_gen = len(row) - len(item[0])
+                    for _ in range(n_gen - 1):
+                        rec.observe_tpot(batch_dt / max(n_gen, 1))
                     if item[4] is not None:
                         for t in row[len(item[0]):]:
-                            _stream_event(item[4], {"token": t})
+                            _stream_event(item[4], {"token": t}, rid)
                         _stream_event(item[4],
-                                      {"done": True, "tokens": row})
+                                      {"done": True, "tokens": row}, rid)
+                    rec.finish(rid)
                 self.batches_run += 1
                 self.requests_served += len(batch)
             except Exception as e:
                 log.exception("batch failed")
                 for item in batch:
-                    _fail(item[3], item[4], e)
+                    _fail(item[3], item[4], e, item[5], rec)
+            rec.set_slots(active=0, total=self.max_batch)
 
 
 class ContinuousEngine:
@@ -221,12 +300,15 @@ class ContinuousEngine:
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_len: int = 2048, prompt_bucket: int = 64,
                  max_prompt_len: int = 1024, prefill_chunk: int = 0,
-                 mesh=None):
+                 mesh=None, recorder: RequestRecorder | None = None):
         from container_engine_accelerators_tpu.models.decode import (
             _kernel_eligible,
         )
 
         self.params = params
+        self.recorder = recorder if recorder is not None \
+            else RequestRecorder()
+        self._rid = itertools.count(1)
         self.cfg = cfg
         self.max_slots = max_slots
         if _kernel_eligible(cfg):
@@ -244,7 +326,11 @@ class ContinuousEngine:
             prefill_chunk = -(-prefill_chunk // self.prompt_bucket) \
                 * self.prompt_bucket
         self.prefill_chunk = prefill_chunk
-        self.queue: queue.SimpleQueue = queue.SimpleQueue()
+        # queue.Queue + Event wake, not SimpleQueue: see BatchingEngine
+        # (SimpleQueue's timed get can lose a put's wakeup and wedge
+        # the worker; _pump_queue never issues a timed queue-get).
+        self.queue: queue.Queue = queue.Queue()
+        self._work = threading.Event()
         self.steps_run = 0          # decode iterations (all slots at once)
         self.prefills_run = 0       # completed request prefills
         self.prefill_chunks_run = 0
@@ -260,27 +346,33 @@ class ContinuousEngine:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float,
-               stream: queue.SimpleQueue | None = None
+               stream: queue.Queue | queue.SimpleQueue | None = None
                ) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        rid = next(self._rid)
         if not _validate_request(tokens, max_new_tokens,
-                                 self.max_prompt_len, fut, stream):
+                                 self.max_prompt_len, fut, stream,
+                                 rid=rid, recorder=self.recorder):
             return fut
         # The prompt is padded UP to a bucket multiple before prefill,
         # so the bucketed length (not the raw one) must fit the cache.
         bucketed = -(-len(tokens) // self.prompt_bucket) * self.prompt_bucket
         if (len(tokens) + max_new_tokens > self.max_len
                 or bucketed > self.max_len):
+            self.recorder.validation_failures.inc()
             _fail(fut, stream, ValueError(
                 f"prompt (bucketed to {bucketed}) + max_new_tokens "
-                f"exceeds cache max_len {self.max_len}"))
+                f"exceeds cache max_len {self.max_len}"), rid)
             return fut
+        self.recorder.enqueue(rid)
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut,
-                        stream))
+                        stream, rid))
+        self._work.set()  # after put: the worker's drain must see it
         return fut
 
     def stop(self):
         self._stop.set()
+        self._work.set()  # wake an idle worker so it can exit promptly
 
     # ---------- engine hooks (overridden by the paged engine) ----------
 
@@ -320,13 +412,13 @@ class ContinuousEngine:
         """Register the request in a free slot (compute deferred to the
         prefill ticks). False = resources exhausted, retry next loop
         (item NOT consumed)."""
-        tokens, n_new, temp, fut, stream = item
+        tokens, n_new, temp, fut, stream, rid = item
         self._admit_seq += 1
         self._slots[slot_idx] = {
             "fut": fut, "stream": stream, "remaining": n_new,
             "out": list(tokens), "temp": temp,
             "pending": list(tokens), "len": 0,
-            "admitted": self._admit_seq}
+            "admitted": self._admit_seq, "rid": rid}
         self._last_tok[slot_idx] = 0
         self._temps[slot_idx] = temp
         return True
@@ -373,23 +465,43 @@ class ContinuousEngine:
 
         while not self._stop.is_set():
             self._pump_queue()
-            self._admit_phase()
+            with annotate("serve/admit"):
+                self._admit_phase()
+            self._record_occupancy()
             if all(sl is None for sl in self._slots):
                 continue
-            self._prefill_tick()
+            with annotate("serve/prefill_chunk"):
+                self._prefill_tick()
             if not self._pre_step():
                 continue
-            self._decode_tick()
+            with annotate("serve/decode_tick"):
+                self._decode_tick()
+
+    def _record_occupancy(self):
+        """Occupancy gauges, refreshed once per worker iteration (the
+        paged engine adds page-pool gauges)."""
+        self.recorder.set_slots(
+            active=sum(sl is not None for sl in self._slots),
+            total=self.max_slots)
 
     def _pump_queue(self):
+        # Liveness: NO timed queue-gets here. The previous
+        # SimpleQueue.get(timeout=...) pump could block forever on a
+        # lost wakeup (CPython _queue race under timed gets racing
+        # put — an admitted-never-served request caught by the ISSUE-2
+        # hang hunter on the SEED code, ~1/10^3 fresh engines). The
+        # worker now drains non-blocking and parks on an Event that
+        # submit() sets AFTER its put, so a missed set costs one 50 ms
+        # wake instead of a wedged engine.
         idle = all(sl is None for sl in self._slots) and not self._backlog
+        if idle:
+            self._work.wait(0.05)
+        self._work.clear()
         while True:
             try:
-                self._backlog.append(self.queue.get(
-                    timeout=0.05 if idle else 0.0))
+                self._backlog.append(self.queue.get_nowait())
             except queue.Empty:
                 return
-            idle = False
 
     def _admit_phase(self):
         free = [i for i in range(self.max_slots)
@@ -402,11 +514,12 @@ class ContinuousEngine:
             except Exception as e:
                 log.exception("admission failed")
                 self._backlog.pop(0)
-                _fail(item[3], item[4], e)
+                _fail(item[3], item[4], e, item[5], self.recorder)
                 self._reset(e)
                 return
             self._backlog.pop(0)
             if self._slots[free[0]] is not None:  # actually admitted
+                self.recorder.admit(item[5])
                 free.pop(0)
 
     def _prefill_tick(self):
@@ -450,7 +563,8 @@ class ContinuousEngine:
         sl["out"].append(tok)
         sl["remaining"] -= 1
         self._last_tok[i] = tok
-        _stream_event(sl["stream"], {"token": tok})
+        self.recorder.first_token(sl["rid"])
+        _stream_event(sl["stream"], {"token": tok}, sl["rid"])
         if sl["remaining"] <= 0:
             self._finish(i)
 
@@ -469,6 +583,7 @@ class ContinuousEngine:
         tokens_arr = jnp.asarray(self._last_tok, jnp.int32)
         active_arr = jnp.asarray(decoding, bool)
         temps_arr = jnp.asarray(self._temps, jnp.float32)
+        t_step = time.monotonic()
         try:
             logits, self._cache = self._step_fn(
                 self.params, self._cache, tokens_arr, active_arr)
@@ -477,11 +592,14 @@ class ContinuousEngine:
             key = jax.random.fold_in(self._base_key,
                                      (self.steps_run & 0xFFFFFFF)
                                      | (1 << 28))
+            # The int() conversions fence the step, so the observed
+            # latency covers the device round trip, not just dispatch.
             toks = [int(t) for t in self._pick_fn(logits, temps_arr, key)]
         except Exception as e:
             log.exception("decode step failed")
             self._reset(e)
             return
+        self.recorder.observe_decode_step(time.monotonic() - t_step)
         for i, sl in enumerate(self._slots):
             if sl is None or sl["pending"]:
                 continue
@@ -489,7 +607,8 @@ class ContinuousEngine:
             sl["len"] = min(sl["len"] + 1, self.max_len)
             self._last_tok[i] = toks[i]
             sl["remaining"] -= 1
-            _stream_event(sl["stream"], {"token": toks[i]})
+            self.recorder.decode_token(sl["rid"])
+            _stream_event(sl["stream"], {"token": toks[i]}, sl["rid"])
             if sl["remaining"] <= 0:
                 self._finish(i)
 
@@ -499,7 +618,9 @@ class ContinuousEngine:
         out = [int(t) for t in sl["out"]]
         if not sl["fut"].done():
             sl["fut"].set_result(out)
-        _stream_event(sl["stream"], {"done": True, "tokens": out})
+        _stream_event(sl["stream"], {"done": True, "tokens": out},
+                      sl["rid"])
+        self.recorder.finish(sl["rid"])
         self.requests_served += 1
         self._slots[i] = None
 
@@ -507,12 +628,14 @@ class ContinuousEngine:
         # Device calls DONATE the cache: after any failure the old buffer
         # may be consumed or poisoned, so recovery = fail every in-flight
         # AND backlogged request and rebuild the pool from scratch.
+        self.recorder.engine_resets.inc()
         for i, sl in enumerate(self._slots):
             if sl is not None:
-                _fail(sl["fut"], sl["stream"], err)
+                _fail(sl["fut"], sl["stream"], err, sl["rid"],
+                      self.recorder)
             self._slots[i] = None
         for item in self._backlog:
-            _fail(item[3], item[4], err)
+            _fail(item[3], item[4], err, item[5], self.recorder)
         self._backlog.clear()
         self._fresh_state()
 
@@ -556,7 +679,8 @@ class PagedContinuousEngine(ContinuousEngine):
                  max_len: int = 2048, page: int = 128,
                  pool_pages: int | None = None,
                  max_prompt_len: int = 1024, prefix_cap: int = 256,
-                 prefill_chunk: int = 0, mesh=None):
+                 prefill_chunk: int = 0, mesh=None,
+                 recorder: RequestRecorder | None = None):
         import math
 
         from container_engine_accelerators_tpu.models.decode import (
@@ -600,7 +724,8 @@ class PagedContinuousEngine(ContinuousEngine):
         super().__init__(params, cfg, max_slots=max_slots,
                          max_len=max_len, prompt_bucket=page,
                          max_prompt_len=max_prompt_len,
-                         prefill_chunk=prefill_chunk, mesh=mesh)
+                         prefill_chunk=prefill_chunk, mesh=mesh,
+                         recorder=recorder)
         assert self.max_len == self.max_pages * self.page
 
     def submit(self, tokens, max_new_tokens, temperature, stream=None):
@@ -610,6 +735,7 @@ class PagedContinuousEngine(ContinuousEngine):
         bucketed = -(-len(tokens) // self.page) * self.page
         if bucketed // self.page > self.pool_pages - 1:
             fut: concurrent.futures.Future = concurrent.futures.Future()
+            self.recorder.validation_failures.inc()
             _fail(fut, stream, ValueError(
                 f"prompt needs {bucketed // self.page} pages but the "
                 f"pool has only {self.pool_pages - 1} usable; raise "
@@ -682,6 +808,14 @@ class PagedContinuousEngine(ContinuousEngine):
     def _release_slot(self, i):
         self._free_slot_pages(i)
 
+    def _record_occupancy(self):
+        super()._record_occupancy()
+        # Pool occupancy includes prefix-cache retention: pages the
+        # index holds are spent HBM even with no live request on them.
+        self.recorder.set_kv_pages(
+            used=self._alloc.n_pages - 1 - self._alloc.free_pages,
+            total=self._alloc.n_pages - 1)
+
     def _preempt_youngest(self) -> int | None:
         """Free the most recently admitted request's pages and requeue
         it at the FRONT of the backlog (generated tokens become part of
@@ -698,9 +832,11 @@ class PagedContinuousEngine(ContinuousEngine):
         sl = self._slots[i]
         self._free_slot_pages(i)
         self._backlog.insert(0, (tuple(sl["out"]), sl["remaining"],
-                                 sl["temp"], sl["fut"], sl["stream"]))
+                                 sl["temp"], sl["fut"], sl["stream"],
+                                 sl["rid"]))
         self._slots[i] = None
         self.preemptions += 1
+        self.recorder.preempt(sl["rid"])
         return i
 
     def _admit_one(self, item, slot_idx) -> bool:
@@ -711,7 +847,7 @@ class PagedContinuousEngine(ContinuousEngine):
             PrefixIndex,
         )
 
-        tokens, n_new, temp, fut, stream = item
+        tokens, n_new, temp, fut, stream, rid = item
         page = self.page
         tp = -(-len(tokens) // page) * page
         if tp // page > self.pool_pages - 1:
@@ -721,7 +857,7 @@ class PagedContinuousEngine(ContinuousEngine):
             _fail(fut, stream, RuntimeError(
                 f"request needs {tp // page} prompt pages but the pool "
                 f"has only {self.pool_pages - 1} usable; raise "
-                "--pool-pages"))
+                "--pool-pages"), rid, self.recorder)
             return True  # consumed
         # Prefix cache: reuse pool rows for the longest chain of FULL
         # prompt pages another request already computed (at most
@@ -746,10 +882,13 @@ class PagedContinuousEngine(ContinuousEngine):
             "out": list(tokens), "temp": temp,
             "pending": list(tokens[p_len:]), "len": p_len,
             "rows": all_rows, "keys": keys,
-            "n_shared": len(shared), "admitted": self._admit_seq}
+            "n_shared": len(shared), "admitted": self._admit_seq,
+            "rid": rid}
         self._last_tok[slot_idx] = 0
         self._temps[slot_idx] = temp
         self.prefix_pages_reused += len(shared)
+        if shared:
+            self.recorder.prefix_pages_reused.inc(len(shared))
         return True
 
     def _run_chunk(self, slot_idx, padded, start, new_len):
@@ -800,7 +939,8 @@ class PagedContinuousEngine(ContinuousEngine):
                     # candidate) — belt against future refactors.
                     _fail(sl["fut"], sl["stream"], RuntimeError(
                         "page pool exhausted and no preemptible "
-                        "request left; raise --pool-pages"))
+                        "request left; raise --pool-pages"),
+                        sl["rid"], self.recorder)
                     self._free_slot_pages(i)
                     self._slots[i] = None
                     break
@@ -877,7 +1017,12 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
                 if req.get("stream"):
-                    stream_q: queue.SimpleQueue = queue.SimpleQueue()
+                    # queue.Queue, not SimpleQueue: this consumer does a
+                    # timed get racing the engine's puts, the exact
+                    # pattern that loses wakeups in the C _queue module
+                    # (see BatchingEngine.__init__) — here it would
+                    # surface as a spurious 120 s SSE idle timeout.
+                    stream_q: queue.Queue = queue.Queue()
                     engine.submit(
                         [int(t) for t in req["tokens"]],
                         int(req.get("max_new_tokens", 16)),
@@ -949,6 +1094,16 @@ def main(argv=None) -> int:
                         "traffic and doubles the slots that fit "
                         "(tools/hbm_plan.py prices it); orthogonal to "
                         "--quantize-int8, which quantizes WEIGHTS")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve request-lifecycle Prometheus metrics "
+                        "(TTFT/TPOT/queue-wait histograms, slot and KV "
+                        "page occupancy, preemptions) on this port; "
+                        "0 binds an ephemeral port (logged at startup); "
+                        "omit to disable the exporter")
+    p.add_argument("--metrics-host", default="",
+                   help="bind host for the metrics exporter (default: "
+                        "all interfaces, matching the reference "
+                        "exporters)")
     p.add_argument("--moe-decode-ep", action="store_true",
                    help="with --tp > 1 on an MoE model: shard experts "
                         "over the tp axis (n_experts/tp per chip + one "
@@ -998,23 +1153,35 @@ def main(argv=None) -> int:
         mesh = decode_tp.make_inference_mesh(tp=args.tp)
         log.info("tensor-parallel over %d chips", args.tp)
 
+    recorder = RequestRecorder()
     if args.engine == "paged":
         engine = PagedContinuousEngine(
             params, cfg, max_slots=args.max_batch, max_len=args.max_len,
             page=args.page_size, pool_pages=args.pool_pages,
             prefix_cap=args.prefix_cache_cap,
-            prefill_chunk=args.prefill_chunk, mesh=mesh)
+            prefill_chunk=args.prefill_chunk, mesh=mesh,
+            recorder=recorder)
     elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len,
                                   prefill_chunk=args.prefill_chunk,
-                                  mesh=mesh)
+                                  mesh=mesh, recorder=recorder)
     else:
         engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
-                                window_ms=args.batch_window_ms, mesh=mesh)
+                                window_ms=args.batch_window_ms, mesh=mesh,
+                                recorder=recorder)
+    if args.metrics_port is not None:
+        exporter = ServeMetricsExporter(recorder, port=args.metrics_port,
+                                        host=args.metrics_host)
+        exporter.start_background()
+        log.info("request metrics on :%d/metrics", exporter.bound_port)
     server = make_server(engine, args.port)
     log.info("serving on :%d (/generate, /healthz)", args.port)
-    server.serve_forever()
+    # TPU_PROFILE_DIR set -> the whole serving session is one xplane
+    # trace whose serve/* annotations line up with the request metrics;
+    # unset -> no-op. start_trace failures log-and-continue.
+    with maybe_profile():
+        server.serve_forever()
     return 0
 
 

@@ -7,6 +7,12 @@ from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesStub,
 )
 from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+    percentile,
+    percentiles,
+)
 from container_engine_accelerators_tpu.metrics.sampler import (
     ChipSample,
     FakeSampler,
@@ -18,6 +24,10 @@ __all__ = [
     "PodResourcesClient",
     "PodResourcesStub",
     "MetricServer",
+    "RequestRecorder",
+    "ServeMetricsExporter",
+    "percentile",
+    "percentiles",
     "ChipSample",
     "FakeSampler",
     "SysfsSampler",

@@ -59,8 +59,16 @@ class MetricServer(ExporterBase):
         self.node_memory_total = Gauge(
             "node_memory_total", "TPU HBM total bytes, per chip",
             NODE_LABELS, registry=self.registry)
+        # reference metrics.go: the request_* family reports the chips a
+        # container REQUESTED (kubelet allocation), not what it uses.
         self.request_count = Gauge(
-            "request", "TPU chips requested by container",
+            "request_tpu_chips", "TPU chips requested by container "
+            "(reference metrics.go request_* family)",
+            ["namespace", "pod", "container"], registry=self.registry)
+        # DEPRECATED alias, kept one release: pre-rename dashboards
+        # scrape `request`; both gauges carry identical values.
+        self.request_count_legacy = Gauge(
+            "request", "DEPRECATED: use request_tpu_chips",
             ["namespace", "pod", "container"], registry=self.registry)
 
     # ---------- metric computation ----------
@@ -97,6 +105,7 @@ class MetricServer(ExporterBase):
         self.memory_used.clear()
         self.memory_total.clear()
         self.request_count.clear()
+        self.request_count_legacy.clear()
 
         for chip, s in sorted(samples.items()):
             labels = dict(tpu_chip=f"accel{chip}", model=model)
@@ -115,6 +124,9 @@ class MetricServer(ExporterBase):
             chips = sorted({c for d in attr.device_ids
                             for c in self._device_chips(d)})
             self.request_count.labels(
+                namespace=attr.namespace, pod=attr.pod,
+                container=attr.container).set(len(attr.device_ids))
+            self.request_count_legacy.labels(
                 namespace=attr.namespace, pod=attr.pod,
                 container=attr.container).set(len(attr.device_ids))
             for chip in chips:

@@ -1,0 +1,282 @@
+"""Request-lifecycle metrics for the serving engines (ISSUE 2 tentpole).
+
+One `RequestRecorder` is shared by every engine in `cli/serve.py`; the
+engines call it at each lifecycle edge:
+
+    enqueue -> admit -> first_token -> decode_token* -> finish
+                  \\-> preempt (paged engine, back to enqueue)
+                  \\-> fail (device error / admission failure)
+
+and it turns those edges into Prometheus histograms (TTFT, TPOT, queue
+wait, prefill time, decode step time), gauges (queue depth, active
+slots, KV page occupancy) and counters (requests by outcome,
+preemptions, validation failures, engine resets) on a private
+`CollectorRegistry` — served over HTTP by `ServeMetricsExporter`
+(`serve --metrics-port`; port 0 binds an ephemeral port for tests).
+
+The recorder also retains the raw samples (bounded deques), so offline
+harnesses (tools/serve_bench.py, bench.py) derive p50/p95/p99 columns
+from the same observations the scrape endpoint exports instead of
+keeping ad-hoc wall-clock totals.
+
+All methods take an optional `now` (monotonic seconds) so tests can
+drive a synthetic timeline; production callers omit it. Thread-safe:
+submit runs on HTTP threads while the worker loop observes tokens.
+
+Semantics worth pinning:
+  - TTFT is measured from ENQUEUE (what a client experiences), prefill
+    time from ADMIT (what the engine controls); queue wait is the gap.
+  - The window engine materializes tokens only at batch completion, so
+    it observes TTFT at completion and amortizes TPOT as
+    batch_time / new_tokens (via `observe_tpot`) — degenerate but
+    honest, and the observation COUNTS stay identical across engines.
+  - A preemption re-queues the request: queue wait and TTFT are
+    observed again for the re-admission (time to first token after
+    restart), matching what the client's stream shows.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+from container_engine_accelerators_tpu.metrics.serving import ExporterBase
+
+# Spans the tiny-model CPU tests (~1 ms steps) through real serving
+# (multi-second TTFT under load); decode steps sit 1-2 orders below
+# request latencies, hence the separate finer ladder.
+_REQ_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                1.0, 2.5, 5.0, 10.0, 30.0)
+_STEP_BUCKETS = (.0001, .00025, .0005, .001, .0025, .005, .01, .025,
+                 .05, .1, .25, .5, 1.0)
+
+SAMPLE_KINDS = ("ttft", "tpot", "queue_wait", "prefill", "decode_step")
+
+
+def percentile(xs, p):
+    """Nearest-rank percentile (inclusive): the smallest sample with at
+    least p% of the mass at or below it. None on empty input."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = max(0, math.ceil(p / 100.0 * len(xs)) - 1)
+    return xs[min(k, len(xs) - 1)]
+
+
+def percentiles(xs, ps=(50, 95, 99)):
+    """{"p50": ..., "p95": ..., "p99": ...} via nearest-rank."""
+    return {f"p{p}": percentile(xs, p) for p in ps}
+
+
+class RequestRecorder:
+    """Thread-safe lifecycle recorder; see the module docstring for the
+    edge protocol and measurement semantics."""
+
+    def __init__(self, registry: CollectorRegistry | None = None,
+                 max_samples: int = 65536):
+        self.registry = registry or CollectorRegistry()
+        self._lock = threading.Lock()
+        # rid -> {"stage", "enqueue_ts", "admit_ts", "last_tok_ts"}
+        self._state: dict = {}
+        self._queued = 0
+        self.samples = {k: collections.deque(maxlen=max_samples)
+                        for k in SAMPLE_KINDS}
+
+        reg = self.registry
+        self.ttft = Histogram(
+            "serve_ttft_seconds",
+            "Time from enqueue to the request's first generated token",
+            buckets=_REQ_BUCKETS, registry=reg)
+        self.tpot = Histogram(
+            "serve_tpot_seconds",
+            "Time per generated token after the first (inter-token gap)",
+            buckets=_STEP_BUCKETS, registry=reg)
+        self.queue_wait = Histogram(
+            "serve_queue_wait_seconds",
+            "Time from enqueue to admission into a decode slot/batch",
+            buckets=_REQ_BUCKETS, registry=reg)
+        self.prefill = Histogram(
+            "serve_prefill_seconds",
+            "Time from admission to the first generated token",
+            buckets=_REQ_BUCKETS, registry=reg)
+        self.decode_step = Histogram(
+            "serve_decode_step_seconds",
+            "Latency of one decode step over the whole active batch",
+            buckets=_STEP_BUCKETS, registry=reg)
+
+        self.queue_depth = Gauge(
+            "serve_queue_depth",
+            "Requests enqueued or backlogged, not yet in a slot",
+            registry=reg)
+        self.active_slots = Gauge(
+            "serve_active_slots", "Decode slots holding a live request",
+            registry=reg)
+        self.slots_total = Gauge(
+            "serve_slots_total", "Configured decode slots", registry=reg)
+        self.kv_pages_in_use = Gauge(
+            "serve_kv_pages_in_use",
+            "KV pool pages held by live slots or the prefix cache "
+            "(paged engine)", registry=reg)
+        self.kv_pages_total = Gauge(
+            "serve_kv_pages_total",
+            "Usable KV pool pages, excluding the reserved trash row "
+            "(paged engine)", registry=reg)
+
+        self.requests = Counter(
+            "serve_requests", "Requests closed, by outcome",
+            ["outcome"], registry=reg)
+        self.preemptions = Counter(
+            "serve_preemptions",
+            "Requests preempted (pages freed, requeued with progress)",
+            registry=reg)
+        self.validation_failures = Counter(
+            "serve_validation_failures",
+            "Requests rejected before enqueue (bad prompt/params)",
+            registry=reg)
+        self.engine_resets = Counter(
+            "serve_engine_resets",
+            "Device-error recoveries that rebuilt the KV pool and "
+            "failed all in-flight work", registry=reg)
+        self.prefix_pages_reused = Counter(
+            "serve_prefix_pages_reused",
+            "Full prompt pages served from the prefix cache instead of "
+            "recomputed (paged engine)", registry=reg)
+
+    # ---------- lifecycle edges ----------
+
+    def _observe(self, kind: str, value: float) -> None:
+        value = max(value, 0.0)
+        getattr(self, kind).observe(value)  # histogram attrs match kinds
+        self.samples[kind].append(value)
+
+    def enqueue(self, rid, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._state[rid] = {"stage": "queued", "enqueue_ts": now}
+            self._queued += 1
+            self.queue_depth.set(self._queued)
+
+    def admit(self, rid, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state.get(rid)
+            if st is None:  # recorder attached mid-flight: adopt
+                st = self._state[rid] = {"stage": "queued",
+                                         "enqueue_ts": now}
+                self._queued += 1
+            if st["stage"] == "queued":
+                self._queued -= 1
+                self.queue_depth.set(self._queued)
+            st["stage"] = "active"
+            st["admit_ts"] = now
+            self._observe("queue_wait", now - st["enqueue_ts"])
+
+    def first_token(self, rid, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state.get(rid)
+            if st is None:
+                return
+            self._observe("ttft", now - st["enqueue_ts"])
+            if "admit_ts" in st:
+                self._observe("prefill", now - st["admit_ts"])
+            st["last_tok_ts"] = now
+
+    def decode_token(self, rid, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state.get(rid)
+            if st is None or "last_tok_ts" not in st:
+                return
+            self._observe("tpot", now - st["last_tok_ts"])
+            st["last_tok_ts"] = now
+
+    def observe_tpot(self, seconds: float) -> None:
+        """Direct TPOT observation for engines with no incremental
+        tokens (the window engine amortizes the batch time)."""
+        with self._lock:
+            self._observe("tpot", seconds)
+
+    def observe_decode_step(self, seconds: float) -> None:
+        with self._lock:
+            self._observe("decode_step", seconds)
+
+    def preempt(self, rid, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state.get(rid)
+            if st is None:
+                return
+            self.preemptions.inc()
+            if st["stage"] == "active":
+                self._queued += 1
+                self.queue_depth.set(self._queued)
+            st["stage"] = "queued"
+            st["enqueue_ts"] = now
+            st.pop("admit_ts", None)
+            st.pop("last_tok_ts", None)
+
+    def finish(self, rid) -> None:
+        self._close(rid, "ok")
+
+    def fail(self, rid) -> None:
+        self._close(rid, "error")
+
+    def _close(self, rid, outcome: str) -> None:
+        with self._lock:
+            st = self._state.pop(rid, None)
+            if st is None:
+                return  # never enqueued (validation) or already closed
+            if st["stage"] == "queued":
+                self._queued -= 1
+                self.queue_depth.set(self._queued)
+            self.requests.labels(outcome=outcome).inc()
+
+    # ---------- occupancy gauges (set by the worker loop) ----------
+
+    def set_slots(self, active: int, total: int) -> None:
+        self.active_slots.set(active)
+        self.slots_total.set(total)
+
+    def set_kv_pages(self, used: int, total: int) -> None:
+        self.kv_pages_in_use.set(used)
+        self.kv_pages_total.set(total)
+
+    # ---------- offline summaries ----------
+
+    def pct(self, kind: str, ps=(50, 95, 99)) -> dict:
+        """Nearest-rank percentiles (seconds) over retained samples."""
+        with self._lock:
+            xs = list(self.samples[kind])
+        return percentiles(xs, ps)
+
+    def pct_ms(self, kind: str, ps=(50, 95, 99)) -> dict:
+        """Same, in rounded milliseconds (None entries dropped)."""
+        return {k: round(v * 1e3, 3)
+                for k, v in self.pct(kind, ps).items() if v is not None}
+
+
+class ServeMetricsExporter(ExporterBase):
+    """Serves a RequestRecorder's registry on /metrics. The recorder is
+    push-updated by the engines, so poll_once only runs the optional
+    poll_fn (e.g. a gauge refresh for an idle engine)."""
+
+    name = "serve-metrics"
+
+    def __init__(self, recorder: RequestRecorder, port: int = 0,
+                 host: str = "", interval: float = 5.0, poll_fn=None):
+        self.recorder = recorder
+        self.registry = recorder.registry
+        self.port = port
+        self.host = host
+        self.interval = interval
+        self._poll_fn = poll_fn
+        self._stop = threading.Event()
+
+    def poll_once(self) -> None:
+        if self._poll_fn is not None:
+            self._poll_fn()

@@ -1,6 +1,7 @@
 """Shared Prometheus-exporter scaffold: WSGI server + poll thread +
-Event-based stop, used by both the chip exporter (metrics.py) and the
-fabric exporter (fabric.py) so serving fixes land in one place."""
+Event-based stop, used by the chip exporter (metrics.py), the fabric
+exporter (fabric.py) and the serving exporter (request_metrics.py) so
+serving fixes land in one place."""
 
 from __future__ import annotations
 
@@ -20,10 +21,17 @@ class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
 
 class ExporterBase:
     """Subclasses provide self.registry, self.port, self.interval, and
-    poll_once(); this base owns the HTTP thread + poll loop + stop."""
+    poll_once(); this base owns the HTTP thread + poll loop + stop.
+
+    port 0 binds an ephemeral port (the OS picks one) — `bound_port`
+    holds the actual port after start_background(), so tests and CI
+    never hard-code ports that can collide. The bind host comes from
+    self.host when a subclass sets it; the default stays all-interfaces
+    for parity with the reference exporters."""
 
     _stop: threading.Event
     name = "exporter"
+    host = ""  # all interfaces, like the reference's :2112 listener
 
     def poll_once(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError
@@ -31,13 +39,17 @@ class ExporterBase:
     def start_background(self) -> None:
         app = make_wsgi_app(self.registry)
         self._httpd = wsgiref.simple_server.make_server(
-            "", self.port, app, handler_class=_QuietHandler)
-        threading.Thread(target=self._httpd.serve_forever, daemon=True,
-                         name=f"{self.name}-http").start()
-        threading.Thread(target=self._poll_loop, daemon=True,
-                         name=f"{self.name}-poll").start()
-        log.info("%s serving on :%d/metrics", self.name,
-                 self._httpd.server_address[1])
+            self.host, self.port, app, handler_class=_QuietHandler)
+        self.bound_port = self._httpd.server_address[1]
+        self._threads = [
+            threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                             name=f"{self.name}-http"),
+            threading.Thread(target=self._poll_loop, daemon=True,
+                             name=f"{self.name}-poll"),
+        ]
+        for t in self._threads:
+            t.start()
+        log.info("%s serving on :%d/metrics", self.name, self.bound_port)
 
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
@@ -48,6 +60,12 @@ class ExporterBase:
             self._stop.wait(self.interval)
 
     def stop(self) -> None:
+        """Stop serving and join both threads (bounded: the poll loop
+        wakes on the event, the HTTP loop on shutdown())."""
         self._stop.set()
         if getattr(self, "_httpd", None):
             self._httpd.shutdown()
+        for t in getattr(self, "_threads", []):
+            t.join(timeout=10)
+        if getattr(self, "_httpd", None):
+            self._httpd.server_close()

@@ -33,17 +33,38 @@ def maybe_profile(log_dir: str | None = None):
         return
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception:
+        # E.g. a trace is already active in this process, or the
+        # backend lacks profiler support. Profiling is observability —
+        # it must never kill the bench/server it wraps.
+        log.exception("profiler start_trace(%s) failed; continuing "
+                      "unprofiled", log_dir)
+        yield False
+        return
     log.info("profiler trace -> %s", log_dir)
     try:
         yield True
     finally:
-        jax.profiler.stop_trace()
-        log.info("profiler trace written to %s", log_dir)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            log.exception("profiler stop_trace failed; trace in %s may "
+                          "be incomplete", log_dir)
+        else:
+            log.info("profiler trace written to %s", log_dir)
 
 
 def annotate(name: str):
-    """Named region in the trace timeline (TraceAnnotation)."""
-    import jax
+    """Named region in the trace timeline (TraceAnnotation). Serving
+    regions follow the scheme `serve/<tick>` (admit, prefill_chunk,
+    decode_tick — cli/serve.py) so xplane traces line up with the
+    request-metrics timeline. Falls back to a no-op context when jax
+    is unavailable so host-only tools can still import callers."""
+    try:
+        import jax
 
-    return jax.profiler.TraceAnnotation(name)
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - jax is present in CI
+        return contextlib.nullcontext()

@@ -9,7 +9,7 @@ from concurrent import futures
 
 import grpc
 import pytest
-from prometheus_client import generate_latest
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
 
 from container_engine_accelerators_tpu.deviceplugin import (
     MockDeviceInfo,
@@ -27,6 +27,7 @@ from container_engine_accelerators_tpu.metrics import podresources_pb2 as pb
 from container_engine_accelerators_tpu.metrics.devices import (
     add_podresources_servicer,
 )
+from container_engine_accelerators_tpu.metrics.serving import ExporterBase
 from tests.test_deviceplugin import make_fake_devfs
 
 
@@ -135,6 +136,10 @@ def test_metric_server_scrape(tmp_path):
             'pod="train-0",tpu_chip="accel1"} 85.5' in text)
     assert ('memory_used{container="main",model="v5e",namespace="ml",'
             'pod="train-0",tpu_chip="accel1"} 8.589934592e+09' in text)
+    # Renamed to match the reference's request_* family; the old name
+    # stays registered as a deprecated alias for one release.
+    assert ('request_tpu_chips{container="main",namespace="ml",'
+            'pod="train-0"} 1.0' in text)
     assert ('request{container="main",namespace="ml",pod="train-0"} 1.0'
             in text)
     # Chip 0 has no container attribution: node-level only.
@@ -207,3 +212,57 @@ def test_metric_server_samples_once_per_chip_and_clears_node(tmp_path):
         assert 'node_duty_cycle{model="v5e",tpu_chip="accel1"}' not in text
     finally:
         srv.stop()
+
+
+# ---------- ExporterBase serving scaffold ----------
+
+class FlakyExporter(ExporterBase):
+    """Minimal subclass: ephemeral port, fast poll, first poll raises."""
+
+    name = "test-exporter"
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.polls_gauge = Gauge("test_polls", "completed polls",
+                                 registry=self.registry)
+        self.port = 0            # ephemeral: no hard-coded CI ports
+        self.interval = 0.01
+        self._stop = threading.Event()
+        self.polls = 0
+
+    def poll_once(self):
+        self.polls += 1
+        if self.polls == 1:
+            raise RuntimeError("injected first-poll failure")
+        self.polls_gauge.set(self.polls)
+
+
+def test_exporter_ephemeral_port_scrape_and_poll_survival():
+    """port=0 binds an OS-chosen port exposed as bound_port; the poll
+    loop keeps serving after a poll_once exception; /metrics over the
+    ephemeral port returns the registered families."""
+    import urllib.request
+
+    exp = FlakyExporter()
+    exp.start_background()
+    try:
+        assert exp.bound_port > 0
+        deadline = time.monotonic() + 30
+        while exp.polls < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert exp.polls >= 3, "poll loop died after the injected failure"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.bound_port}/metrics",
+                timeout=10) as resp:
+            text = resp.read().decode()
+        assert "test_polls" in text
+    finally:
+        exp.stop()
+
+
+def test_exporter_stop_joins_threads():
+    exp = FlakyExporter()
+    exp.start_background()
+    exp.stop()
+    for t in exp._threads:
+        assert not t.is_alive(), t.name

@@ -44,3 +44,10 @@ def test_tiny_smoke_emits_all_engine_dtype_combos(monkeypatch, capsys):
     for ln in lines:
         assert ln["tokens_per_s"] > 0
         assert ln["step_ms"] > 0
+        # Recorder-derived latency percentile columns (ISSUE 2): every
+        # cell carries p50/p95/p99 TTFT and TPOT in ms, ordered.
+        for col in ("ttft_ms", "tpot_ms", "decode_step_ms"):
+            pcts = ln[col]
+            assert set(pcts) == {"p50", "p95", "p99"}, (col, pcts)
+            assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"], \
+                (col, pcts)

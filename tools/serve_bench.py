@@ -11,6 +11,15 @@ adjacent JSON lines. Reports tokens/s (= slots x steps/s) and per-step
 latency; tunnel discipline throughout (steps enqueued back-to-back,
 one scalar fence per window).
 
+Each (engine, kv_dtype) line also carries p50/p95/p99 TTFT and TPOT
+columns, derived from a RequestRecorder (metrics/request_metrics.py —
+the same observations the serving exporter scrapes) fed by a SECOND,
+per-step-fenced window: the throughput loop above is deliberately
+fence-free, so per-step latency tails are invisible to it. This bench
+has no prefill/queue stage, so its "TTFT" is the first decode step's
+latency — the decode floor under the serving number, not the serving
+number itself.
+
 Usage:  python tools/serve_bench.py [--slots 8,16,32] [--steps 64]
                                     [--kv-dtypes bf16,int8]
 """
@@ -45,6 +54,48 @@ def build_page_tables(n_slots: int, max_pages: int):
     tables = np.arange(1, n_pages, dtype=np.int32).reshape(
         n_slots, max_pages)
     return tables, n_pages
+
+
+def latency_percentile_phase(params, cache, step, toks, active,
+                             n_slots, max_len, n_steps):
+    """Per-step-fenced window feeding a RequestRecorder: each slot is
+    treated as one in-flight request, every step is fenced (this phase
+    measures LATENCY; the throughput number comes from the fence-free
+    loop), and the recorder's retained samples yield the p50/p95/p99
+    TTFT/TPOT columns. Returns the recorder."""
+    import time
+
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+
+    rec = RequestRecorder()
+    # Restart mid-sequence, like the warmup reset: the throughput loop
+    # advanced the lengths, and two phases of args.steps must not push
+    # a slot past its logical capacity.
+    cache = cache._replace(
+        length=jnp.full((n_slots,), max_len // 2, jnp.int32))
+    now = time.monotonic()
+    for s in range(n_slots):
+        rec.enqueue(s, now=now)
+        rec.admit(s, now=now)
+    for k in range(max(n_steps, 2)):
+        t0 = time.monotonic()
+        last, cache = step(params, cache, toks, active)
+        toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        float(jnp.sum(last))  # per-step fence (latency, not throughput)
+        now = time.monotonic()
+        rec.observe_decode_step(now - t0)
+        for s in range(n_slots):
+            if k == 0:
+                rec.first_token(s, now=now)
+            else:
+                rec.decode_token(s, now=now)
+    for s in range(n_slots):
+        rec.finish(s)
+    return rec
 
 
 def main():
@@ -126,12 +177,22 @@ def main():
                     toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 float(jnp.sum(last))
                 dt = (time.perf_counter() - t0) / args.steps
+
+                rec = latency_percentile_phase(
+                    params, cache, step, toks, active, n_slots,
+                    max_len, min(args.steps, 32))
                 print(json.dumps({
                     "engine": engine, "slots": n_slots,
                     "kv_dtype": kv_dtype,
                     "step_ms": round(dt * 1e3, 3),
                     "tokens_per_s": round(n_slots / dt, 1),
                     "max_len": max_len,
+                    # Recorder-derived percentile columns (ms). TTFT
+                    # here = first fenced decode step (no prefill/queue
+                    # in this harness); TPOT = per-step inter-token gap.
+                    "ttft_ms": rec.pct_ms("ttft"),
+                    "tpot_ms": rec.pct_ms("tpot"),
+                    "decode_step_ms": rec.pct_ms("decode_step"),
                 }), flush=True)
 
 
