@@ -57,6 +57,13 @@ hbm-plan:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serve_metrics.py -q
 
+# Training-observability smoke: tiny CPU fit with metrics-port=0,
+# /metrics scraped mid-run (step/goodput/MFU/watchdog families
+# asserted), JSONL step log re-parsed after a mid-line truncation,
+# synthetic stalled heartbeat trips train_stalled. Fast tier-1.
+train-obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_train_metrics.py -q
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
@@ -66,4 +73,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
-    perf hbm-plan obs-smoke dryrun clean
+    perf hbm-plan obs-smoke train-obs-smoke dryrun clean

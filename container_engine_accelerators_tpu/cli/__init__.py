@@ -1,1 +1,2 @@
-"""Entry points: device plugin daemon, partition_tpu one-shot, tpu-info."""
+"""Entry points: device plugin daemon, partition_tpu one-shot, tpu-info,
+serve (inference engines), train (fit + training observability)."""

@@ -1,0 +1,138 @@
+"""Training entrypoint with the full observability stack (ISSUE 3).
+
+Runs `training/train.py fit()` — checkpoint/auto-resume included — over
+synthetic or token-file data on the local devices, with:
+
+  --metrics-port    TrainMetricsExporter on /metrics (0 = ephemeral):
+                    step/data-wait/ckpt histograms, tokens/s, analytic
+                    MFU, goodput buckets, watchdog gauges
+  --metrics-log     crash-safe JSONL step log (parseable at any
+                    truncation point; metrics.read_metrics_jsonl)
+  --heartbeat-dir   per-process heartbeat files + HangWatchdog: a
+                    stalled process trips `train_stalled` with the
+                    straggler's id instead of hanging silently
+
+Multi-host: initialize_from_env() picks up the JobSet/Indexed-Job env
+contract (parallel/distributed.py); each process heartbeats under its
+own id, so one watchdog watching a shared heartbeat dir names the
+straggling rank. Set TPU_PROFILE_DIR to capture an xplane trace whose
+`train/*` annotations line up with the metric timeline.
+
+Prints one JSON summary line (throughput, MFU, step percentiles,
+goodput split) on exit — machine-parseable like bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+log = logging.getLogger(__name__)
+
+PRESETS = ("tiny", "1b", "8b")
+
+
+def build_config(preset: str, vocab_size: int | None):
+    from container_engine_accelerators_tpu.models import llama
+
+    if preset == "tiny":
+        return llama.llama_tiny(
+            **({"vocab_size": vocab_size} if vocab_size else {}))
+    if preset == "1b":
+        return llama.llama3_1b()
+    return llama.llama3_8b()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", choices=PRESETS, default="tiny")
+    p.add_argument("--vocab-size", type=int, default=None,
+                   help="tiny preset only: override vocab (synthetic "
+                        "data follows it)")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--data", default=None,
+                   help="token file (training/dataset.py format); "
+                        "default: deterministic synthetic stream")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve training metrics on this port; 0 binds "
+                        "an ephemeral port (logged at startup); omit "
+                        "to disable the exporter")
+    p.add_argument("--metrics-host", default="",
+                   help="bind host for the metrics exporter (default: "
+                        "all interfaces)")
+    p.add_argument("--metrics-log", default=None,
+                   help="append one JSON line per step to this file "
+                        "(line-buffered; survives any kill)")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="per-process heartbeat files + hang watchdog")
+    p.add_argument("--watchdog-threshold", type=float, default=300.0,
+                   help="seconds a heartbeat may age before "
+                        "train_stalled fires")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder,
+    )
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes,
+        make_mesh,
+    )
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_env,
+    )
+    from container_engine_accelerators_tpu.training import make_optimizer
+    from container_engine_accelerators_tpu.training.train import fit
+
+    initialize_from_env()
+    import jax
+
+    cfg = build_config(args.preset, args.vocab_size)
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshAxes(fsdp=n_dev), devices=jax.devices())
+
+    if args.data:
+        from container_engine_accelerators_tpu.training.dataset import (
+            token_file_batches,
+        )
+        batches = token_file_batches(
+            args.data, args.batch_size, args.seq_len,
+            process_id=jax.process_index(),
+            num_processes=jax.process_count(), seed=args.seed)
+    else:
+        from container_engine_accelerators_tpu.training.data import (
+            synthetic_batches,
+        )
+        batches = synthetic_batches(cfg.vocab_size, args.batch_size,
+                                    args.seq_len, seed=args.seed)
+
+    # The CLI owns the recorder (fit would also build one) so the final
+    # summary line can be printed after fit returns.
+    recorder = TrainRecorder(log_path=args.metrics_log,
+                             heartbeat_dir=args.heartbeat_dir)
+    opt = make_optimizer()
+    state, _ = fit(cfg, mesh, opt, batches,
+                   ckpt_dir=args.ckpt_dir, save_every=args.save_every,
+                   max_steps=args.steps, log_every=args.log_every,
+                   log_fn=log.info, recorder=recorder,
+                   metrics_port=args.metrics_port,
+                   metrics_host=args.metrics_host,
+                   heartbeat_dir=args.heartbeat_dir,
+                   watchdog_threshold_s=args.watchdog_threshold)
+
+    summary = recorder.summary()
+    summary["final_step"] = int(jax.device_get(state.step))
+    print(json.dumps(summary))
+    recorder.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,6 +19,13 @@ from container_engine_accelerators_tpu.metrics.sampler import (
     SysfsSampler,
     make_sampler,
 )
+from container_engine_accelerators_tpu.metrics.train_metrics import (
+    HangWatchdog,
+    TrainMetricsExporter,
+    TrainRecorder,
+    detect_peak_flops,
+    read_metrics_jsonl,
+)
 
 __all__ = [
     "PodResourcesClient",
@@ -32,4 +39,9 @@ __all__ = [
     "FakeSampler",
     "SysfsSampler",
     "make_sampler",
+    "HangWatchdog",
+    "TrainMetricsExporter",
+    "TrainRecorder",
+    "detect_peak_flops",
+    "read_metrics_jsonl",
 ]

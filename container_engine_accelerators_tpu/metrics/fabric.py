@@ -53,7 +53,8 @@ class FabricMetricServer(ExporterBase):
                  sysfs_net: str = DEFAULT_SYSFS_NET,
                  sysfs_accel: str = DEFAULT_SYSFS_ACCEL,
                  probe_addr: tuple[str, int] | None = None,
-                 port: int = 2113, interval: float = 10.0):
+                 port: int = 2113, interval: float = 10.0,
+                 registry: CollectorRegistry | None = None):
         self.sysfs_net = sysfs_net
         self.sysfs_accel = sysfs_accel
         self.interfaces = interfaces  # None = all non-loopback
@@ -63,7 +64,11 @@ class FabricMetricServer(ExporterBase):
         self._stop = threading.Event()
         self._last: dict[tuple[str, str], tuple[int, float]] = {}
 
-        self.registry = CollectorRegistry()
+        # Shared-registry mode: pass another exporter's registry to
+        # co-serve these gauges on its /metrics port (e.g.
+        # TrainMetricsExporter(co_exporters=[this]) drives poll_once);
+        # don't start_background() on a sharing instance.
+        self.registry = registry or CollectorRegistry()
         self.nic_counter = Gauge(
             "tpu_dcn_nic_stat",
             "Raw NIC counter from /sys/class/net (DCN datapath)",

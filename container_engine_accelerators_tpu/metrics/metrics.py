@@ -26,7 +26,8 @@ NODE_LABELS = ["tpu_chip", "model"]
 class MetricServer(ExporterBase):
     name = "metrics"
     def __init__(self, manager, sampler=None, pod_resources=None,
-                 port: int = 2112, interval: float = 10.0):
+                 port: int = 2112, interval: float = 10.0,
+                 registry: CollectorRegistry | None = None):
         from container_engine_accelerators_tpu.metrics.devices import (
             PodResourcesClient,
         )
@@ -40,7 +41,11 @@ class MetricServer(ExporterBase):
         self.interval = interval
         self._stop = threading.Event()
 
-        self.registry = CollectorRegistry()
+        # Shared-registry mode: co-serve the chip gauges (sysfs sampler
+        # duty-cycle/memory + PodResources attribution) on another
+        # exporter's /metrics port — that exporter calls poll_once();
+        # don't start_background() on a sharing instance.
+        self.registry = registry or CollectorRegistry()
         self.duty_cycle = Gauge(
             "duty_cycle", "TPU chip utilization percent, per container",
             CONTAINER_LABELS, registry=self.registry)

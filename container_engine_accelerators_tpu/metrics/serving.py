@@ -1,7 +1,10 @@
 """Shared Prometheus-exporter scaffold: WSGI server + poll thread +
 Event-based stop, used by the chip exporter (metrics.py), the fabric
-exporter (fabric.py) and the serving exporter (request_metrics.py) so
-serving fixes land in one place."""
+exporter (fabric.py), the serving exporter (request_metrics.py) and
+the training exporter (train_metrics.py) so serving fixes land in one
+place. Exporters that accept a `registry=` can instead co-register on
+another exporter's registry and be driven via its poll loop
+(TrainMetricsExporter(co_exporters=[...])) — one port per node."""
 
 from __future__ import annotations
 

@@ -349,14 +349,16 @@ def _moe_dropless_ep(h: jnp.ndarray, lp: dict, cfg, mesh, ep: int,
         return out_loc, aux, z, dropped
 
     from jax.sharding import PartitionSpec as P
-    smap_kw: dict = {} if in_pipeline else {"mesh": mesh}
-    out, aux, z, dropped = jax.shard_map(
+
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        compat_shard_map,
+    )
+    out, aux, z, dropped = compat_shard_map(
         per_shard,
+        mesh=None if in_pipeline else mesh,
         in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep")),
         out_specs=(P("ep"), P(), P(), P()),
-        axis_names={"ep"},
-        check_vma=False,
-        **smap_kw,
+        manual_axes={"ep"},
     )(h.reshape(n_tok, d), lp["w_router"], lp["w_gate"], lp["w_up"],
       lp["w_down"])
     return out.reshape(b, s, d), MoeMetrics(aux, z, dropped)

@@ -88,10 +88,14 @@ def build_probe(mesh: Mesh, axis: str, collective: str):
     n = mesh.shape[axis]
     fn = _collective_fn(collective, axis, n)
     out_spec = _OUT_SPECS[collective](axis)
-    # check_vma=False: all_gather outputs are replicated over `axis`, which
-    # the varying-mesh-axes inference can't prove statically.
-    mapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(axis),
-                                   out_specs=out_spec, check_vma=False))
+    # VMA checking off (compat_shard_map): all_gather outputs are
+    # replicated over `axis`, which the varying-mesh-axes inference
+    # can't prove statically.
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        compat_shard_map,
+    )
+    mapped = jax.jit(compat_shard_map(fn, mesh=mesh, in_specs=P(axis),
+                                      out_specs=out_spec))
     return mapped, n
 
 

@@ -91,6 +91,10 @@ def make_mesh(axes: MeshAxes | None = None, devices=None) -> Mesh:
             f"mesh axes {axes} need {axes.total} devices, have {len(devices)}")
     # Auto axis types: classic GSPMD propagation (jax>=0.7 defaults to the
     # Explicit sharding-in-types mode, which wants jax.set_mesh contexts).
-    auto = (jax.sharding.AxisType.Auto,) * len(AXIS_NAMES)
+    # jax 0.4.x predates AxisType AND the axis_types kwarg — GSPMD
+    # propagation is its only mode, so plain make_mesh is equivalent.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is None:
+        return jax.make_mesh(axes.as_tuple(), AXIS_NAMES, devices=devices)
     return jax.make_mesh(axes.as_tuple(), AXIS_NAMES, devices=devices,
-                         axis_types=auto)
+                         axis_types=(axis_type.Auto,) * len(AXIS_NAMES))

@@ -242,12 +242,14 @@ def _launch(per_shard, params, x_mb, x, mesh, axis, param_spec,
     """Shared shard_map invocation + microbatch re-flatten for both
     schedules ('pp' manual, every other mesh axis left to GSPMD)."""
     b = x.shape[0]
-    out = jax.shard_map(
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        compat_shard_map,
+    )
+    out = compat_shard_map(
         per_shard, mesh=mesh,
         in_specs=(param_spec, P()),
         out_specs=(P(), P()) if with_aux else P(),
-        axis_names={axis},
-        check_vma=False,
+        manual_axes={axis},
     )(params, x_mb)
     if with_aux:
         y, aux = out

@@ -1,10 +1,54 @@
 """Shared shard_map wrapping for sequence-parallel attention bodies
-(ring and ulysses use the identical layout contract)."""
+(ring and ulysses use the identical layout contract), plus the
+version-compat shard_map entry every manual-region caller routes
+through (pipeline 'pp' regions, MoE 'ep' dispatch, collective probes):
+jax >= 0.5 spells it jax.shard_map(check_vma=, axis_names=); 0.4.x
+keeps it in experimental with check_rep= and the complement-set auto=.
+The tp decode stack (models/decode_tp.py) grew its own shim first —
+this is the same contract for the remaining callers."""
 
 from __future__ import annotations
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def compat_shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                     manual_axes=None):
+    """shard_map across jax versions. `manual_axes=None` makes every
+    mesh axis manual; a set makes only those axes manual (the
+    axis_names= semantic of jax>=0.5). Replication/VMA checking is off
+    either way: the kernels inside these regions have no replication
+    rules, and the invariants hold by construction (psum/all_gather
+    before every replicated output).
+
+    0.4.x supports only the FULL-manual form. Its experimental
+    `auto=` partial-manual mode is not a substitute: depending on the
+    body it either lowers to a PartitionId instruction SPMD
+    partitioning rejects (pipeline regions) or aborts the process
+    inside backend_compile (ep dispatch) — so partial-manual requests
+    fail fast here with a catchable error instead of reaching XLA."""
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": False}
+        if manual_axes is not None:
+            kw["axis_names"] = set(manual_axes)
+        if mesh is not None:
+            kw["mesh"] = mesh
+        return jax.shard_map(f, in_specs=in_specs, out_specs=out_specs,
+                             **kw)
+    if manual_axes is not None:
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names=) requires jax>=0.5; "
+            "this jax only supports fully-manual regions")
+    if mesh is None:
+        raise NotImplementedError(
+            "nested shard_map without an explicit mesh needs the "
+            "AbstractMesh context of jax>=0.5; pass a concrete mesh on "
+            "jax 0.4.x")
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
 
 
 def sp_shard_map(body, mesh: Mesh, axis_name: str, n_args: int):
@@ -13,11 +57,18 @@ def sp_shard_map(body, mesh: Mesh, axis_name: str, n_args: int):
 
     Nested inside another shard_map (e.g. the 'pp' pipeline region) the
     context is an AbstractMesh with some axes already Manual; shard_map
-    then requires that context mesh, not the concrete one."""
-    from jax.sharding import get_abstract_mesh
-
+    then requires that context mesh, not the concrete one (jax>=0.5
+    only — 0.4.x has no abstract-mesh contexts, so the concrete mesh is
+    always used there)."""
     spec = P(("dp", "fsdp"), axis_name, "tp", None)
-    ctx = get_abstract_mesh()
-    use_mesh = ctx if not ctx.empty else mesh
-    return jax.shard_map(body, mesh=use_mesh, in_specs=(spec,) * n_args,
-                         out_specs=spec, check_vma=False)
+    use_mesh = mesh
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:
+        pass
+    else:
+        ctx = get_abstract_mesh()
+        if not ctx.empty:
+            use_mesh = ctx
+    return compat_shard_map(body, mesh=use_mesh,
+                            in_specs=(spec,) * n_args, out_specs=spec)

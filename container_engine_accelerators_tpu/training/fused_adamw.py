@@ -95,7 +95,10 @@ def fused_adamw(learning_rate, b1: float = 0.9, b2: float = 0.999,
                 grad_clip / gnorm.astype(jnp.float32))
         else:
             scale = jnp.ones((), jnp.float32)
-        count_inc = optax.safe_increment(state.count)
+        # optax<0.2.3 spells it safe_int32_increment; same semantics.
+        safe_inc = getattr(optax, "safe_increment", None) \
+            or optax.safe_int32_increment
+        count_inc = safe_inc(state.count)
         lr = schedule(state.count)  # optax scale_by_schedule: pre-inc
         bc1 = 1.0 - b1 ** count_inc.astype(jnp.float32)
         bc2 = 1.0 - b2 ** count_inc.astype(jnp.float32)

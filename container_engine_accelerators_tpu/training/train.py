@@ -10,8 +10,7 @@ params update in place in HBM.
 
 from __future__ import annotations
 
-import functools
-import itertools
+import time
 from typing import Any, Iterator, NamedTuple
 
 import jax
@@ -23,6 +22,10 @@ from container_engine_accelerators_tpu.models import llama
 from container_engine_accelerators_tpu.parallel import sharding as shd
 from container_engine_accelerators_tpu.training.fused_adamw import (
     grad_norm_metric,
+)
+from container_engine_accelerators_tpu.utils.profiling import (
+    annotate,
+    maybe_profile,
 )
 
 
@@ -187,19 +190,54 @@ def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def _host_token_count(batch) -> int:
+    """Non-padding tokens, computed on the HOST batch before it is
+    placed — fetching the on-device `metrics['tokens']` per step would
+    reintroduce exactly the sync the recorder exists to remove."""
+    import numpy as np
+
+    return int(np.sum(np.asarray(batch["targets"]) >= 0))
+
+
 def train_loop(state: TrainState, batches: Iterator, step_fn, mesh: Mesh,
                sequence_parallel: bool = False, log_every: int = 10,
-               log_fn=print):
-    """Minimal host loop; returns final state and last metrics."""
+               log_fn=print, recorder=None):
+    """Minimal host loop; returns final state and last metrics.
+
+    With a `recorder` (metrics/train_metrics.TrainRecorder), every step
+    edge is recorded — data wait vs. dispatch split, tokens, loss at
+    log boundaries — and the phases carry xplane `train/*` annotations
+    so a trace lines up with the metric timeline."""
     metrics = None
-    for i, batch in enumerate(batches):
-        batch = shard_batch(batch, mesh, sequence_parallel)
-        state, metrics = step_fn(state, batch)
+    it = iter(batches)
+    i = 0
+    while True:
+        t0 = time.perf_counter()
+        try:
+            with annotate("train/data_wait"):
+                batch = next(it)
+        except StopIteration:
+            break
+        t1 = time.perf_counter()
+        tokens = _host_token_count(batch) if recorder is not None else 0
+        with annotate("train/step"):
+            batch = shard_batch(batch, mesh, sequence_parallel)
+            state, metrics = step_fn(state, batch)
+        t2 = time.perf_counter()
+        loss = None
         if log_every and i % log_every == 0:
-            m = jax.device_get(metrics)
+            m = jax.device_get(metrics)  # the only per-loop fence
+            if recorder is not None:
+                recorder.record_host_sync(time.perf_counter() - t2)
+            loss = float(m["loss"])
             log_fn(f"step {int(jax.device_get(state.step))} "
-                   f"loss {float(m['loss']):.4f} "
+                   f"loss {loss:.4f} "
                    f"grad_norm {float(m['grad_norm']):.3f}")
+        if recorder is not None:
+            recorder.record_step(i + 1, compute_s=t2 - t1, tokens=tokens,
+                                 data_wait_s=t1 - t0, loss=loss,
+                                 first=(i == 0))
+        i += 1
     return state, metrics
 
 
@@ -223,7 +261,10 @@ def state_layer_layout(cfg, mesh: Mesh | None) -> dict:
 def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         ckpt_dir: str | None = None, save_every: int = 100,
         max_steps: int | None = None, key=None, log_every: int = 10,
-        log_fn=print):
+        log_fn=print, recorder=None, metrics_port: int | None = None,
+        metrics_host: str = "", metrics_log: str | None = None,
+        heartbeat_dir: str | None = None,
+        watchdog_threshold_s: float = 300.0):
     """Train with checkpoint/auto-resume — the elastic-recovery loop
     (SURVEY.md §5: the reference's recovery is node-level repair; the
     workload-level half is resuming from the latest checkpoint after a
@@ -235,6 +276,25 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     deterministic stream from step 0 (training/dataset.py streams are).
     Saves every `save_every` steps and at the end. Returns
     (state, last_metrics).
+
+    Observability (metrics/train_metrics.py): a `recorder` — passed in,
+    or built here when any of `metrics_port` / `metrics_log` /
+    `heartbeat_dir` is set — sees every step edge (data-wait vs.
+    dispatch vs. ckpt-save vs. log-boundary sync), accumulates goodput
+    buckets across resumes (restore + fast-forward are badput), appends
+    a crash-safe JSONL step log, and touches a per-process heartbeat
+    that `HangWatchdog` monitors (started when `heartbeat_dir` is set).
+    `metrics_port` serves it all on /metrics via TrainMetricsExporter
+    (0 = ephemeral; the bound port goes through `log_fn`). The loop
+    phases carry xplane `train/*` annotations and the whole run honors
+    TPU_PROFILE_DIR via maybe_profile.
+
+    The step counter is tracked on the HOST: the device step advances
+    by exactly 1 per `step_fn` call, so fetching it every iteration —
+    as this loop did through round 5 — only blocked async dispatch.
+    The only per-loop fences left are the log-boundary `device_get`
+    (reported as `train_host_sync_seconds`) and actual checkpoint
+    writes.
     """
     import jax.random as jrandom
 
@@ -242,50 +302,140 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         CheckpointManager,
     )
 
+    rec = recorder
+    own_log = rec is None and metrics_log is not None
+    if rec is None and (metrics_port is not None or metrics_log
+                        or heartbeat_dir):
+        from container_engine_accelerators_tpu.metrics.train_metrics import (
+            TrainRecorder,
+        )
+        rec = TrainRecorder(log_path=metrics_log,
+                            heartbeat_dir=heartbeat_dir)
+    watchdog = exporter = None
+    if rec is not None and heartbeat_dir:
+        from container_engine_accelerators_tpu.metrics.train_metrics import (
+            HangWatchdog,
+        )
+        watchdog = HangWatchdog(heartbeat_dir,
+                                threshold_s=watchdog_threshold_s,
+                                registry=rec.registry)
+        watchdog.start()
+    if rec is not None and metrics_port is not None:
+        from container_engine_accelerators_tpu.metrics.train_metrics import (
+            TrainMetricsExporter,
+        )
+        exporter = TrainMetricsExporter(rec, port=metrics_port,
+                                        host=metrics_host,
+                                        watchdog=watchdog)
+        exporter.start_background()
+        log_fn(f"train metrics on :{exporter.bound_port}/metrics")
+
     key = key if key is not None else jrandom.key(0)
     state = create_train_state(key, cfg, mesh, optimizer)
     mngr = None
     layout = state_layer_layout(cfg, mesh)
     if ckpt_dir:
         mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
+        t0 = time.perf_counter()
         restored = mngr.restore(state, layout=layout)
         if restored is not None:
             state = restored
-            log_fn(f"resumed from step {int(jax.device_get(state.step))}")
+            resumed_step = int(jax.device_get(state.step))
+            if rec is not None:
+                rec.record_restore(time.perf_counter() - t0,
+                                   step=resumed_step)
+            log_fn(f"resumed from step {resumed_step}")
 
     step_fn = make_train_step(cfg, mesh, optimizer)
     sp = cfg.sequence_parallel
     start_step = int(jax.device_get(state.step))
-    if start_step:
-        # Skip already-consumed data; without this, every resume would
-        # re-train on the stream's first start_step batches.
-        batches = itertools.islice(batches, start_step, None)
     metrics = None
     it = iter(batches)
-    i = 0
-    while True:
-        step_no = start_step + i
-        if max_steps is not None and step_no >= max_steps:
-            break
-        try:
-            batch = next(it)
-        except StopIteration:
-            break
-        batch = shard_batch(batch, mesh, sp)
-        state, metrics = step_fn(state, batch)
-        cur = int(jax.device_get(state.step))
+    if start_step:
+        # Skip already-consumed data; without this, every resume would
+        # re-train on the stream's first start_step batches. Consumed
+        # eagerly (islice-equivalent) so the replay time is attributable
+        # to the restore bucket, not the first step's data wait.
+        t0 = time.perf_counter()
+        skipped = 0
+        for _ in range(start_step):
+            try:
+                next(it)
+            except StopIteration:
+                break
+            skipped += 1
+        if rec is not None:
+            rec.record_fast_forward(time.perf_counter() - t0,
+                                    batches=skipped)
+    try:
+        with maybe_profile():
+            i = 0
+            cur = start_step  # host-tracked; device step stays in lockstep
+            while True:
+                if max_steps is not None and cur >= max_steps:
+                    break
+                t0 = time.perf_counter()
+                try:
+                    with annotate("train/data_wait"):
+                        batch = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                if rec is not None and not rec.model_configured:
+                    from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: E501
+                        detect_peak_flops,
+                    )
+                    rec.configure_model(
+                        cfg.train_flops_per_token(
+                            batch["targets"].shape[-1]),
+                        peak_flops_per_chip=detect_peak_flops(),
+                        n_chips=mesh.devices.size)
+                tokens = _host_token_count(batch) if rec is not None else 0
+                with annotate("train/step"):
+                    batch = shard_batch(batch, mesh, sp)
+                    state, metrics = step_fn(state, batch)
+                t2 = time.perf_counter()
+                cur += 1
+                saved = False
+                save_dt = 0.0
+                if mngr is not None:
+                    with annotate("train/ckpt_save"):
+                        ts = time.perf_counter()
+                        saved = mngr.save(cur, state, layout=layout,
+                                          cfg=cfg)
+                        save_dt = time.perf_counter() - ts
+                loss = None
+                if log_every and i % log_every == 0:
+                    ts = time.perf_counter()
+                    m = jax.device_get(metrics)  # log-boundary fence
+                    if rec is not None:
+                        rec.record_host_sync(time.perf_counter() - ts)
+                    loss = float(m["loss"])
+                    log_fn(f"step {cur} loss {loss:.4f}")
+                if rec is not None:
+                    rec.record_step(cur, compute_s=t2 - t1, tokens=tokens,
+                                    data_wait_s=t1 - t0, loss=loss,
+                                    first=(i == 0))
+                    if saved:
+                        rec.record_checkpoint_save(save_dt)
+                i += 1
         if mngr is not None:
-            mngr.save(cur, state, layout=layout, cfg=cfg)
-        if log_every and i % log_every == 0:
-            m = jax.device_get(metrics)
-            log_fn(f"step {cur} loss {float(m['loss']):.4f}")
-        i += 1
-    if mngr is not None:
-        final = int(jax.device_get(state.step))
-        if mngr.latest_step() != final:
-            mngr.save(final, state, force=True, layout=layout, cfg=cfg)
-        mngr.wait()
-        mngr.close()
+            if mngr.latest_step() != cur:
+                ts = time.perf_counter()
+                mngr.save(cur, state, force=True, layout=layout, cfg=cfg)
+                if rec is not None:
+                    rec.record_checkpoint_save(time.perf_counter() - ts)
+            mngr.wait()
+            mngr.close()
+    finally:
+        if rec is not None:
+            rec.goodput()
+        if exporter is not None:
+            exporter.stop()
+        if watchdog is not None:
+            watchdog.stop()
+        if own_log and rec is not None:
+            rec.close()
     return state, metrics
 
 

@@ -59,9 +59,11 @@ def maybe_profile(log_dir: str | None = None):
 def annotate(name: str):
     """Named region in the trace timeline (TraceAnnotation). Serving
     regions follow the scheme `serve/<tick>` (admit, prefill_chunk,
-    decode_tick — cli/serve.py) so xplane traces line up with the
-    request-metrics timeline. Falls back to a no-op context when jax
-    is unavailable so host-only tools can still import callers."""
+    decode_tick — cli/serve.py); training regions follow `train/<phase>`
+    (data_wait, step, ckpt_save — training/train.py), so xplane traces
+    line up with the request-metrics / train-metrics timelines. Falls
+    back to a no-op context when jax is unavailable so host-only tools
+    can still import callers."""
     try:
         import jax
 

@@ -17,6 +17,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import __graft_entry__ as entry
 
 
+@pytest.fixture(autouse=True)
+def _bench_sidecar_to_tmp(tmp_path, monkeypatch):
+    """The bench tests below drive bench's outage/ladder paths, which
+    stream partial results to the JSONL sidecar — route it into the
+    test tmpdir so suite runs never litter the repo root."""
+    import bench
+
+    monkeypatch.setenv("BENCH_JSONL_PATH", str(tmp_path / "partial.jsonl"))
+    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)
+
+
 def test_env_forces_cpu_mesh_detection(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv(

@@ -1,0 +1,324 @@
+"""Training observability (ISSUE 3): goodput bucket accounting across a
+synthetic resume, MFU math pinned against a hand-computed config, hang
+watchdog on stale/live heartbeats, crash-safe JSONL after mid-line
+truncation, /metrics scrape smoke on an ephemeral port (the
+`make train-obs-smoke` anchor), fit() end-to-end, shared-registry
+co-serving, and bench.py's partial-results sidecar."""
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from container_engine_accelerators_tpu.metrics.train_metrics import (
+    HangWatchdog,
+    TrainMetricsExporter,
+    TrainRecorder,
+    read_metrics_jsonl,
+)
+
+
+def scrape(port: int) -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        return resp.read().decode()
+
+
+# ---------- goodput accounting ----------
+
+def test_goodput_buckets_synthetic_resume():
+    """A resume timeline: restore + fast-forward are badput, the first
+    step is recompile, later steps productive, residual wall-clock is a
+    stall."""
+    rec = TrainRecorder(now=100.0)
+    rec.record_restore(2.0, step=4, now=102.0)
+    rec.record_fast_forward(1.0, batches=4, now=103.0)
+    rec.record_step(5, compute_s=4.0, tokens=100, data_wait_s=0.5,
+                    first=True, now=107.5)
+    rec.record_step(6, compute_s=2.0, tokens=100, data_wait_s=0.5,
+                    now=110.0)
+    rec.record_checkpoint_save(1.0, now=111.0)
+    g = rec.goodput(now=112.0)
+    assert g["restore"] == pytest.approx(3.0)   # restore + fast-forward
+    assert g["recompile"] == pytest.approx(4.0)
+    assert g["productive"] == pytest.approx(2.0)
+    assert g["checkpoint"] == pytest.approx(1.0)
+    # 1.0s of data waits + 1.0s the loop never accounted for.
+    assert g["stalled"] == pytest.approx(2.0)
+    assert g["elapsed"] == pytest.approx(12.0)
+    assert g["goodput_fraction"] == pytest.approx(2.0 / 12.0)
+    # The gauges export the same split.
+    v = rec.registry.get_sample_value
+    assert v("train_goodput_seconds", {"bucket": "restore"}) == \
+        pytest.approx(3.0)
+    assert v("train_resumes_total") == 1.0
+
+
+def test_goodput_residual_grows_during_hang():
+    """With no step edges at all, elapsed wall-clock accumulates in the
+    stalled bucket — a hang is visible from the poll thread alone."""
+    rec = TrainRecorder(now=0.0)
+    g = rec.goodput(now=50.0)
+    assert g["stalled"] == pytest.approx(50.0)
+    assert g["goodput_fraction"] == 0.0
+
+
+# ---------- MFU ----------
+
+def test_mfu_pinned_against_hand_computed_cfg():
+    from container_engine_accelerators_tpu.models import llama_tiny
+
+    cfg = llama_tiny(vocab_size=64)
+    seq = 32
+    hd = cfg.head_dim
+    attn = cfg.n_layers * cfg.d_model * hd * (
+        2 * cfg.n_heads + 2 * cfg.n_kv_heads)
+    mlp = cfg.n_layers * 3 * cfg.d_model * cfg.d_ff
+    hand = (6.0 * (attn + mlp + cfg.vocab_size * cfg.d_model)
+            + 6.0 * cfg.n_layers * cfg.d_model * seq)
+    fpt = cfg.train_flops_per_token(seq)
+    assert fpt == pytest.approx(hand)
+
+    rec = TrainRecorder(flops_per_token=fpt, peak_flops_per_chip=1e9,
+                        n_chips=2, now=0.0)
+    # First step = compile: excluded from throughput/MFU.
+    rec.record_step(1, compute_s=10.0, tokens=500, first=True, now=10.0)
+    rec.record_step(2, compute_s=2.0, tokens=1000, now=12.0)
+    assert rec.tokens_per_sec() == pytest.approx(500.0)
+    assert rec.mfu() == pytest.approx(500.0 * fpt / (1e9 * 2))
+    assert rec.registry.get_sample_value("train_mfu") == \
+        pytest.approx(rec.mfu())
+
+
+def test_fenced_window_matches_wallclock_estimator():
+    """record_steps (the bench edge): recorder throughput IS the
+    wall-clock estimator."""
+    rec = TrainRecorder(flops_per_token=100.0, peak_flops_per_chip=1e6,
+                        n_chips=1, now=0.0)
+    rec.record_steps(8, 4.0, 8 * 1000, now=4.0)   # 2000 tokens/s
+    rec.record_steps(8, 4.0, 8 * 1000, now=8.0)
+    assert rec.tokens_per_sec() == pytest.approx(2000.0)
+    assert rec.mfu() == pytest.approx(2000.0 * 100.0 / 1e6)
+    # One observation per window, of the per-step average.
+    assert rec.pct("step")["p50"] == pytest.approx(0.5)
+
+
+# ---------- hang watchdog ----------
+
+def test_watchdog_fires_on_stale_and_clears_on_touch(tmp_path):
+    hb = str(tmp_path / "hb")
+    rec = TrainRecorder(heartbeat_dir=hb, process_id=3, now=0.0)
+    rec.record_step(1, compute_s=0.01, tokens=1, now=1.0)
+    wd = HangWatchdog(hb, threshold_s=60.0, registry=rec.registry)
+    v = rec.registry.get_sample_value
+
+    assert wd.check() == []          # live heartbeat: quiet
+    assert v("train_stalled") == 0.0
+    assert v("train_stalled_process") == -1.0
+
+    # Age the heartbeat past the threshold.
+    path = os.path.join(hb, "hb-3")
+    old = time.time() - 120
+    os.utime(path, (old, old))
+    assert wd.check() == [3]
+    assert v("train_stalled") == 1.0
+    assert v("train_stalled_process") == 3.0
+    assert v("train_heartbeat_age_seconds", {"process": "3"}) >= 60.0
+
+    # A new step touches the heartbeat; the gauge clears.
+    rec.record_step(2, compute_s=0.01, tokens=1, now=2.0)
+    assert wd.check() == []
+    assert v("train_stalled") == 0.0
+    assert v("train_stalled_process") == -1.0
+
+
+def test_watchdog_names_oldest_straggler_multiprocess(tmp_path):
+    hb = str(tmp_path / "hb")
+    for pid in (0, 1, 2):
+        TrainRecorder(heartbeat_dir=hb, process_id=pid).record_step(
+            1, compute_s=0.01, tokens=1)
+    now = time.time()
+    os.utime(os.path.join(hb, "hb-1"), (now - 200, now - 200))
+    os.utime(os.path.join(hb, "hb-2"), (now - 400, now - 400))
+    wd = HangWatchdog(hb, threshold_s=100.0)
+    assert wd.check() == [2, 1]      # oldest heartbeat first
+    assert wd.registry.get_sample_value("train_stalled_process") == 2.0
+
+
+# ---------- crash-safe JSONL ----------
+
+def test_jsonl_parseable_after_midline_truncation(tmp_path):
+    path = str(tmp_path / "steps.jsonl")
+    rec = TrainRecorder(log_path=path, now=0.0)
+    for s in range(1, 4):
+        rec.record_step(s, compute_s=0.5, tokens=10, now=float(s))
+    rec.close()
+
+    whole = read_metrics_jsonl(path)
+    assert [r["step"] for r in whole if r["kind"] == "step"] == [1, 2, 3]
+
+    # Kill mid-write: chop the file inside the final line. Every
+    # complete line still parses; the torn tail is skipped.
+    data = open(path, "rb").read()
+    assert data.endswith(b"\n")
+    with open(path, "wb") as f:
+        f.write(data[:-7])
+    partial = read_metrics_jsonl(path)
+    assert [r["step"] for r in partial if r["kind"] == "step"] == [1, 2]
+
+
+def test_jsonl_appends_across_resumes(tmp_path):
+    """The trajectory spans resumes: a second recorder appends to the
+    same log, so restore events and both runs' steps are one stream."""
+    path = str(tmp_path / "steps.jsonl")
+    rec1 = TrainRecorder(log_path=path, now=0.0)
+    rec1.record_step(1, compute_s=0.1, tokens=5, now=1.0)
+    rec1.close()
+    rec2 = TrainRecorder(log_path=path, now=10.0)
+    rec2.record_restore(0.5, step=1, now=10.5)
+    rec2.record_step(2, compute_s=0.1, tokens=5, now=11.0)
+    rec2.close()
+    kinds = [r["kind"] for r in read_metrics_jsonl(path)]
+    assert kinds == ["step", "restore", "step"]
+
+
+# ---------- exporter scrape ----------
+
+def test_exporter_scrape_smoke_port0():
+    rec = TrainRecorder(now=0.0)
+    rec.record_step(1, compute_s=0.1, tokens=64, first=True, now=0.2)
+    rec.record_step(2, compute_s=0.1, tokens=64, now=0.4)
+    exp = TrainMetricsExporter(rec, port=0)
+    exp.start_background()
+    try:
+        body = scrape(exp.bound_port)
+    finally:
+        exp.stop()
+    assert "train_step_seconds_count 2.0" in body
+    assert "train_tokens_total 128.0" in body
+    for family in ("train_tokens_per_sec", "train_mfu",
+                   "train_goodput_seconds", "train_goodput_fraction",
+                   "train_last_step"):
+        assert family in body, family
+
+
+def test_shared_registry_co_serves_fabric_gauges(tmp_path):
+    """Satellite: one /metrics port per node — fabric (and chip) gauges
+    co-register on the train recorder's registry and the train exporter
+    drives their polls."""
+    from container_engine_accelerators_tpu.metrics.fabric import (
+        FabricMetricServer,
+    )
+
+    rec = TrainRecorder(now=0.0)
+    fab = FabricMetricServer(interfaces=[],
+                             sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(tmp_path / "accel"),
+                             registry=rec.registry)
+    assert fab.registry is rec.registry
+    exp = TrainMetricsExporter(rec, port=0, co_exporters=[fab])
+    exp.start_background()
+    try:
+        exp.poll_once()
+        body = scrape(exp.bound_port)
+    finally:
+        exp.stop()
+    assert "train_goodput_seconds" in body
+    assert "tpu_fabric_poll_total" in body       # fabric rode along
+
+
+# ---------- fit() end-to-end (the train-obs-smoke anchor) ----------
+
+def test_fit_exposes_metrics_and_crash_safe_log(tmp_path, mesh8):
+    """Tiny CPU fit with metrics_port=0: /metrics scraped MID-RUN from
+    inside the batch stream exposes the step/goodput/MFU/watchdog
+    families; the JSONL log and heartbeat are on disk afterwards."""
+    from container_engine_accelerators_tpu.models import llama_tiny
+    from container_engine_accelerators_tpu.training import make_optimizer
+    from container_engine_accelerators_tpu.training.data import (
+        synthetic_batches,
+    )
+    from container_engine_accelerators_tpu.training.train import fit
+
+    cfg = llama_tiny(vocab_size=64)
+    opt = make_optimizer(warmup_steps=2, decay_steps=100)
+    jsonl = str(tmp_path / "steps.jsonl")
+    hb = str(tmp_path / "hb")
+    logs = []
+    seen = {}
+
+    def batches():
+        for i, b in enumerate(synthetic_batches(64, 8, 32, num_batches=5)):
+            if i == 4:
+                # The exporter line went through log_fn before step 0.
+                port = int(re.search(r":(\d+)/metrics", logs[0]).group(1))
+                seen["body"] = scrape(port)
+            yield b
+
+    state, _ = fit(cfg, mesh8, opt, batches(), metrics_port=0,
+                   metrics_log=jsonl, heartbeat_dir=hb,
+                   log_every=2, log_fn=logs.append)
+    import jax
+
+    assert int(jax.device_get(state.step)) == 5
+
+    body = seen["body"]
+    for family in ("train_step_seconds", "train_data_wait_seconds",
+                   "train_tokens_per_sec", "train_mfu",
+                   "train_goodput_seconds", "train_host_sync_seconds",
+                   "train_stalled"):
+        assert family in body, family
+    # 4 steps had landed when the stream produced batch index 4.
+    assert "train_steps_total 4.0" in body
+
+    records = read_metrics_jsonl(jsonl)
+    steps = [r for r in records if r["kind"] == "step"]
+    assert [r["step"] for r in steps] == [1, 2, 3, 4, 5]
+    assert steps[0].get("first") is True
+    assert all(r["tokens"] == 8 * 32 for r in steps)
+    # Loss is recorded at log boundaries (log_every=2: steps 1, 3, 5).
+    assert "loss" in steps[0] and "loss" in steps[2]
+    assert os.path.exists(os.path.join(hb, "hb-0"))
+
+
+def test_train_cli_tiny_smoke(tmp_path, capsys):
+    """The `train --metrics-port 0` entrypoint: runs a tiny fit and
+    prints a machine-parseable summary with goodput + throughput."""
+    from container_engine_accelerators_tpu.cli import train as train_cli
+
+    jsonl = str(tmp_path / "steps.jsonl")
+    rc = train_cli.main([
+        "--preset", "tiny", "--vocab-size", "64", "--steps", "3",
+        "--batch-size", "8", "--seq-len", "32", "--metrics-port", "0",
+        "--metrics-log", jsonl,
+        "--heartbeat-dir", str(tmp_path / "hb"),
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["final_step"] == 3
+    assert summary["steps"] == 3
+    assert summary["goodput"]["productive"] > 0
+    assert summary["goodput"]["recompile"] > 0
+    assert len(read_metrics_jsonl(jsonl)) >= 3
+
+
+# ---------- bench.py partial-results sidecar ----------
+
+def test_bench_sidecar_streams_lines(tmp_path, monkeypatch):
+    import bench
+
+    path = str(tmp_path / "partial.jsonl")
+    monkeypatch.setenv("BENCH_JSONL_PATH", path)
+    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)
+    bench._sidecar({"event": "config_start", "config": "x"})
+    bench._sidecar({"event": "window", "config": "x", "window_s": 1.5})
+    # Every line is complete on disk the moment _sidecar returns —
+    # a kill here loses nothing already written.
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["event"] for l in lines] == ["config_start", "window"]
+    assert all("t" in l for l in lines)
+    bench._SIDECAR_FILE.close()
+    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)

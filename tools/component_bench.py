@@ -29,12 +29,21 @@ import jax.numpy as jnp
 
 sys.path.insert(0, ".")
 
-PEAK = 197e12
+from container_engine_accelerators_tpu.metrics.request_metrics import (  # noqa: E402,E501
+    percentile,
+)
+from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: E402,E501
+    detect_peak_flops,
+)
+
 B, S, D, F, H, KV, HD = 5, 2048, 2048, 8192, 16, 8, 128
 L = 8  # scan length — amortizes dispatch, mimics stacked-layer weights
 
 
 def timed(fn, *args, iters=8, warmup=2):
+    """Returns the raw per-iteration times; report() derives the
+    median/p95 through the shared nearest-rank helper
+    (metrics/request_metrics.percentile) instead of local sort math."""
     # Reduce to a scalar INSIDE jit: fetching a large array over the
     # tunnel costs seconds and would swamp the compute being measured.
     sfn = jax.jit(lambda *a: jnp.sum(fn(*a).astype(jnp.float32)))
@@ -45,15 +54,18 @@ def timed(fn, *args, iters=8, warmup=2):
         t0 = time.perf_counter()
         jax.device_get(sfn(*args))
         times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+    return times
 
 
-def report(name, median_s, flops):
+def report(name, times, flops):
+    peak = detect_peak_flops()
+    median_s = percentile(times, 50)
     tflops = flops / median_s / 1e12
     print(json.dumps({
         "component": name, "median_s": round(median_s, 5),
-        "tflops": round(tflops, 1), "frac_peak": round(tflops * 1e12 / PEAK, 3),
+        "p95_s": round(percentile(times, 95), 5),
+        "tflops": round(tflops, 1),
+        "frac_peak": round(tflops * 1e12 / peak, 3),
     }), flush=True)
 
 
@@ -185,7 +197,7 @@ def main():
         y, _ = jax.lax.scan(body, xb, jnp.arange(L))
         return y
 
-    t = timed(norm_rope, xb)
+    t = percentile(timed(norm_rope, xb), 50)
     # report bandwidth instead of flops: bytes ~ L * 4 passes * size
     nbytes = L * 4 * xb.size * 2
     print(json.dumps({
