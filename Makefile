@@ -64,6 +64,16 @@ obs-smoke:
 train-obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_train_metrics.py -q
 
+# Flight-recorder smoke (third member of the obs-smoke family): serve a
+# few requests through a tiny engine with the EventBus enabled, run a
+# short `train` CLI fit in a SECOND process with --trace-dump, `trace
+# merge` the two dumps + the JSONL step log, and assert the merged file
+# is valid Chrome-trace JSON holding request spans, train-step spans
+# and a counter track from two distinct pids. Also covers ring
+# wraparound, the disabled zero-alloc path, SIGUSR2 dumps and /debugz.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_events.py -q
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
@@ -73,4 +83,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
-    perf hbm-plan obs-smoke train-obs-smoke dryrun clean
+    perf hbm-plan obs-smoke train-obs-smoke trace-smoke dryrun clean

@@ -32,10 +32,22 @@ import jax.numpy as jnp
 # Peak-FLOPs table + detection moved to the shared metrics layer in
 # round 6; re-exported here for tools/mfu_sweep.py and any older
 # callers of `from bench import detect_peak_flops`.
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: F401,E501
     PEAK_TFLOPS,
     detect_peak_flops,
 )
+
+
+def enable_trace_sidecar() -> None:
+    """Arm the flight recorder for this bench run: the EventBus ring is
+    dumped as Chrome-trace JSON next to the structured results
+    (BENCH_TRACE_PATH, default BENCH_trace.json) at exit — every bench
+    run yields an openable timeline (windows, recorder counters,
+    profiler markers), not just the one-line JSON."""
+    events.enable(
+        dump_path=os.environ.get("BENCH_TRACE_PATH", "BENCH_trace.json"),
+        signals=True, process_name="bench")
 
 
 _SIDECAR_FILE = None
@@ -58,6 +70,11 @@ def _sidecar(record: dict) -> None:
         rec = dict(record)
         rec.setdefault("t", round(time.time(), 3))
         _SIDECAR_FILE.write(json.dumps(rec) + "\n")
+        # Mirror the JSONL stream onto the flight-recorder timeline so
+        # the trace sidecar shows config starts/windows/failures too.
+        if events.enabled():
+            events.instant(f"bench/{rec.get('event', 'event')}", "bench",
+                           rec)
     except OSError:
         pass  # a sidecar failure must never cost the bench itself
 
@@ -111,6 +128,11 @@ def install_kill_handler() -> None:
             _emit_unavailable(
                 f"killed by signal {signum} mid-run (driver wall-clock "
                 "kill; treat as outage/timeout, not a crash)")
+        # os._exit skips atexit: flush the flight-recorder ring here so
+        # a driver kill still leaves the timeline sidecar (dump_now is
+        # a no-op unless enable_trace_sidecar armed it).
+        events.instant("bench/killed", "flight", {"signal": signum})
+        events.dump_now()
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(0)
@@ -334,10 +356,13 @@ def _run_one(config_name, cfg_overrides, mu_dtype):
     }
     _sidecar({"event": "result", **payload})
     print(json.dumps(payload))
+    # Timeline sidecar lands with the result (atexit is the backstop).
+    events.dump_now()
 
 
 if __name__ == "__main__":
     install_kill_handler()
+    enable_trace_sidecar()
     if not require_backend():
         sys.exit(0)
     try:

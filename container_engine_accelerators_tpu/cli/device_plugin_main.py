@@ -91,11 +91,13 @@ def main(argv=None) -> int:
     manager.discover()
     log.info("discovered %d advertised devices", len(manager.devices))
 
+    metric_server = None
     if args.enable_metrics:
         from container_engine_accelerators_tpu.metrics.metrics import MetricServer
         from container_engine_accelerators_tpu.metrics.sampler import make_sampler
-        MetricServer(manager, sampler=make_sampler(sysfs_root),
-                     port=args.metrics_port).start_background()
+        metric_server = MetricServer(manager, sampler=make_sampler(sysfs_root),
+                                     port=args.metrics_port)
+        metric_server.start_background()
     if (args.runtime_log or cfg.runtime_log_path) \
             and not args.enable_health_monitoring:
         # A scrape target (flag or config) without the checker would be
@@ -118,7 +120,12 @@ def main(argv=None) -> int:
                         "only flip device health, not Node conditions", e)
         if args.runtime_log:
             cfg.runtime_log_path = args.runtime_log
-        checker = TPUHealthChecker(manager, cfg, k8s=k8s)
+        # Health events co-serve on the chip exporter's /metrics port
+        # (tpu_health_events_total / tpu_health_last_event_timestamp) —
+        # previously they were visible only as K8s Events/conditions.
+        checker = TPUHealthChecker(
+            manager, cfg, k8s=k8s,
+            registry=metric_server.registry if metric_server else None)
         threading.Thread(target=checker.run, daemon=True,
                          name="health-checker").start()
     if args.publish_version_annotations:

@@ -61,13 +61,16 @@ log = logging.getLogger("tpu-serve")
 
 def _stream_event(stream, event: dict, rid=None) -> None:
     """Push an event to a request's stream queue (None = not streaming).
-    Every event is stamped with a monotonic timestamp and, when known,
-    the request id — the streaming protocol doubles as a structured
-    event log (timestamps within one request are monotonic, which
-    tests/test_serve_metrics.py pins)."""
+    Every event is stamped with a monotonic timestamp `ts` plus a
+    unix-epoch `t` and, when known, the request id — the streaming
+    protocol doubles as a structured event log (timestamps within one
+    request are monotonic, which tests/test_serve_metrics.py pins; the
+    epoch stamp is what lets a client-saved SSE log merge onto the
+    cross-process flight-recorder timeline, `trace merge --sse-log`)."""
     if stream is not None:
         ev = dict(event)
         ev["ts"] = time.monotonic()
+        ev["t"] = round(time.time(), 6)
         if rid is not None:
             ev["req"] = rid
         stream.put(ev)
@@ -1104,6 +1107,12 @@ def main(argv=None) -> int:
                    help="bind host for the metrics exporter (default: "
                         "all interfaces, matching the reference "
                         "exporters)")
+    p.add_argument("--trace-dump", default=None,
+                   help="enable the flight-recorder EventBus and write "
+                        "its ring as Chrome-trace JSON to this path on "
+                        "exit/crash and on SIGUSR2 (a directory gets a "
+                        "per-pid file); TPU_TRACE_DUMP env is the "
+                        "flagless equivalent")
     p.add_argument("--moe-decode-ep", action="store_true",
                    help="with --tp > 1 on an MoE model: shard experts "
                         "over the tp axis (n_experts/tp per chip + one "
@@ -1111,6 +1120,15 @@ def main(argv=None) -> int:
                         "scales 1/tp (models/decode_tp.py)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    from container_engine_accelerators_tpu.metrics import events
+    if args.trace_dump:
+        events.enable(dump_path=args.trace_dump, signals=True,
+                      process_name="serve")
+        log.info("flight recorder on; trace dump -> %s (SIGUSR2 dumps "
+                 "on demand)", args.trace_dump)
+    else:
+        events.configure_from_env(process_name="serve")
 
     from container_engine_accelerators_tpu.models.convert import load_model
 

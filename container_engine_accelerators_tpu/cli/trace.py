@@ -1,0 +1,123 @@
+"""trace — flight-recorder timeline tooling (metrics/events.py).
+
+Two subcommands:
+
+  trace dump   Trigger or convert EventBus dumps.
+                 --pid P          send SIGUSR2 to a live process that
+                                  was started with a trace dump path
+                                  (--trace-dump / TPU_TRACE_DUMP); it
+                                  writes its ring to that path.
+                 DUMP.json -o OUT rebase one or more raw dumps to a
+                                  single epoch-aligned Chrome trace
+                                  (same machinery as merge).
+
+  trace merge  Merge per-process EventBus dumps, TrainRecorder JSONL
+               step logs (--train-jsonl) and stamped SSE event logs
+               (--sse-log) into ONE clock-aligned Chrome-trace JSON:
+
+                 trace merge serve-trace.json train-trace.json \\
+                     --train-jsonl steps.jsonl --sse-log sse.jsonl \\
+                     -o merged.json
+
+               Open the output at ui.perfetto.dev (or chrome://tracing):
+               one process track per source, request async spans from
+               serving, train-step phases from training, health/fabric
+               instants and counter tracks on the shared timeline.
+
+Exit code 0 on success; 2 on bad usage (argparse).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+
+log = logging.getLogger("tpu-trace")
+
+
+def _write(trace: dict, out_path: str) -> None:
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n = sum(1 for e in trace.get("traceEvents", ())
+            if e.get("ph") != "M")
+    print(f"wrote {out_path}: {n} events from "
+          f"{len((trace.get('otherData') or {}).get('sources', []))} "
+          f"source(s)")
+
+
+def cmd_dump(args) -> int:
+    from container_engine_accelerators_tpu.metrics.events import (
+        merge_traces,
+    )
+
+    if args.pid is not None:
+        os.kill(args.pid, signal.SIGUSR2)
+        print(f"sent SIGUSR2 to pid {args.pid}; the process writes its "
+              "ring to its configured --trace-dump / TPU_TRACE_DUMP "
+              "path")
+        return 0
+    if not args.inputs:
+        print("trace dump: need --pid or at least one dump file",
+              file=sys.stderr)
+        return 2
+    out = args.out or (os.path.splitext(args.inputs[0])[0]
+                       + ".chrome.json")
+    _write(merge_traces(args.inputs), out)
+    return 0
+
+
+def cmd_merge(args) -> int:
+    from container_engine_accelerators_tpu.metrics.events import (
+        merge_traces,
+    )
+
+    if not (args.inputs or args.train_jsonl or args.sse_log):
+        print("trace merge: nothing to merge", file=sys.stderr)
+        return 2
+    _write(merge_traces(args.inputs, args.train_jsonl, args.sse_log),
+           args.out)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="trace", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)  # noqa: E501
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("dump", help="signal a live process to dump, or "
+                                    "convert raw dumps to epoch time")
+    d.add_argument("--pid", type=int, default=None,
+                   help="send SIGUSR2 to this pid (it must have a dump "
+                        "path configured)")
+    d.add_argument("inputs", nargs="*",
+                   help="raw EventBus dump file(s) to rebase/convert")
+    d.add_argument("-o", "--out", default=None,
+                   help="output path (default: <first input>.chrome.json)")
+    d.set_defaults(fn=cmd_dump)
+
+    m = sub.add_parser("merge", help="merge dumps + step logs + SSE "
+                                     "logs into one timeline")
+    m.add_argument("inputs", nargs="*",
+                   help="EventBus dump files (one per process)")
+    m.add_argument("--train-jsonl", action="append", default=[],
+                   help="TrainRecorder JSONL step log (repeatable)")
+    m.add_argument("--sse-log", action="append", default=[],
+                   help="saved SSE event log with epoch `t` stamps "
+                        "(repeatable)")
+    m.add_argument("-o", "--out", required=True)
+    m.set_defaults(fn=cmd_merge)
+
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

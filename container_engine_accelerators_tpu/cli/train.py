@@ -75,8 +75,22 @@ def main(argv=None) -> int:
     p.add_argument("--watchdog-threshold", type=float, default=300.0,
                    help="seconds a heartbeat may age before "
                         "train_stalled fires")
+    p.add_argument("--trace-dump", default=None,
+                   help="enable the flight-recorder EventBus and write "
+                        "its ring as Chrome-trace JSON to this path on "
+                        "exit/crash and on SIGUSR2 (a directory gets a "
+                        "per-pid file); TPU_TRACE_DUMP env is the "
+                        "flagless equivalent")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    from container_engine_accelerators_tpu.metrics import events
+    if args.trace_dump:
+        events.enable(dump_path=args.trace_dump, signals=True,
+                      process_name="train")
+        log.info("flight recorder on; trace dump -> %s", args.trace_dump)
+    else:
+        events.configure_from_env(process_name="train")
 
     from container_engine_accelerators_tpu.metrics.train_metrics import (
         TrainRecorder,

@@ -33,7 +33,10 @@ import os
 import re
 import time
 
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
 from container_engine_accelerators_tpu.deviceplugin.manager import UNHEALTHY
+from container_engine_accelerators_tpu.metrics import events
 
 log = logging.getLogger(__name__)
 
@@ -213,9 +216,23 @@ class TPUHealthChecker:
                  node_name: str | None = None,
                  poll_interval: float = 5.0,
                  boot_id_path: str = BOOT_ID_PATH,
-                 error_log_path: str = DEFAULT_ERROR_LOG):
+                 error_log_path: str = DEFAULT_ERROR_LOG,
+                 registry: CollectorRegistry | None = None):
         self.manager = manager
         self.config = config
+        # Health events were previously invisible to /metrics scrapes
+        # (only K8s Events / the node condition carried them). Pass the
+        # chip exporter's registry (device_plugin_main does) to co-serve
+        # these on the node's scrape port.
+        self.registry = registry or CollectorRegistry()
+        self.health_events = Counter(
+            "tpu_health_events",
+            "TPU health error events observed, by error class",
+            ["error_class"], registry=self.registry)
+        self.health_last_event_ts = Gauge(
+            "tpu_health_last_event_timestamp",
+            "Unix time of the most recent TPU health error event",
+            registry=self.registry)
         if sources is not None:
             self.sources = sources
         else:
@@ -277,7 +294,15 @@ class TPUHealthChecker:
                     ev.chip_index, ev.error_class, ev.message)
         self.error_counts[ev.error_class] = (
             self.error_counts.get(ev.error_class, 0) + 1)
+        self.health_events.labels(error_class=ev.error_class).inc()
+        self.health_last_event_ts.set(time.time())
         critical = ev.error_class in self.config.health_critical_errors
+        if events.enabled():
+            # On the flight-recorder timeline a fabric/chip fault lines
+            # up against the serving/training spans it degraded.
+            events.instant(f"health/{ev.error_class}", "health",
+                           {"chip": ev.chip_index, "critical": critical,
+                            "message": ev.message[:200]})
         if critical:
             self._critical_seen = True
             if ev.chip_index < 0:

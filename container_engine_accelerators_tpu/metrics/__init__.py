@@ -2,9 +2,15 @@
 memory gauges with kubelet PodResources attribution — the analog of the
 reference's metrics package (reference pkg/gpu/nvidia/metrics/)."""
 
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesClient,
     PodResourcesStub,
+)
+from container_engine_accelerators_tpu.metrics.events import (
+    EventBus,
+    merge_traces,
+    write_merged,
 )
 from container_engine_accelerators_tpu.metrics.metrics import MetricServer
 from container_engine_accelerators_tpu.metrics.request_metrics import (
@@ -28,6 +34,10 @@ from container_engine_accelerators_tpu.metrics.train_metrics import (
 )
 
 __all__ = [
+    "events",
+    "EventBus",
+    "merge_traces",
+    "write_merged",
     "PodResourcesClient",
     "PodResourcesStub",
     "MetricServer",

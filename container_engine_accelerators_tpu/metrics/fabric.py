@@ -54,7 +54,9 @@ class FabricMetricServer(ExporterBase):
                  sysfs_accel: str = DEFAULT_SYSFS_ACCEL,
                  probe_addr: tuple[str, int] | None = None,
                  port: int = 2113, interval: float = 10.0,
-                 registry: CollectorRegistry | None = None):
+                 registry: CollectorRegistry | None = None,
+                 collective_probe=None,
+                 collective_probe_interval: float = 600.0):
         self.sysfs_net = sysfs_net
         self.sysfs_accel = sysfs_accel
         self.interfaces = interfaces  # None = all non-loopback
@@ -63,6 +65,16 @@ class FabricMetricServer(ExporterBase):
         self.interval = interval
         self._stop = threading.Event()
         self._last: dict[tuple[str, str], tuple[int, float]] = {}
+        # Opt-in active ICI probe (the reference fabric-metrics-server
+        # analog run from inside the workload): a callable returning
+        # [(collective, axis, busbw_bytes_per_second), ...] — e.g.
+        # ops/collectives.make_probe_hook(mesh, axis). It RUNS a real
+        # collective over the fabric, so it is rate-limited to one
+        # round per `collective_probe_interval` seconds and never
+        # enabled by default.
+        self.collective_probe = collective_probe
+        self.collective_probe_interval = collective_probe_interval
+        self._next_collective_probe = 0.0  # due on the first poll
 
         # Shared-registry mode: pass another exporter's registry to
         # co-serve these gauges on its /metrics port (e.g.
@@ -96,6 +108,12 @@ class FabricMetricServer(ExporterBase):
         self.scrapes = Counter(
             "tpu_fabric_poll_total", "Fabric poll iterations",
             [], registry=self.registry)
+        self.collective_busbw = Gauge(
+            "fabric_collective_busbw_bytes_per_second",
+            "Measured collective bus bandwidth over a mesh axis "
+            "(nccl-tests busBW convention; ops/collectives probe via "
+            "an opt-in rate-limited background hook)",
+            ["collective", "axis"], registry=self.registry)
 
     # ---------- collection ----------
 
@@ -137,6 +155,18 @@ class FabricMetricServer(ExporterBase):
                 self.ici_errors.labels(tpu_chip=chip).set(val)
         if self.probe_addr:
             self._probe()
+        if (self.collective_probe is not None
+                and now >= self._next_collective_probe):
+            # Schedule the next round BEFORE running: a slow/hung probe
+            # must not burst when polls catch up.
+            self._next_collective_probe = (
+                now + self.collective_probe_interval)
+            try:
+                for coll, axis, busbw in self.collective_probe():
+                    self.collective_busbw.labels(
+                        collective=coll, axis=axis).set(busbw)
+            except Exception:
+                log.exception("collective busBW probe failed")
         self.scrapes.inc()
 
     def _probe(self) -> None:

@@ -44,6 +44,7 @@ import time
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.metrics.serving import ExporterBase
 
 # Spans the tiny-model CPU tests (~1 ms steps) through real serving
@@ -159,6 +160,13 @@ class RequestRecorder:
             self._state[rid] = {"stage": "queued", "enqueue_ts": now}
             self._queued += 1
             self.queue_depth.set(self._queued)
+            # Flight-recorder edges (metrics/events.py): the request
+            # becomes one async span on the merged timeline. Guarded so
+            # the disabled path builds no args dict.
+            if events.enabled():
+                events.async_begin("request", rid, "serve")
+                events.counter("serve/queue_depth",
+                               {"queued": self._queued})
 
     def admit(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -174,6 +182,10 @@ class RequestRecorder:
             st["stage"] = "active"
             st["admit_ts"] = now
             self._observe("queue_wait", now - st["enqueue_ts"])
+            if events.enabled():
+                events.async_instant("admit", rid, "serve")
+                events.counter("serve/queue_depth",
+                               {"queued": self._queued})
 
     def first_token(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -185,6 +197,8 @@ class RequestRecorder:
             if "admit_ts" in st:
                 self._observe("prefill", now - st["admit_ts"])
             st["last_tok_ts"] = now
+            if events.enabled():
+                events.async_instant("first_token", rid, "serve")
 
     def decode_token(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -204,6 +218,9 @@ class RequestRecorder:
     def observe_decode_step(self, seconds: float) -> None:
         with self._lock:
             self._observe("decode_step", seconds)
+            if events.enabled():
+                events.counter("serve/decode_step_ms",
+                               {"ms": round(seconds * 1e3, 3)})
 
     def preempt(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -219,6 +236,10 @@ class RequestRecorder:
             st["enqueue_ts"] = now
             st.pop("admit_ts", None)
             st.pop("last_tok_ts", None)
+            if events.enabled():
+                events.async_instant("preempt", rid, "serve")
+                events.counter("serve/queue_depth",
+                               {"queued": self._queued})
 
     def finish(self, rid) -> None:
         self._close(rid, "ok")
@@ -235,16 +256,25 @@ class RequestRecorder:
                 self._queued -= 1
                 self.queue_depth.set(self._queued)
             self.requests.labels(outcome=outcome).inc()
+            if events.enabled():
+                events.async_end("request", rid, "serve",
+                                 {"outcome": outcome})
 
     # ---------- occupancy gauges (set by the worker loop) ----------
 
     def set_slots(self, active: int, total: int) -> None:
         self.active_slots.set(active)
         self.slots_total.set(total)
+        if events.enabled():
+            events.counter("serve/slots", {"active": active,
+                                           "total": total})
 
     def set_kv_pages(self, used: int, total: int) -> None:
         self.kv_pages_in_use.set(used)
         self.kv_pages_total.set(total)
+        if events.enabled():
+            events.counter("serve/kv_pages", {"used": used,
+                                              "total": total})
 
     # ---------- offline summaries ----------
 

@@ -8,13 +8,17 @@ another exporter's registry and be driven via its poll loop
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import urllib.parse
 import wsgiref.simple_server
 
 from prometheus_client import make_wsgi_app
 
 log = logging.getLogger(__name__)
+
+DEBUGZ_DEFAULT_LIMIT = 256
 
 
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
@@ -39,8 +43,36 @@ class ExporterBase:
     def poll_once(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError
 
+    def _make_app(self):
+        """Prometheus WSGI app plus a /debugz route serving the
+        process-wide EventBus's last-N events as JSON (?n= to change N)
+        — the live window onto the flight recorder, on every exporter
+        port, no dump file required."""
+        prom = make_wsgi_app(self.registry)
+
+        def app(environ, start_response):
+            if environ.get("PATH_INFO", "") == "/debugz":
+                from container_engine_accelerators_tpu.metrics import (
+                    events,
+                )
+                qs = urllib.parse.parse_qs(
+                    environ.get("QUERY_STRING", ""))
+                try:
+                    limit = int(qs.get("n", [DEBUGZ_DEFAULT_LIMIT])[0])
+                except (TypeError, ValueError):
+                    limit = DEBUGZ_DEFAULT_LIMIT
+                body = json.dumps(
+                    events.get_bus().debugz(max(limit, 0))).encode()
+                start_response("200 OK", [
+                    ("Content-Type", "application/json"),
+                    ("Content-Length", str(len(body)))])
+                return [body]
+            return prom(environ, start_response)
+
+        return app
+
     def start_background(self) -> None:
-        app = make_wsgi_app(self.registry)
+        app = self._make_app()
         self._httpd = wsgiref.simple_server.make_server(
             self.host, self.port, app, handler_class=_QuietHandler)
         self.bound_port = self._httpd.server_address[1]

@@ -60,6 +60,7 @@ import time
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.metrics.request_metrics import (
     percentiles,
 )
@@ -302,6 +303,20 @@ class TrainRecorder:
             self._goodput_locked(now)
             self._append_log(rec)
             self._touch_heartbeat()
+            # Flight-recorder phases (metrics/events.py): the step edge
+            # is known only retroactively, so emit X (complete) events
+            # spanning [now - dur, now] on the monotonic clock.
+            if events.enabled():
+                cs, dw = max(compute_s, 0.0), max(data_wait_s, 0.0)
+                args = {"step": step, "tokens": tokens}
+                if first:
+                    args["first"] = True
+                if loss is not None:
+                    args["loss"] = loss
+                events.complete("train/step", now - cs, cs, "train", args)
+                if dw > 0:
+                    events.complete("train/data_wait", now - cs - dw, dw,
+                                    "train", {"step": step})
 
     def record_steps(self, n: int, total_s: float, tokens: int,
                      now: float | None = None) -> None:
@@ -327,6 +342,10 @@ class TrainRecorder:
                               "total_s": round(total_s, 6),
                               "tokens": tokens})
             self._touch_heartbeat()
+            if events.enabled():
+                ws = max(total_s, 0.0)
+                events.complete("train/window", now - ws, ws, "train",
+                                {"n": n, "tokens": tokens})
 
     def record_restore(self, seconds: float, step: int | None = None,
                        now: float | None = None) -> None:
@@ -337,6 +356,10 @@ class TrainRecorder:
             self._goodput_locked(now)
             self._append_log({"kind": "restore", "t": round(time.time(), 3),
                               "seconds": round(seconds, 6), "step": step})
+            if events.enabled():
+                s = max(seconds, 0.0)
+                events.complete("train/restore", now - s, s, "train",
+                                {"step": step})
 
     def record_fast_forward(self, seconds: float, batches: int = 0,
                             now: float | None = None) -> None:
@@ -350,6 +373,10 @@ class TrainRecorder:
                               "t": round(time.time(), 3),
                               "seconds": round(seconds, 6),
                               "batches": batches})
+            if events.enabled():
+                s = max(seconds, 0.0)
+                events.complete("train/fast_forward", now - s, s, "train",
+                                {"batches": batches})
 
     def record_checkpoint_save(self, seconds: float,
                                now: float | None = None) -> None:
@@ -361,6 +388,9 @@ class TrainRecorder:
             self._append_log({"kind": "ckpt_save",
                               "t": round(time.time(), 3),
                               "seconds": round(seconds, 6)})
+            if events.enabled():
+                s = max(seconds, 0.0)
+                events.complete("train/ckpt_save", now - s, s, "train")
 
     def record_host_sync(self, seconds: float) -> None:
         """Log-boundary device_get fence. Counted PRODUCTIVE: the wait
@@ -370,6 +400,10 @@ class TrainRecorder:
         with self._lock:
             self._observe("host_sync", self.host_sync, seconds)
             self._buckets["productive"] += max(seconds, 0.0)
+            if events.enabled():
+                s = max(seconds, 0.0)
+                events.complete("train/host_sync", time.monotonic() - s,
+                                s, "train")
 
     # ---------- derived rates / goodput ----------
 
@@ -378,9 +412,15 @@ class TrainRecorder:
         tps = (self._tokens_productive / productive) if productive > 0 \
             else 0.0
         self.tokens_per_sec_g.set(tps)
+        if events.enabled():
+            events.counter("train/tokens_per_sec",
+                           {"tokens_per_sec": round(tps, 1)})
         if self.flops_per_token:
             peak = (self.peak_flops_per_chip or 197e12) * self.n_chips
-            self.mfu_g.set(tps * self.flops_per_token / peak)
+            mfu = tps * self.flops_per_token / peak
+            self.mfu_g.set(mfu)
+            if events.enabled():
+                events.counter("train/mfu", {"mfu": round(mfu, 4)})
 
     def tokens_per_sec(self) -> float:
         """Productive-time throughput over all chips (first-step
@@ -407,6 +447,13 @@ class TrainRecorder:
             self.goodput_g.labels(bucket=bucket).set(secs)
         frac = out["productive"] / elapsed if elapsed > 0 else 0.0
         self.goodput_fraction_g.set(frac)
+        if events.enabled():
+            # One stacked counter track of the goodput split, plus the
+            # scalar throughput tracks the merge's acceptance pins.
+            events.counter("train/goodput",
+                           {k: round(v, 3) for k, v in out.items()})
+            events.counter("train/goodput_fraction",
+                           {"fraction": round(frac, 4)})
         out["elapsed"] = elapsed
         out["goodput_fraction"] = frac
         return out
@@ -517,6 +564,11 @@ class HangWatchdog:
             worst = stragglers[0]
             self.stalled.set(1)
             self.stalled_process.set(worst)
+            if events.enabled():
+                events.instant("train/stalled", "health",
+                               {"process": worst,
+                                "age_s": round(ages[worst], 1),
+                                "overdue": len(stragglers)})
             log.warning(
                 "train stalled: process %d heartbeat is %.0fs old "
                 "(threshold %.0fs; %d process(es) overdue)",
@@ -527,6 +579,8 @@ class HangWatchdog:
         else:
             if self._was_stalled:
                 log.info("train heartbeats recovered")
+                if events.enabled():
+                    events.instant("train/recovered", "health")
             self._was_stalled = False
             self.stalled.set(0)
             self.stalled_process.set(-1)

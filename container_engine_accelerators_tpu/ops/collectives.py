@@ -120,6 +120,7 @@ def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
         out = mapped(x)
     jax.block_until_ready(out)
 
+    m0 = time.monotonic()
     t0 = time.perf_counter()
     for _ in range(iters):
         out = mapped(x)
@@ -130,6 +131,17 @@ def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
     size = per_dev_bytes * n if collective == "all_gather" else per_dev_bytes
     alg_bw = size / dt / 1e9
     bus_bw = alg_bw * _BUS_FACTORS[collective](n)
+    # Flight-recorder record of the probe: the timed window as an X
+    # span plus a busBW counter sample, so a fabric regression lines up
+    # against whatever the timeline shows running next to it.
+    from container_engine_accelerators_tpu.metrics import events
+    if events.enabled():
+        events.complete(f"fabric/probe/{collective}", m0,
+                        time.monotonic() - m0, "fabric",
+                        {"axis": axis, "size_bytes": size,
+                         "time_us": round(dt * 1e6, 1),
+                         "bus_bw_gbps": round(bus_bw, 3)})
+        events.counter("fabric/busbw_gbps", {collective: round(bus_bw, 3)})
     return CollectiveResult(collective, size, dt * 1e6, alg_bw, bus_bw)
 
 
@@ -145,6 +157,27 @@ def sweep(mesh: Mesh, axis: str, collective: str,
                                         warmup=warmup, iters=iters, dtype=dtype))
         size *= factor
     return results
+
+
+def make_probe_hook(mesh: Mesh, axis: str,
+                    collectives=("all_reduce", "all_gather"),
+                    size_bytes: int = 1 << 20, warmup: int = 2,
+                    iters: int = 5):
+    """A low-rate background-probe callable for
+    FabricMetricServer(collective_probe=...): each invocation times the
+    given collectives once at one small size (defaults keep one round
+    well under a second on healthy ICI) and returns
+    [(collective, axis, busbw_bytes_per_second), ...] for the
+    `fabric_collective_busbw_bytes_per_second` gauge family."""
+    def hook():
+        out = []
+        for c in collectives:
+            r = probe_collective(mesh, axis, c, size_bytes,
+                                 warmup=warmup, iters=iters)
+            out.append((c, axis, r.bus_bw_gbps * 1e9))
+        return out
+
+    return hook
 
 
 def report(results: list[CollectiveResult]) -> str:

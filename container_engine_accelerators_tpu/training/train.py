@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.models import llama
 from container_engine_accelerators_tpu.parallel import sharding as shd
 from container_engine_accelerators_tpu.training.fused_adamw import (
@@ -344,6 +345,13 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
             if rec is not None:
                 rec.record_restore(time.perf_counter() - t0,
                                    step=resumed_step)
+            # Resumes are the anchor points of cross-incident forensics
+            # ("did the stall start before or after the restart?") —
+            # mark them on the flight-recorder timeline even when no
+            # recorder is attached.
+            if events.enabled():
+                events.instant("train/resume", "train",
+                               {"step": resumed_step})
             log_fn(f"resumed from step {resumed_step}")
 
     step_fn = make_train_step(cfg, mesh, optimizer)

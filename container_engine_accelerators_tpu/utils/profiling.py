@@ -18,6 +18,8 @@ import contextlib
 import logging
 import os
 
+from container_engine_accelerators_tpu.metrics import events
+
 log = logging.getLogger(__name__)
 
 PROFILE_DIR_ENV = "TPU_PROFILE_DIR"
@@ -44,6 +46,10 @@ def maybe_profile(log_dir: str | None = None):
         yield False
         return
     log.info("profiler trace -> %s", log_dir)
+    # The xplane capture window shows up on the flight-recorder
+    # timeline, so an EventBus dump says whether a given incident is
+    # covered by an xplane trace.
+    events.instant("profile/start", "xplane", {"log_dir": log_dir})
     try:
         yield True
     finally:
@@ -54,6 +60,29 @@ def maybe_profile(log_dir: str | None = None):
                           "be incomplete", log_dir)
         else:
             log.info("profiler trace written to %s", log_dir)
+        events.instant("profile/stop", "xplane")
+
+
+class _AnnotatedSpan:
+    """TraceAnnotation + EventBus B/E pair: the same named region lands
+    in the xplane trace AND on the flight-recorder timeline."""
+
+    __slots__ = ("_name", "_inner")
+
+    def __init__(self, name, inner):
+        self._name = name
+        self._inner = inner
+
+    def __enter__(self):
+        events.get_bus().begin(self._name, "xplane")
+        self._inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            return self._inner.__exit__(*exc)
+        finally:
+            events.get_bus().end(self._name, "xplane")
 
 
 def annotate(name: str):
@@ -63,10 +92,15 @@ def annotate(name: str):
     (data_wait, step, ckpt_save — training/train.py), so xplane traces
     line up with the request-metrics / train-metrics timelines. Falls
     back to a no-op context when jax is unavailable so host-only tools
-    can still import callers."""
+    can still import callers. When the process-wide EventBus is enabled
+    the region is mirrored as a B/E span there too; when disabled the
+    annotation is returned bare — zero added overhead."""
     try:
         import jax
 
-        return jax.profiler.TraceAnnotation(name)
+        ctx = jax.profiler.TraceAnnotation(name)
     except Exception:  # pragma: no cover - jax is present in CI
-        return contextlib.nullcontext()
+        ctx = contextlib.nullcontext()
+    if not events.enabled():
+        return ctx
+    return _AnnotatedSpan(name, ctx)

@@ -131,3 +131,51 @@ def test_http_server_serves_metrics(tmp_path):
         assert "tpu_fabric_poll_total" in body
     finally:
         srv.stop()
+
+
+def test_collective_busbw_probe_hook_rate_limited(tmp_path):
+    """Opt-in background collective probe (ISSUE 4 satellite): results
+    land on fabric_collective_busbw_bytes_per_second{collective,axis},
+    the hook runs at most once per interval, and a failing hook never
+    kills the poll loop."""
+    calls = []
+
+    def hook():
+        calls.append(1)
+        return [("all_reduce", "tp", 1.5e9), ("all_gather", "tp", 2.5e9)]
+
+    srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(tmp_path / "accel"),
+                             collective_probe=hook,
+                             collective_probe_interval=600.0)
+    srv.poll_once(now=100.0)   # first poll: due immediately
+    assert calls == [1]
+    text = scrape(srv)
+    assert ('fabric_collective_busbw_bytes_per_second{axis="tp",'
+            'collective="all_reduce"} 1.5e+09') in text
+    assert ('fabric_collective_busbw_bytes_per_second{axis="tp",'
+            'collective="all_gather"} 2.5e+09') in text
+
+    srv.poll_once(now=300.0)   # inside the interval: rate-limited
+    assert calls == [1]
+    srv.poll_once(now=701.0)   # past it: runs again
+    assert calls == [1, 1]
+
+    # A probe that raises is logged, not fatal, and stays rate-limited.
+    def bad_hook():
+        calls.append("bad")
+        raise RuntimeError("fabric down")
+
+    srv.collective_probe = bad_hook
+    srv.poll_once(now=1400.0)
+    assert calls[-1] == "bad"
+    assert "tpu_fabric_poll_total" in scrape(srv)
+
+
+def test_collective_probe_disabled_by_default(tmp_path):
+    srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(tmp_path / "accel"))
+    srv.poll_once(now=1.0)
+    # Registered but never set: the family exports no samples.
+    assert ("fabric_collective_busbw_bytes_per_second{"
+            not in scrape(srv))

@@ -372,3 +372,66 @@ def test_k8s_client_rereads_token_file(tmp_path, fake_k8s):
         assert captured["auth"] == "Bearer tok-2"
     finally:
         urllib.request.urlopen = orig
+
+
+# ---------- health events on /metrics (ISSUE 4 satellite) ----------
+
+def _sample_value(registry, name, **labels):
+    for metric in registry.collect():
+        for s in metric.samples:
+            if s.name == name and all(
+                    s.labels.get(k) == v for k, v in labels.items()):
+                return s.value
+    return None
+
+
+def test_health_events_exported_to_registry(tmp_path, fake_k8s, client):
+    """Error events become tpu_health_events_total{error_class=...} +
+    tpu_health_last_event_timestamp on the checker's registry — health
+    was previously invisible to /metrics scrapes."""
+    import time as _time
+
+    from prometheus_client import CollectorRegistry
+
+    manager, _ = make_manager(tmp_path)
+    reg = CollectorRegistry()
+    checker, log_path, _ = make_checker(tmp_path, manager, client,
+                                        registry=reg)
+    assert checker.registry is reg  # shared-registry wiring
+    assert _sample_value(reg, "tpu_health_events_total",
+                         error_class="HBM_OOM") is None
+
+    t0 = _time.time()
+    log_path.write_text(
+        '{"chip": 0, "class": "HBM_ECC_UNCORRECTABLE", "message": "x"}\n'
+        '{"chip": 1, "class": "HBM_OOM"}\n'
+        '{"chip": 1, "class": "HBM_OOM"}\n')
+    checker.poll_once()
+
+    assert _sample_value(reg, "tpu_health_events_total",
+                         error_class="HBM_ECC_UNCORRECTABLE") == 1
+    assert _sample_value(reg, "tpu_health_events_total",
+                         error_class="HBM_OOM") == 2
+    ts = _sample_value(reg, "tpu_health_last_event_timestamp")
+    assert ts is not None and ts >= t0
+
+
+def test_health_events_on_flight_recorder(tmp_path, fake_k8s, client):
+    """With the EventBus enabled, every health event also lands on the
+    flight-recorder timeline as a `health/<CLASS>` instant."""
+    from container_engine_accelerators_tpu.metrics import events
+
+    manager, _ = make_manager(tmp_path)
+    checker, log_path, _ = make_checker(tmp_path, manager, client)
+    events._reset_for_tests()
+    events.enable(process_name="health-test")
+    try:
+        log_path.write_text('{"chip": 2, "class": "THERMAL_TRIP"}\n')
+        checker.poll_once()
+        evs = [ev for ev in events.get_bus().snapshot()
+               if ev[3] == "health/THERMAL_TRIP"]
+        assert len(evs) == 1
+        assert evs[0][7]["chip"] == 2
+        assert evs[0][7]["critical"] is True
+    finally:
+        events._reset_for_tests()

@@ -31,11 +31,25 @@ def test_page_tables_fit_declared_pool():
         assert int(np.max(tables)) < n_pages
 
 
-def test_tiny_smoke_emits_all_engine_dtype_combos(monkeypatch, capsys):
+def test_tiny_smoke_emits_all_engine_dtype_combos(monkeypatch, capsys,
+                                                  tmp_path):
+    from container_engine_accelerators_tpu.metrics import events
+
+    trace_path = tmp_path / "serve_bench_trace.json"
     monkeypatch.setattr(sys, "argv",
                         ["serve_bench.py", "--tiny", "--slots", "2",
-                         "--steps", "2"])
-    main()
+                         "--steps", "2", "--trace-out",
+                         str(trace_path)])
+    try:
+        main()
+    finally:
+        events._reset_for_tests()
+    # Flight-recorder sidecar (ISSUE 4 satellite): every bench run
+    # yields an openable Chrome-trace timeline next to its results.
+    trace = json.loads(trace_path.read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "serve_bench/throughput_window" in names
+    assert any(e["ph"] == "C" for e in trace["traceEvents"])
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
     combos = {(ln["engine"], ln["kv_dtype"]) for ln in lines}

@@ -110,7 +110,16 @@ def main():
     ap.add_argument("--tiny", action="store_true",
                     help="llama_tiny on the CPU backend — a smoke test "
                          "of the harness, not a measurement")
+    ap.add_argument("--trace-out", default="serve_bench_trace.json",
+                    help="flight-recorder trace sidecar written next "
+                         "to the JSON result lines (Chrome-trace JSON; "
+                         "empty string disables)")
     args = ap.parse_args()
+
+    from container_engine_accelerators_tpu.metrics import events
+    if args.trace_out:
+        events.enable(dump_path=args.trace_out, signals=True,
+                      process_name="serve_bench")
 
     import jax
 
@@ -168,15 +177,25 @@ def main():
                 cache = cache._replace(
                     length=jnp.full((n_slots,), max_len // 2, jnp.int32))
 
-                t0 = time.perf_counter()
-                last = None
-                for _ in range(args.steps):
-                    last, cache = step(params, cache, toks, active)
-                    # Chain tokens through the cache dependency; greedy
-                    # pick on-device keeps the loop fence-free.
-                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                float(jnp.sum(last))
-                dt = (time.perf_counter() - t0) / args.steps
+                with events.span(
+                        "serve_bench/throughput_window", "bench",
+                        {"engine": engine, "slots": n_slots,
+                         "kv_dtype": kv_dtype}):
+                    t0 = time.perf_counter()
+                    last = None
+                    for _ in range(args.steps):
+                        last, cache = step(params, cache, toks, active)
+                        # Chain tokens through the cache dependency;
+                        # greedy pick on-device keeps the loop
+                        # fence-free.
+                        toks = jnp.argmax(last, axis=-1).astype(
+                            jnp.int32)
+                    float(jnp.sum(last))
+                    dt = (time.perf_counter() - t0) / args.steps
+                if events.enabled():
+                    events.counter(
+                        f"serve_bench/tokens_per_s/{engine}/{kv_dtype}",
+                        {f"slots{n_slots}": round(n_slots / dt, 1)})
 
                 rec = latency_percentile_phase(
                     params, cache, step, toks, active, n_slots,
@@ -194,6 +213,10 @@ def main():
                     "tpot_ms": rec.pct_ms("tpot"),
                     "decode_step_ms": rec.pct_ms("decode_step"),
                 }), flush=True)
+    # Sidecar next to the JSON result lines: the whole sweep as one
+    # openable timeline (atexit also dumps, but a wrapper that keeps
+    # the process alive shouldn't delay the file).
+    events.dump_now()
 
 
 if __name__ == "__main__":
