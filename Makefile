@@ -74,6 +74,17 @@ train-obs-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_events.py -q
 
+# XLA compile + HBM introspection smoke (fourth member of the family):
+# forced recompile counted AND attributed with the exact shape diff,
+# simulated RESOURCE_EXHAUSTED writing a forensics bundle with a
+# live-array census then re-raising, HBM poller scrape, /debugz
+# census, and the disabled-path zero-allocation guard.
+introspect-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_introspection.py -q
+
+# The whole observability smoke family in one target.
+smoke: obs-smoke train-obs-smoke trace-smoke introspect-smoke
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PYTHON) -c "import jax; jax.config.update('jax_platforms','cpu'); \
@@ -83,4 +94,5 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
-    perf hbm-plan obs-smoke train-obs-smoke trace-smoke dryrun clean
+    perf hbm-plan obs-smoke train-obs-smoke trace-smoke \
+    introspect-smoke smoke dryrun clean

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 # Peak-FLOPs table + detection moved to the shared metrics layer in
 # round 6; re-exported here for tools/mfu_sweep.py and any older
 # callers of `from bench import detect_peak_flops`.
-from container_engine_accelerators_tpu.metrics import events
+from container_engine_accelerators_tpu.metrics import events, introspection
 from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: F401,E501
     PEAK_TFLOPS,
     detect_peak_flops,
@@ -353,6 +353,11 @@ def _run_one(config_name, cfg_overrides, mu_dtype):
         "wallclock_mfu": round(wall_mfu, 3),
         "step_ms": step_pcts,
         "config": config_name,
+        # Runtime high-water mark (metrics/introspection.py): lets the
+        # BENCH_r*.json trajectory catch a memory regression the same
+        # way it catches a throughput one. null where the backend
+        # exposes no memory_stats (CPU smoke runs).
+        "peak_hbm_bytes": introspection.peak_hbm_bytes(),
     }
     _sidecar({"event": "result", **payload})
     print(json.dumps(payload))

@@ -47,6 +47,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from container_engine_accelerators_tpu.metrics import introspection
 from container_engine_accelerators_tpu.metrics.request_metrics import (
     RequestRecorder,
     ServeMetricsExporter,
@@ -103,6 +104,22 @@ def _validate_request(tokens, max_new_tokens, max_prompt_len,
         recorder.validation_failures.inc()
     _fail(fut, stream, err, rid)
     return False
+
+
+def _detect_chip() -> str:
+    """Local accelerator generation as a tools/hbm_plan.py chip key;
+    conservative v5e default for unknown kinds (incl. the CPU test
+    backend, where the plan is informational only)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind:
+        return "v5p"
+    if "v6" in kind:
+        return "v6e"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
 
 
 def _use_mesh(mesh):
@@ -263,6 +280,10 @@ class BatchingEngine:
                 self.batches_run += 1
                 self.requests_served += len(batch)
             except Exception as e:
+                # RESOURCE_EXHAUSTED leaves an atomic post-mortem bundle
+                # (per-device memory, live-array census, compile cache,
+                # event ring) before the clients see the failure.
+                introspection.note_failure(e, "serve/window_batch")
                 log.exception("batch failed")
                 for item in batch:
                     _fail(item[3], item[4], e, item[5], rec)
@@ -515,6 +536,7 @@ class ContinuousEngine:
                 if not self._admit_one(item, free[0]):
                     return  # resources exhausted: retry next loop
             except Exception as e:
+                introspection.note_failure(e, "serve/admit")
                 log.exception("admission failed")
                 self._backlog.pop(0)
                 _fail(item[3], item[4], e, item[5], self.recorder)
@@ -547,6 +569,9 @@ class ContinuousEngine:
         try:
             last_logits = self._run_chunk(i, padded, start, new_len)
         except Exception as e:
+            # OOM forensics bundle before recovery tears the pool down:
+            # _reset frees/rebuilds the cache, destroying the evidence.
+            introspection.note_failure(e, "serve/prefill_chunk")
             log.exception("prefill chunk failed")
             self._reset(e)
             return
@@ -599,6 +624,9 @@ class ContinuousEngine:
             # latency covers the device round trip, not just dispatch.
             toks = [int(t) for t in self._pick_fn(logits, temps_arr, key)]
         except Exception as e:
+            # Bundle FIRST: _reset rebuilds the pool, and the census
+            # must capture what was resident when the step died.
+            introspection.note_failure(e, "serve/decode_tick")
             log.exception("decode step failed")
             self._reset(e)
             return
@@ -965,6 +993,7 @@ class PagedContinuousEngine(ContinuousEngine):
                     self._cache, jnp.asarray(pos), jnp.asarray(rws),
                     jnp.asarray(mask))
             except Exception as e:
+                introspection.note_failure(e, "serve/assign_pages")
                 log.exception("assign_pages failed")
                 self._reset(e)
                 return False
@@ -1188,6 +1217,28 @@ def main(argv=None) -> int:
         engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
                                 window_ms=args.batch_window_ms, mesh=mesh,
                                 recorder=recorder)
+    # Runtime introspection (metrics/introspection.py): compile
+    # tracking on — the engines' jitted step paths are watch()-wrapped
+    # in models/decode*.py, so a steady-state recompile logs the shape
+    # diff that caused it — with the tpu_xla_* families co-served on
+    # the request-metrics registry. The hbm_plan expectation rides in
+    # every OOM forensics bundle as "what the budget said should fit".
+    introspection.install(registry=recorder.registry)
+    if args.engine in ("continuous", "paged"):
+        try:
+            from tools.hbm_plan import plan_serving
+            if args.engine == "paged":
+                max_pages = max(engine.max_pages, 1)
+                frac = (args.pool_pages / (args.max_batch * max_pages)
+                        if args.pool_pages else 0.5)
+            else:
+                frac = 1.0  # full slots x max_len reservation
+            introspection.set_expected_hbm(plan_serving(
+                cfg, tp=args.tp, max_slots=args.max_batch,
+                max_len=args.max_len, pool_fraction=frac,
+                kv_dtype=args.kv_dtype, chip=_detect_chip()))
+        except Exception:
+            log.debug("hbm_plan expectation unavailable", exc_info=True)
     if args.metrics_port is not None:
         exporter = ServeMetricsExporter(recorder, port=args.metrics_port,
                                         host=args.metrics_host)

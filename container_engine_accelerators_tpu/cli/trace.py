@@ -1,6 +1,6 @@
 """trace — flight-recorder timeline tooling (metrics/events.py).
 
-Two subcommands:
+Three subcommands:
 
   trace dump   Trigger or convert EventBus dumps.
                  --pid P          send SIGUSR2 to a live process that
@@ -23,6 +23,14 @@ Two subcommands:
                one process track per source, request async spans from
                serving, train-step phases from training, health/fabric
                instants and counter tracks on the shared timeline.
+
+  trace oom    Pretty-print an OOM forensics bundle
+               (metrics/introspection.py writes one next to the trace
+               dump whenever a wrapped device path dies with
+               RESOURCE_EXHAUSTED): the error, per-device memory
+               stats, the top live arrays by size, the compile-cache
+               summary, and the hbm_plan expectation vs what was
+               observed.
 
 Exit code 0 on success; 2 on bad usage (argparse).
 """
@@ -86,6 +94,68 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def _gb(n) -> str:
+    return f"{n / 1e9:.2f} GB" if isinstance(n, (int, float)) else "?"
+
+
+def cmd_oom(args) -> int:
+    try:
+        with open(args.bundle) as f:
+            b = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"trace oom: cannot read {args.bundle}: {e}",
+              file=sys.stderr)
+        return 2
+    if b.get("kind") != "tpu_oom_forensics":
+        print(f"trace oom: {args.bundle} is not an OOM forensics "
+              "bundle", file=sys.stderr)
+        return 2
+
+    err = b.get("error") or {}
+    print(f"OOM forensics bundle (pid {b.get('pid')}, "
+          f"context {b.get('context')!r})")
+    if err:
+        print(f"  error: {err.get('type')}: "
+              f"{(err.get('message') or '')[:200]}")
+
+    for row in b.get("device_memory_stats", []):
+        if not row.get("stats_available"):
+            print(f"  {row.get('device')}: memory_stats unavailable "
+                  f"({row.get('kind')})")
+            continue
+        print(f"  {row.get('device')}: in_use {_gb(row.get('bytes_in_use'))}"
+              f"  peak {_gb(row.get('peak_bytes_in_use'))}"
+              f"  limit {_gb(row.get('bytes_limit'))}")
+
+    plan = (b.get("hbm_plan") or {})
+    cmp_ = plan.get("comparison")
+    if cmp_:
+        print(f"  hbm_plan: expected {cmp_.get('expected_total_gb')} GB "
+              f"(fits={cmp_.get('expected_fits')}), observed peak "
+              f"{cmp_.get('observed_peak_gb')} GB on "
+              f"{cmp_.get('observed_device')}")
+
+    census = b.get("live_array_census") or {}
+    rows = census.get("rows", [])
+    print(f"  live arrays: {census.get('n_arrays', 0)} totalling "
+          f"{_gb(census.get('total_bytes', 0))}; top {min(args.top, len(rows))}:")
+    for row in rows[:args.top]:
+        shard = row.get("sharding", "")
+        print(f"    {_gb(row['nbytes']):>10s}  {row['dtype']}"
+              f"{row['shape']}  {shard[:60]}")
+
+    fns = ((b.get("compile_cache") or {}).get("fns") or {})
+    if fns:
+        print("  compile cache:")
+        for name, d in sorted(fns.items()):
+            print(f"    {name}: {d.get('compiles', 0)} compiles, "
+                  f"{d.get('recompiles', 0)} recompiles, "
+                  f"{d.get('signatures', 0)} signatures")
+    n_ev = len((b.get("recent_events") or {}).get("events", []))
+    print(f"  event ring: {n_ev} recent events in the bundle")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="trace", description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)  # noqa: E501
@@ -113,6 +183,14 @@ def main(argv=None) -> int:
                         "(repeatable)")
     m.add_argument("-o", "--out", required=True)
     m.set_defaults(fn=cmd_merge)
+
+    o = sub.add_parser("oom", help="pretty-print an OOM forensics "
+                                   "bundle (introspection.py)")
+    o.add_argument("bundle", help="bundle JSON written on "
+                                  "RESOURCE_EXHAUSTED")
+    o.add_argument("--top", type=int, default=10,
+                   help="live-array census rows to show")
+    o.set_defaults(fn=cmd_oom)
 
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)

@@ -131,6 +131,22 @@ def main(argv=None) -> int:
     # summary line can be printed after fit returns.
     recorder = TrainRecorder(log_path=args.metrics_log,
                              heartbeat_dir=args.heartbeat_dir)
+    # Runtime introspection: compile tracking with recompile goodput
+    # attribution (fit installs too, but wiring here covers the window
+    # before fit builds its exporter), plus the hbm_plan budget this
+    # run should fit under — embedded in any OOM forensics bundle.
+    from container_engine_accelerators_tpu.metrics import introspection
+    introspection.install(registry=recorder.registry, recorder=recorder)
+    try:
+        from container_engine_accelerators_tpu.cli.serve import (
+            _detect_chip,
+        )
+        from tools.hbm_plan import plan_training
+        introspection.set_expected_hbm(plan_training(
+            cfg, fsdp=n_dev, batch_size=args.batch_size,
+            seq_len=args.seq_len, chip=_detect_chip()))
+    except Exception:
+        log.debug("hbm_plan expectation unavailable", exc_info=True)
     opt = make_optimizer()
     state, _ = fit(cfg, mesh, opt, batches,
                    ckpt_dir=args.ckpt_dir, save_every=args.save_every,

@@ -465,6 +465,9 @@ def _train_jsonl_events(path: str, pid: int) -> list[dict]:
               {"n": rec.get("n"), "tokens": rec.get("tokens")})
         elif kind == "ckpt_save":
             x("train/ckpt_save", t, float(rec.get("seconds", 0.0)), {})
+        elif kind == "recompile":
+            x("train/recompile", t, float(rec.get("seconds", 0.0)),
+              {"fn": rec.get("fn")})
         elif kind == "restore":
             x("train/restore", t, float(rec.get("seconds", 0.0)),
               {"step": rec.get("step")})

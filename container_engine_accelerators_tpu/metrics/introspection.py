@@ -1,0 +1,765 @@
+"""Runtime XLA + HBM introspection (ISSUE 5 tentpole): the layer that
+turns the flight-recorder's "what happened" timeline into "why it
+died". Three instruments, one module:
+
+**Compile tracker + recompile attributor.** The serve engines
+bucket-pad every shape *specifically* to keep the jit caches hot
+(cli/serve.py), and the training loop charges only its FIRST step to
+compilation — yet nothing verified either claim. `CompileTracker`
+hooks `jax.monitoring`'s duration listeners (version-guarded: older
+jax without the API degrades to a logged fingerprint-only mode, same
+pattern as `compat_shard_map`) and exports
+
+    tpu_xla_compiles_total{fn}          backend compiles per entrypoint
+    tpu_xla_recompiles_total{fn}        steady-state recompiles
+    tpu_xla_compile_seconds{fn,phase}   trace / lower / compile time
+
+`watch(fn, name)` wraps a jitted callable: while the tracker is
+enabled, each call runs under a thread-local attribution context so
+compile durations land on the right `fn` label; when a compile fires
+*after* the function's first one, the wrapper fingerprints the call's
+abstract signature (shape/dtype per leaf, path-keyed), diffs it
+against the previous compile's signature, and logs exactly which
+leaf/dimension changed — the single log line that separates "someone
+sent an unbucketed prompt" from "the compilation cache was evicted".
+The recompile's compile-seconds also move into an attached
+TrainRecorder's `recompile` goodput bucket (mid-run attribution, not
+just the first-step heuristic). Disabled, the wrapper is one global
+attribute check — no allocation, guard-tested with the tracemalloc
+harness.
+
+**HBM poller + live-array census.** `HbmPoller` samples per-device
+`memory_stats()` (version/backend-guarded; CPU and old jax degrade to
+a logged idle poller) into `tpu_hbm_bytes_in_use / peak / limit`
+gauges and `hbm/<device>` EventBus counter tracks; both serving and
+training exporters drive one automatically, so every `--metrics-port`
+carries live memory telemetry. `live_array_census()` ranks
+`jax.live_arrays()` by nbytes with shape/dtype/sharding — served on
+every exporter's `/debugz?census=1` for "what exactly is resident
+RIGHT NOW" without a debugger.
+
+**OOM forensics.** A bare `RESOURCE_EXHAUSTED` names the allocation
+that lost the race, not the residents that won it. `note_failure(exc,
+context)` (called from the engines' failure paths and the train loops'
+`oom_forensics` wrap) recognizes resource exhaustion and writes an
+atomic post-mortem bundle next to the trace dump — per-device memory
+stats, the live-array census, the compile-cache summary, the recent
+event ring, and the `tools/hbm_plan.py` expectation vs what was
+observed — then re-raises/propagates the original error untouched.
+`trace oom BUNDLE.json` pretty-prints one.
+
+Nothing here imports jax at module import time: host-only tools (the
+device plugin, trace CLI) stay importable on jax-free images.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+from container_engine_accelerators_tpu.metrics import events
+
+log = logging.getLogger(__name__)
+
+OOM_DIR_ENV = "TPU_OOM_DIR"
+
+# jax.monitoring duration-event names for the compile pipeline
+# (jax/_src/interpreters/pxla.py emits these on every executable build).
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+_PHASES = {
+    "jaxpr_trace_duration": "trace",
+    "jaxpr_to_mlir_module_duration": "lower",
+    "backend_compile_duration": "compile",
+}
+
+# Tiny CPU-test compiles (~10 ms) through multi-minute real-model
+# XLA compiles on the TPU backend.
+_COMPILE_BUCKETS = (.01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 120.0, 300.0, 600.0)
+
+# memory_stats() keys worth exporting/bundling; the raw dict also
+# carries allocator-internal counters that vary by backend version.
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_free_block_bytes", "pool_bytes", "num_allocs")
+
+
+# ---------- version-guarded jax surface ----------
+
+def _monitoring():
+    """jax.monitoring when it has the duration-listener API (jax >=
+    ~0.4.0); None on older jax / no jax — callers degrade to a logged
+    no-op (the `compat_shard_map` pattern, applied to observability)."""
+    try:
+        import jax.monitoring as m
+    except Exception:
+        return None
+    if not hasattr(m, "register_event_duration_secs_listener"):
+        return None
+    return m
+
+
+def device_memory_stats(include_unavailable: bool = False) -> list[dict]:
+    """One row per local device from `memory_stats()` (bytes_in_use /
+    peak / limit ...). Devices whose runtime lacks the API (CPU
+    backend, old jax) are skipped — or included as
+    `{"stats_available": False}` rows when `include_unavailable` is
+    set, so a forensics bundle still records what devices existed."""
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception as e:
+        log.debug("device_memory_stats: no jax backend (%s)", e)
+        return []
+    rows = []
+    for d in devs:
+        stats = None
+        try:
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if fn is not None else None
+        except Exception:
+            stats = None
+        row = {"device": f"{d.platform}:{d.id}",
+               "kind": getattr(d, "device_kind", "?")}
+        if not stats:
+            if include_unavailable:
+                row["stats_available"] = False
+                rows.append(row)
+            continue
+        row["stats_available"] = True
+        for k in _MEM_KEYS:
+            if k in stats:
+                row[k] = int(stats[k])
+        rows.append(row)
+    return rows
+
+
+def peak_hbm_bytes() -> int | None:
+    """Max per-device peak allocation (fallback: current bytes_in_use)
+    — the one number benches record per config so BENCH_*.json
+    trajectories catch memory regressions. None when no backend
+    exposes memory_stats (CPU)."""
+    peaks = [r.get("peak_bytes_in_use", r.get("bytes_in_use"))
+             for r in device_memory_stats()]
+    peaks = [p for p in peaks if p is not None]
+    return max(peaks) if peaks else None
+
+
+def live_array_census(top_n: int = 32) -> dict:
+    """Top-N live device arrays by nbytes, with shape/dtype/sharding —
+    the "what is actually resident" view `/debugz?census=1` serves and
+    every OOM bundle embeds. The tail beyond top_n is summarized, not
+    dropped silently."""
+    try:
+        import jax
+        arrs = jax.live_arrays()
+    except Exception as e:
+        return {"available": False, "error": str(e)[:200], "rows": []}
+    rows = []
+    total = 0
+    for a in arrs:
+        try:
+            nbytes = int(a.nbytes)
+            row = {"nbytes": nbytes, "shape": list(a.shape),
+                   "dtype": str(a.dtype)}
+            try:
+                row["sharding"] = str(a.sharding)
+            except Exception:
+                pass
+        except Exception:
+            continue  # deleted/donated between listing and inspection
+        total += nbytes
+        rows.append(row)
+    rows.sort(key=lambda r: -r["nbytes"])
+    head = rows[:max(top_n, 0)]
+    return {"available": True, "n_arrays": len(rows),
+            "total_bytes": total,
+            "truncated_arrays": len(rows) - len(head),
+            "truncated_bytes": total - sum(r["nbytes"] for r in head),
+            "rows": head}
+
+
+# ---------- compile tracker + recompile attributor ----------
+
+def _abstract_signature(args, kwargs):
+    """Hashable fingerprint of a call's abstract signature: one
+    (path, shape, dtype) triple per array leaf, (path, repr) for
+    statics. Shape/dtype read from avals stays valid on donated
+    buffers, so fingerprinting AFTER the call is safe."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path((args, kwargs))[0]
+    sig = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((key, tuple(int(s) for s in shape), str(dtype)))
+        else:
+            sig.append((key, None, repr(leaf)[:80]))
+    return tuple(sig)
+
+
+def _fmt_entry(entry) -> str:
+    key, shape, dtype = entry
+    if shape is None:
+        return f"static {dtype}"
+    return f"{dtype}{list(shape)}"
+
+
+def _sig_diff(prev, cur, max_entries: int = 6) -> str:
+    """Human-readable diff between two abstract signatures, naming the
+    changed leaf and DIMENSION — the line an on-call engineer greps
+    for when a recompile storm starts."""
+    if prev is None:
+        return "no previous signature recorded"
+    pmap = {e[0]: e for e in prev}
+    cmap = {e[0]: e for e in cur}
+    parts = []
+    for key, entry in cmap.items():
+        old = pmap.get(key)
+        if old is None:
+            parts.append(f"{key}: added {_fmt_entry(entry)}")
+        elif old != entry:
+            msg = f"{key}: {_fmt_entry(old)} -> {_fmt_entry(entry)}"
+            oshape, cshape = old[1], entry[1]
+            if (oshape is not None and cshape is not None
+                    and len(oshape) == len(cshape)):
+                dims = [f"dim {i}: {a} -> {b}"
+                        for i, (a, b) in enumerate(zip(oshape, cshape))
+                        if a != b]
+                if dims:
+                    msg += " (" + ", ".join(dims) + ")"
+            parts.append(msg)
+    for key in pmap:
+        if key not in cmap:
+            parts.append(f"{key}: removed {_fmt_entry(pmap[key])}")
+    if not parts:
+        return ("identical abstract signature (jit cache evicted, or a "
+                "layout/donation change invisible to shapes)")
+    extra = len(parts) - max_entries
+    shown = "; ".join(parts[:max_entries])
+    return shown + (f"; ... and {extra} more" if extra > 0 else "")
+
+
+class _Watched:
+    """Per-watch()-site compile history. Each call of watch() gets its
+    own state even under a shared label, so two configs of the same
+    factory never read each other's signatures as recompiles."""
+
+    __slots__ = ("name", "lock", "sigs", "last_sig", "compiles",
+                 "recompiles")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.sigs: set = set()
+        self.last_sig = None
+        self.compiles = 0
+        self.recompiles = 0
+
+
+class CompileTracker:
+    """Process-wide XLA compile telemetry; obtain via `get_tracker()`
+    or `install()`. Listeners register once and check `self.enabled`
+    first, so `disable()` is an attribute write, not an unhook (jax
+    only offers clear-ALL-listeners, which would nuke other users)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.monitoring_ok = False
+        self._listening = False
+        self._warned = False
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._states: list[_Watched] = []
+        self._recorder = None
+
+        self.registry = CollectorRegistry()
+        reg = self.registry
+        self.compiles_total = Counter(
+            "tpu_xla_compiles",
+            "XLA backend compiles observed via jax.monitoring, by "
+            "watched jitted entrypoint (fn=untracked: compile outside "
+            "any watch() context)", ["fn"], registry=reg)
+        self.recompiles_total = Counter(
+            "tpu_xla_recompiles",
+            "Steady-state recompiles: a compile AFTER a watched "
+            "entrypoint's first, attributed with the signature diff "
+            "in the log", ["fn"], registry=reg)
+        self.compile_seconds = Histogram(
+            "tpu_xla_compile_seconds",
+            "Compile-pipeline phase durations (trace / lower / "
+            "compile) by watched entrypoint",
+            ["fn", "phase"], buckets=_COMPILE_BUCKETS, registry=reg)
+
+    # ----- lifecycle -----
+
+    def enable(self) -> None:
+        m = _monitoring()
+        if m is None:
+            if not self._warned:
+                self._warned = True
+                log.warning(
+                    "jax.monitoring unavailable (jax too old or "
+                    "absent): compile tracking degrades to signature "
+                    "fingerprinting with no compile-time attribution")
+            self.monitoring_ok = False
+        else:
+            if not self._listening:
+                m.register_event_duration_secs_listener(self._on_duration)
+                self._listening = True
+            self.monitoring_ok = True
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def register_on(self, registry: CollectorRegistry) -> None:
+        """Additionally expose the tracker's metrics on another
+        registry (the serving/training exporters' co-serve pattern);
+        duplicate registration is a no-op."""
+        for metric in (self.compiles_total, self.recompiles_total,
+                       self.compile_seconds):
+            try:
+                registry.register(metric)
+            except ValueError:
+                pass  # already on this registry
+
+    def set_train_recorder(self, recorder) -> None:
+        """Steady-state recompile seconds will move into this
+        TrainRecorder's `recompile` goodput bucket."""
+        self._recorder = recorder
+
+    # ----- monitoring listener (fires on the compiling thread) -----
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        if not self.enabled or not event.startswith(_COMPILE_EVENT_PREFIX):
+            return
+        phase = _PHASES.get(event[len(_COMPILE_EVENT_PREFIX):])
+        if phase is None:
+            return
+        ctx = getattr(self._tls, "ctx", None)
+        fn = ctx["name"] if ctx is not None else "untracked"
+        try:
+            self.compile_seconds.labels(fn=fn, phase=phase).observe(duration)
+            if phase == "compile":
+                self.compiles_total.labels(fn=fn).inc()
+        except Exception:  # a broken metric must never break a compile
+            log.exception("compile metric update failed")
+        if ctx is not None:
+            ctx["compile_s"] += duration
+            if phase == "compile":
+                ctx["compiled"] = True
+        if events.enabled():
+            now = time.monotonic()
+            events.complete(f"xla/{phase}", now - duration, duration,
+                            "xla", {"fn": fn})
+
+    # ----- watched calls -----
+
+    def _watched_call(self, st: _Watched, fn, args, kwargs):
+        tls = self._tls
+        prev = getattr(tls, "ctx", None)
+        ctx = {"name": st.name, "compile_s": 0.0, "compiled": False}
+        tls.ctx = ctx
+        try:
+            out = fn(*args, **kwargs)
+        finally:
+            tls.ctx = prev
+        # With monitoring, fingerprint ONLY when a compile actually
+        # fired — zero steady-state cost. Without it, every call pays
+        # the fingerprint (degraded old-jax mode).
+        if ctx["compiled"] or not self.monitoring_ok:
+            try:
+                self._note_signature(st, args, kwargs, ctx)
+            except Exception:
+                log.exception("recompile attribution failed for %s",
+                              st.name)
+        return out
+
+    def _note_signature(self, st: _Watched, args, kwargs, ctx) -> None:
+        sig = _abstract_signature(args, kwargs)
+        with st.lock:
+            known = sig in st.sigs
+            if not self.monitoring_ok and known:
+                return  # fingerprint mode: an old signature = cache hit
+            prev_sig = st.last_sig
+            st.sigs.add(sig)
+            st.last_sig = sig
+            st.compiles += 1
+            n = st.compiles
+            if n > 1:
+                st.recompiles += 1
+        if not self.monitoring_ok:
+            # No listener counted this compile; keep the counter honest.
+            self.compiles_total.labels(fn=st.name).inc()
+        if n == 1:
+            log.info("XLA compile #1 of %s (%.3fs compile pipeline)",
+                     st.name, ctx["compile_s"])
+            return
+        diff = _sig_diff(prev_sig, sig)
+        self.recompiles_total.labels(fn=st.name).inc()
+        log.warning(
+            "steady-state XLA recompile #%d of %s (%.3fs compile "
+            "pipeline): %s", n - 1, st.name, ctx["compile_s"], diff)
+        if events.enabled():
+            events.instant("xla/recompile", "xla",
+                           {"fn": st.name, "diff": diff,
+                            "seconds": round(ctx["compile_s"], 4)})
+        rec = self._recorder
+        if rec is not None and ctx["compile_s"] > 0:
+            try:
+                rec.record_recompile(ctx["compile_s"], fn=st.name)
+            except Exception:
+                log.exception("recompile goodput attribution failed")
+
+    def _fn_state(self, name: str) -> _Watched:
+        st = _Watched(name)
+        with self._lock:
+            self._states.append(st)
+        return st
+
+    def summary(self) -> dict:
+        """Per-entrypoint compile-cache state for bundles/debugz,
+        merged by label across watch sites."""
+        fns: dict[str, dict] = {}
+        with self._lock:
+            states = list(self._states)
+        for st in states:
+            with st.lock:
+                d = fns.setdefault(st.name, {"compiles": 0,
+                                             "recompiles": 0,
+                                             "signatures": 0,
+                                             "last_signature": None})
+                d["compiles"] += st.compiles
+                d["recompiles"] += st.recompiles
+                d["signatures"] += len(st.sigs)
+                if st.last_sig is not None:
+                    d["last_signature"] = [
+                        f"{k}: {_fmt_entry((k, s, t))}"
+                        for k, s, t in st.last_sig][:12]
+        return {"enabled": self.enabled,
+                "monitoring": self.monitoring_ok, "fns": fns}
+
+
+_TRACKER: CompileTracker | None = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def get_tracker() -> CompileTracker:
+    global _TRACKER
+    if _TRACKER is None:
+        with _TRACKER_LOCK:
+            if _TRACKER is None:
+                _TRACKER = CompileTracker()
+    return _TRACKER
+
+
+def install(registry: CollectorRegistry | None = None,
+            recorder=None) -> CompileTracker:
+    """Enable process-wide compile tracking (idempotent). `registry`
+    co-registers the metrics on an exporter's scrape surface;
+    `recorder` routes steady-state recompile seconds into that
+    TrainRecorder's goodput."""
+    t = get_tracker()
+    t.enable()
+    if registry is not None:
+        t.register_on(registry)
+    if recorder is not None:
+        t.set_train_recorder(recorder)
+    return t
+
+
+def watch(fn, name: str):
+    """Wrap a jitted callable for compile attribution. With the
+    tracker disabled the wrapper is ONE global load + attribute check
+    and a tail call — no allocation in this module (tracemalloc
+    guard-tested), cheap enough for every decode-step wrapper."""
+    tracker = get_tracker()
+    st = tracker._fn_state(name)
+
+    def watched(*args, **kwargs):
+        if not tracker.enabled:
+            return fn(*args, **kwargs)
+        return tracker._watched_call(st, fn, args, kwargs)
+
+    watched.__name__ = f"watched_{name}"
+    watched.__wrapped__ = fn
+    return watched
+
+
+# ---------- HBM poller ----------
+
+class HbmPoller:
+    """Per-device HBM telemetry from `memory_stats()` into gauges +
+    EventBus counter tracks. Driven by an exporter's poll loop
+    (`poll_once`) or its own background thread (`start`). On backends
+    without memory_stats (CPU) it logs once and idles — never raises."""
+
+    name = "hbm-poller"
+
+    def __init__(self, registry: CollectorRegistry | None = None,
+                 interval: float = 10.0, stats_fn=None):
+        self.registry = registry or CollectorRegistry()
+        self.interval = interval
+        self._stats_fn = stats_fn or device_memory_stats
+        self._warned = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        reg = self.registry
+        self.bytes_in_use = Gauge(
+            "tpu_hbm_bytes_in_use",
+            "Runtime HBM bytes currently allocated, per device "
+            "(jax memory_stats)", ["device"], registry=reg)
+        self.peak_bytes_in_use = Gauge(
+            "tpu_hbm_peak_bytes_in_use",
+            "Runtime high-water-mark HBM bytes, per device",
+            ["device"], registry=reg)
+        self.bytes_limit = Gauge(
+            "tpu_hbm_bytes_limit",
+            "Allocatable HBM bytes, per device", ["device"], registry=reg)
+        self.utilization = Gauge(
+            "tpu_hbm_utilization",
+            "bytes_in_use / bytes_limit, per device", ["device"],
+            registry=reg)
+
+    def poll_once(self) -> list[dict]:
+        rows = self._stats_fn()
+        if not rows:
+            if not self._warned:
+                self._warned = True
+                log.info("memory_stats unavailable on this backend/"
+                         "jax; HBM poller idle")
+            return []
+        for r in rows:
+            dev = r["device"]
+            used = r.get("bytes_in_use")
+            peak = r.get("peak_bytes_in_use")
+            limit = r.get("bytes_limit")
+            if used is not None:
+                self.bytes_in_use.labels(device=dev).set(used)
+            if peak is not None:
+                self.peak_bytes_in_use.labels(device=dev).set(peak)
+            if limit:
+                self.bytes_limit.labels(device=dev).set(limit)
+                if used is not None:
+                    self.utilization.labels(device=dev).set(used / limit)
+            if events.enabled():
+                vals = {k: r[k] for k in
+                        ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit") if k in r}
+                if vals:
+                    events.counter(f"hbm/{dev}", vals, "hbm")
+        return rows
+
+    def start(self) -> None:
+        """Own background thread, for hosts without an exporter poll
+        loop (benches)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("HBM poll failed")
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def snapshot_memory_to_bus(tag: str = "snapshot") -> None:
+    """One-shot per-device memory sample onto the EventBus counter
+    tracks (profiler start/stop markers use this so an xplane capture
+    window carries its HBM context). Never raises."""
+    if not events.enabled():
+        return
+    try:
+        for r in device_memory_stats():
+            vals = {k: r[k] for k in
+                    ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                    if k in r}
+            if vals:
+                events.counter(f"hbm/{r['device']}", vals, "hbm")
+    except Exception:
+        log.debug("memory snapshot (%s) failed", tag, exc_info=True)
+
+
+# ---------- OOM forensics ----------
+
+_EXPECTED_HBM: dict | None = None
+LAST_BUNDLE_PATH: str | None = None
+
+
+def set_expected_hbm(plan: dict | None) -> None:
+    """Record the tools/hbm_plan.py budget this process was launched
+    under; every OOM bundle embeds it next to the observed stats so
+    "the plan said it fits" is checkable post-mortem."""
+    global _EXPECTED_HBM
+    _EXPECTED_HBM = plan
+    if plan:
+        log.info("hbm_plan expectation: %.2f GB of %.1f GB (%s)",
+                 plan.get("total_gb", 0.0), plan.get("hbm_gb", 0.0),
+                 "fits" if plan.get("fits") else "DOES NOT FIT")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED in any of its spellings: the XLA status code
+    in the message (XlaRuntimeError carries it), an exception class
+    named for it, or the allocator's plain-English variant."""
+    name = type(exc).__name__.lower().replace("_", "")
+    if "resourceexhausted" in name:
+        return True
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg
+            or "resource exhausted" in msg.lower()
+            or "out of memory" in msg.lower())
+
+
+def _bundle_dir() -> str:
+    d = os.environ.get(OOM_DIR_ENV)
+    if d:
+        return d
+    # Land next to the flight-recorder trace dump when one is armed,
+    # so the post-mortem artifacts sit together.
+    dump = getattr(events, "_DUMP_PATH", None)
+    if dump:
+        return os.path.dirname(dump) or "."
+    return "."
+
+
+def build_oom_bundle(context: str, exc: BaseException | None = None,
+                     census_top: int = 32) -> dict:
+    bundle = {
+        "kind": "tpu_oom_forensics",
+        "version": 1,
+        "t": round(time.time(), 3),
+        "pid": os.getpid(),
+        "context": context,
+        "error": None,
+        "device_memory_stats": device_memory_stats(
+            include_unavailable=True),
+        "live_array_census": live_array_census(census_top),
+        "compile_cache": get_tracker().summary(),
+        "recent_events": events.get_bus().debugz(256),
+    }
+    if exc is not None:
+        bundle["error"] = {"type": type(exc).__name__,
+                           "message": str(exc)[:2000]}
+    observed = [r for r in bundle["device_memory_stats"]
+                if r.get("stats_available")]
+    comparison = None
+    if _EXPECTED_HBM and observed:
+        worst = max(observed, key=lambda r: r.get("bytes_in_use", 0))
+        comparison = {
+            "expected_total_gb": _EXPECTED_HBM.get("total_gb"),
+            "expected_fits": _EXPECTED_HBM.get("fits"),
+            "observed_peak_gb": round(
+                worst.get("peak_bytes_in_use",
+                          worst.get("bytes_in_use", 0)) / 1e9, 3),
+            "observed_device": worst["device"],
+        }
+    bundle["hbm_plan"] = {"expected": _EXPECTED_HBM,
+                          "comparison": comparison}
+    return bundle
+
+
+def write_oom_bundle(context: str, exc: BaseException | None = None,
+                     path: str | None = None) -> str | None:
+    """Atomic (tmp + os.replace) post-mortem bundle write. Never
+    raises — forensics must not mask the error it documents. Returns
+    the final path, or None on failure."""
+    global LAST_BUNDLE_PATH
+    try:
+        bundle = build_oom_bundle(context, exc)
+        if path is None:
+            path = os.path.join(
+                _bundle_dir(),
+                f"oom-{os.getpid()}-{int(time.time())}.json")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f)
+        os.replace(tmp, path)
+        LAST_BUNDLE_PATH = path
+        census = bundle["live_array_census"]
+        log.error(
+            "OOM forensics bundle -> %s (%d live arrays, %.2f GB "
+            "resident; read it with `trace oom %s`)", path,
+            census.get("n_arrays", 0),
+            census.get("total_bytes", 0) / 1e9, path)
+        return path
+    except Exception:
+        log.exception("OOM forensics bundle write failed")
+        return None
+
+
+def note_failure(exc: BaseException, context: str,
+                 path: str | None = None) -> str | None:
+    """Call from an except block on any device-calling path: when the
+    failure is resource exhaustion, write the forensics bundle, mark
+    the flight-recorder timeline, and flush the trace ring next to it.
+    A no-op for every other error. Returns the bundle path or None."""
+    if not is_resource_exhausted(exc):
+        return None
+    out = write_oom_bundle(context, exc, path)
+    if events.enabled():
+        events.instant("oom", "forensics",
+                       {"context": context,
+                        "type": type(exc).__name__,
+                        "bundle": out or "unwritable"})
+    events.dump_now()  # the trace dump the bundle sits next to
+    return out
+
+
+@contextlib.contextmanager
+def oom_forensics(context: str, path: str | None = None):
+    """Wrap a device-calling step so RESOURCE_EXHAUSTED produces the
+    post-mortem bundle before re-raising the ORIGINAL error (training
+    loops propagate; the serve engines call note_failure from their
+    existing recovery paths instead)."""
+    try:
+        yield
+    except BaseException as e:
+        note_failure(e, context, path)
+        raise
+
+
+def _reset_for_tests() -> None:
+    """Disable tracking and drop per-process wiring (tests only); the
+    metric objects persist (prometheus counters are cumulative), so
+    tests assert on unique fn labels or deltas. Watch states are
+    zeroed IN PLACE, not discarded: lru_cached jit factories
+    (models/decode*.py) hold their wrapper — and its state — across
+    tests, so a discarded state would vanish from summary() forever."""
+    global _EXPECTED_HBM, LAST_BUNDLE_PATH
+    t = get_tracker()
+    t.enabled = False
+    t._recorder = None
+    with t._lock:
+        for st in t._states:
+            with st.lock:
+                st.sigs.clear()
+                st.last_sig = None
+                st.compiles = 0
+                st.recompiles = 0
+    _EXPECTED_HBM = None
+    LAST_BUNDLE_PATH = None

@@ -64,6 +64,21 @@ class MetricServer(ExporterBase):
         self.node_memory_total = Gauge(
             "node_memory_total", "TPU HBM total bytes, per chip",
             NODE_LABELS, registry=self.registry)
+        # Driver-truth per-chip memory with explicit units/namespace:
+        # the sampler has always read mem_used/mem_total from sysfs,
+        # but only the reference-named (unitless) node_memory_* gauges
+        # reached /metrics; dashboards alerting on chip memory want the
+        # tpu_chip_* family regardless of reference naming parity.
+        self.chip_memory_used = Gauge(
+            "tpu_chip_memory_used_bytes",
+            "TPU HBM bytes in use per chip, from the accel driver's "
+            "sysfs counters (SysfsSampler)",
+            NODE_LABELS, registry=self.registry)
+        self.chip_memory_total = Gauge(
+            "tpu_chip_memory_total_bytes",
+            "TPU HBM capacity bytes per chip, from the accel driver's "
+            "sysfs counters (SysfsSampler)",
+            NODE_LABELS, registry=self.registry)
         # reference metrics.go: the request_* family reports the chips a
         # container REQUESTED (kubelet allocation), not what it uses.
         self.request_count = Gauge(
@@ -106,6 +121,8 @@ class MetricServer(ExporterBase):
         self.node_duty_cycle.clear()
         self.node_memory_used.clear()
         self.node_memory_total.clear()
+        self.chip_memory_used.clear()
+        self.chip_memory_total.clear()
         self.duty_cycle.clear()
         self.memory_used.clear()
         self.memory_total.clear()
@@ -117,6 +134,9 @@ class MetricServer(ExporterBase):
             self.node_duty_cycle.labels(**labels).set(s.duty_cycle_pct)
             self.node_memory_used.labels(**labels).set(s.memory_used_bytes)
             self.node_memory_total.labels(**labels).set(s.memory_total_bytes)
+            self.chip_memory_used.labels(**labels).set(s.memory_used_bytes)
+            self.chip_memory_total.labels(**labels).set(
+                s.memory_total_bytes)
 
         # Container-level: PodResources attribution (reference
         # devices.go:51-101).

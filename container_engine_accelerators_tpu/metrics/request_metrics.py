@@ -298,15 +298,31 @@ class ServeMetricsExporter(ExporterBase):
     name = "serve-metrics"
 
     def __init__(self, recorder: RequestRecorder, port: int = 0,
-                 host: str = "", interval: float = 5.0, poll_fn=None):
+                 host: str = "", interval: float = 5.0, poll_fn=None,
+                 hbm_poller="auto"):
         self.recorder = recorder
         self.registry = recorder.registry
         self.port = port
         self.host = host
         self.interval = interval
         self._poll_fn = poll_fn
+        if hbm_poller == "auto":
+            # Serving metrics ports carry live per-device HBM telemetry
+            # (metrics/introspection.py) — KV-memory accounting has to
+            # be continuous, not post-hoc. A shared registry that
+            # already holds the gauges keeps its existing poller.
+            from container_engine_accelerators_tpu.metrics.introspection import (  # noqa: E501
+                HbmPoller,
+            )
+            try:
+                hbm_poller = HbmPoller(registry=self.registry)
+            except ValueError:
+                hbm_poller = None
+        self.hbm_poller = hbm_poller
         self._stop = threading.Event()
 
     def poll_once(self) -> None:
         if self._poll_fn is not None:
             self._poll_fn()
+        if self.hbm_poller is not None:
+            self.hbm_poller.poll_once()

@@ -19,6 +19,7 @@ from prometheus_client import make_wsgi_app
 log = logging.getLogger(__name__)
 
 DEBUGZ_DEFAULT_LIMIT = 256
+DEBUGZ_DEFAULT_CENSUS = 32
 
 
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
@@ -47,7 +48,11 @@ class ExporterBase:
         """Prometheus WSGI app plus a /debugz route serving the
         process-wide EventBus's last-N events as JSON (?n= to change N)
         — the live window onto the flight recorder, on every exporter
-        port, no dump file required."""
+        port, no dump file required. `?census=1` additionally embeds
+        the live-array census (top-N `jax.live_arrays()` by nbytes;
+        `census=<k>` with k>1 sets N), per-device memory stats, and the
+        compile-cache summary (metrics/introspection.py) — the "what
+        is resident right now" view, no debugger required."""
         prom = make_wsgi_app(self.registry)
 
         def app(environ, start_response):
@@ -61,8 +66,26 @@ class ExporterBase:
                     limit = int(qs.get("n", [DEBUGZ_DEFAULT_LIMIT])[0])
                 except (TypeError, ValueError):
                     limit = DEBUGZ_DEFAULT_LIMIT
-                body = json.dumps(
-                    events.get_bus().debugz(max(limit, 0))).encode()
+                payload = events.get_bus().debugz(max(limit, 0))
+                try:
+                    census_n = int(qs.get("census", [0])[0])
+                except (TypeError, ValueError):
+                    census_n = 0
+                if census_n > 0:
+                    from container_engine_accelerators_tpu.metrics import (  # noqa: E501
+                        introspection,
+                    )
+                    try:
+                        payload["census"] = introspection.live_array_census(
+                            census_n if census_n > 1
+                            else DEBUGZ_DEFAULT_CENSUS)
+                        payload["memory"] = introspection.device_memory_stats(
+                            include_unavailable=True)
+                        payload["compile_cache"] = \
+                            introspection.get_tracker().summary()
+                    except Exception:
+                        log.exception("/debugz census failed")
+                body = json.dumps(payload).encode()
                 start_response("200 OK", [
                     ("Content-Type", "application/json"),
                     ("Content-Length", str(len(body)))])

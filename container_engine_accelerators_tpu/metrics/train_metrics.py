@@ -145,6 +145,11 @@ class TrainRecorder:
         self._tokens = 0
         self._tokens_productive = 0  # excludes first-step (compile) tokens
         self._last_step = 0
+        # Steady-state recompile seconds reported by the compile
+        # tracker (metrics/introspection.py) but not yet deducted from
+        # a step's productive charge — the recompile happens INSIDE
+        # the step dispatch the next record_step will report.
+        self._pending_recompile = 0.0
         self.samples = {k: collections.deque(maxlen=max_samples)
                         for k in SAMPLE_KINDS}
 
@@ -192,6 +197,11 @@ class TrainRecorder:
             "train_tokens", "Non-padding tokens trained on", registry=reg)
         self.resumes_total = Counter(
             "train_resumes", "Checkpoint restores (resume events)",
+            registry=reg)
+        self.recompiles_total = Counter(
+            "train_recompiles",
+            "Steady-state XLA recompiles attributed to the loop by the "
+            "compile tracker (first-step compiles excluded)",
             registry=reg)
 
         self.last_step_g = Gauge(
@@ -274,8 +284,17 @@ class TrainRecorder:
         with self._lock:
             self._observe("step", self.step_time, compute_s)
             self._observe("data_wait", self.data_wait, data_wait_s)
-            self._buckets["recompile" if first else "productive"] += \
-                max(compute_s, 0.0)
+            cs = max(compute_s, 0.0)
+            if first:
+                self._buckets["recompile"] += cs
+            else:
+                # Any recompile seconds record_recompile already moved
+                # into the recompile bucket happened inside THIS step's
+                # dispatch — deduct them so the time isn't counted
+                # productive AND recompile.
+                self._buckets["productive"] += max(
+                    cs - self._pending_recompile, 0.0)
+            self._pending_recompile = 0.0
             self._buckets["stalled"] += max(data_wait_s, 0.0)
             self._steps += 1
             self._tokens += tokens
@@ -360,6 +379,28 @@ class TrainRecorder:
                 s = max(seconds, 0.0)
                 events.complete("train/restore", now - s, s, "train",
                                 {"step": step})
+
+    def record_recompile(self, seconds: float, fn: str | None = None,
+                         now: float | None = None) -> None:
+        """Steady-state XLA recompile wall-clock, attributed mid-run by
+        the compile tracker (metrics/introspection.py watch()) — the
+        generalization of the first-step heuristic. The seconds land in
+        the `recompile` goodput bucket now and are deducted from the
+        NEXT record_step's productive charge (the recompile happened
+        inside that step's dispatch), so nothing double-counts."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            s = max(seconds, 0.0)
+            self._buckets["recompile"] += s
+            self._pending_recompile += s
+            self.recompiles_total.inc()
+            self._goodput_locked(now)
+            self._append_log({"kind": "recompile",
+                              "t": round(time.time(), 3),
+                              "seconds": round(s, 6), "fn": fn})
+            if events.enabled():
+                events.complete("train/recompile", now - s, s, "train",
+                                {"fn": fn})
 
     def record_fast_forward(self, seconds: float, batches: int = 0,
                             now: float | None = None) -> None:
@@ -619,7 +660,7 @@ class TrainMetricsExporter(ExporterBase):
     def __init__(self, recorder: TrainRecorder, port: int = 0,
                  host: str = "", interval: float = 5.0,
                  watchdog: HangWatchdog | None = None,
-                 co_exporters=()):
+                 co_exporters=(), hbm_poller="auto"):
         self.recorder = recorder
         self.registry = recorder.registry
         self.port = port
@@ -627,11 +668,25 @@ class TrainMetricsExporter(ExporterBase):
         self.interval = interval
         self.watchdog = watchdog
         self.co_exporters = list(co_exporters)
+        if hbm_poller == "auto":
+            # Every training metrics port carries live per-device HBM
+            # telemetry (metrics/introspection.py); a shared registry
+            # that already has the gauges keeps its existing poller.
+            from container_engine_accelerators_tpu.metrics.introspection import (  # noqa: E501
+                HbmPoller,
+            )
+            try:
+                hbm_poller = HbmPoller(registry=self.registry)
+            except ValueError:
+                hbm_poller = None
+        self.hbm_poller = hbm_poller
         self._stop = threading.Event()
 
     def poll_once(self) -> None:
         self.recorder.goodput()
         if self.watchdog is not None:
             self.watchdog.check()
+        if self.hbm_poller is not None:
+            self.hbm_poller.poll_once()
         for co in self.co_exporters:
             co.poll_once()

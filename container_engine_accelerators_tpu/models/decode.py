@@ -897,22 +897,37 @@ class PrefixIndex:
             pass
 
 
+def _watched_jit(fn, name: str):
+    """Compile-attribution wrap (metrics/introspection.py watch): the
+    serve engines bucket-pad shapes so these executables compile once
+    per bucket and never again — the tracker is what verifies that in
+    production, naming the exact shape diff when a steady-state
+    recompile does land. One attribute check per call when disabled."""
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+    return watch(fn, name)
+
+
 @functools.lru_cache(maxsize=32)
 def _jitted_decode_step_paged(cfg: LlamaConfig):
-    return jax.jit(functools.partial(decode_step_paged, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(decode_step_paged, cfg=cfg),
+                donate_argnums=(1,)), "decode_step_paged")
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_prefill_slot_paged(cfg: LlamaConfig):
-    return jax.jit(functools.partial(prefill_slot_paged, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(prefill_slot_paged, cfg=cfg),
+                donate_argnums=(1,)), "prefill_slot_paged")
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_prefill_suffix_paged(cfg: LlamaConfig):
-    return jax.jit(functools.partial(prefill_suffix_paged, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(prefill_suffix_paged, cfg=cfg),
+                donate_argnums=(1,)), "prefill_suffix_paged")
 
 
 @functools.lru_cache(maxsize=32)
@@ -938,20 +953,23 @@ def pick_tokens(logits: jnp.ndarray, temps: jnp.ndarray,
 
 @functools.lru_cache(maxsize=32)
 def _jitted_decode_step_slots(cfg: LlamaConfig):
-    return jax.jit(functools.partial(decode_step_slots, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(decode_step_slots, cfg=cfg),
+                donate_argnums=(1,)), "decode_step_slots")
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_prefill_slot(cfg: LlamaConfig):
-    return jax.jit(functools.partial(prefill_slot, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(prefill_slot, cfg=cfg),
+                donate_argnums=(1,)), "prefill_slot")
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_prefill_suffix_slot(cfg: LlamaConfig):
-    return jax.jit(functools.partial(prefill_suffix_slot, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(prefill_suffix_slot, cfg=cfg),
+                donate_argnums=(1,)), "prefill_suffix_slot")
 
 
 @functools.lru_cache(maxsize=32)
@@ -967,8 +985,9 @@ def _jitted_decode_step(cfg: LlamaConfig):
     per call would recompile every batch — minutes per compile through the
     tunnel). One wrapper serves both prefill and single-token decode; jit
     keeps a separate executable per call shape under it."""
-    return jax.jit(functools.partial(decode_step, cfg=cfg),
-                   donate_argnums=(1,))
+    return _watched_jit(
+        jax.jit(functools.partial(decode_step, cfg=cfg),
+                donate_argnums=(1,)), "decode_step")
 
 
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,

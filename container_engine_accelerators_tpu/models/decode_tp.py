@@ -192,6 +192,17 @@ def _smap(fn, mesh, in_specs, out_specs):
                       out_specs=out_specs, **kw)
 
 
+
+def _watched_jit(fn, name: str):
+    """Compile-attribution wrap (metrics/introspection.py watch) for
+    the tensor-parallel executables — same contract as the
+    single-device wrappers in models/decode.py."""
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+    return watch(fn, name)
+
+
 @functools.lru_cache(maxsize=32)
 def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
     """Classic scalar-length batched decode/prefill step over the mesh
@@ -205,7 +216,8 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(None, None)),
         out_specs=(P(None, None, None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/decode_step')
 
 
 @functools.lru_cache(maxsize=32)
@@ -219,7 +231,8 @@ def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(None), P(None)),
         out_specs=(P(None, None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/decode_step_slots')
 
 
 @functools.lru_cache(maxsize=32)
@@ -233,7 +246,8 @@ def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(), P(None), P()),
         out_specs=(P(None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/prefill_slot')
 
 
 @functools.lru_cache(maxsize=32)
@@ -247,7 +261,8 @@ def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(), P(None), P(), P()),
         out_specs=(P(None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/prefill_suffix_slot')
 
 
 @functools.lru_cache(maxsize=32)
@@ -261,7 +276,8 @@ def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(None), P(None)),
         out_specs=(P(None, None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/decode_step_paged')
 
 
 @functools.lru_cache(maxsize=32)
@@ -275,7 +291,8 @@ def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(), P(None), P(None), P()),
         out_specs=(P(None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/prefill_slot_paged')
 
 
 @functools.lru_cache(maxsize=32)
@@ -289,7 +306,8 @@ def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
         mesh,
         in_specs=(pspecs, cspecs, P(), P(None), P()),
         out_specs=(P(None), cspecs))
-    return jax.jit(fn, donate_argnums=(1,))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/prefill_suffix_paged')
 
 
 def make_inference_mesh(tp: int | None = None,

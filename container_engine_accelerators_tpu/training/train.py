@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from container_engine_accelerators_tpu.metrics import events
+from container_engine_accelerators_tpu.metrics import events, introspection
 from container_engine_accelerators_tpu.models import llama
 from container_engine_accelerators_tpu.parallel import sharding as shd
 from container_engine_accelerators_tpu.training.fused_adamw import (
@@ -182,7 +182,15 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
                    "tokens": jnp.sum((batch["targets"] >= 0).astype(jnp.int32))}
         return TrainState(state.step + 1, new_params, new_opt), metrics
 
-    return jax.jit(step, donate_argnums=(0,))
+    # Compile-attribution wrap (metrics/introspection.py): a mid-run
+    # recompile of the train step — new batch shape, cache eviction —
+    # is logged with the exact signature diff and its compile seconds
+    # move into the recorder's `recompile` goodput bucket instead of
+    # silently inflating one step's "productive" time.
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+    return watch(jax.jit(step, donate_argnums=(0,)), "train_step")
 
 
 def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
@@ -221,7 +229,8 @@ def train_loop(state: TrainState, batches: Iterator, step_fn, mesh: Mesh,
             break
         t1 = time.perf_counter()
         tokens = _host_token_count(batch) if recorder is not None else 0
-        with annotate("train/step"):
+        with annotate("train/step"), \
+                introspection.oom_forensics("train_loop/step"):
             batch = shard_batch(batch, mesh, sequence_parallel)
             state, metrics = step_fn(state, batch)
         t2 = time.perf_counter()
@@ -330,6 +339,11 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                         watchdog=watchdog)
         exporter.start_background()
         log_fn(f"train metrics on :{exporter.bound_port}/metrics")
+    if rec is not None:
+        # Compile tracker: tpu_xla_* families on this run's registry,
+        # and steady-state recompile seconds routed into the recorder's
+        # goodput (the first-step heuristic stays for the initial jit).
+        introspection.install(registry=rec.registry, recorder=rec)
 
     key = key if key is not None else jrandom.key(0)
     state = create_train_state(key, cfg, mesh, optimizer)
@@ -399,7 +413,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                         peak_flops_per_chip=detect_peak_flops(),
                         n_chips=mesh.devices.size)
                 tokens = _host_token_count(batch) if rec is not None else 0
-                with annotate("train/step"):
+                with annotate("train/step"), \
+                        introspection.oom_forensics("fit/step"):
                     batch = shard_batch(batch, mesh, sp)
                     state, metrics = step_fn(state, batch)
                 t2 = time.perf_counter()

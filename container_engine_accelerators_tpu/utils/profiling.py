@@ -48,8 +48,11 @@ def maybe_profile(log_dir: str | None = None):
     log.info("profiler trace -> %s", log_dir)
     # The xplane capture window shows up on the flight-recorder
     # timeline, so an EventBus dump says whether a given incident is
-    # covered by an xplane trace.
+    # covered by an xplane trace — and carries the per-device HBM
+    # state at both edges of the window (introspection.py), so "was
+    # memory already high when the capture started?" is answerable.
     events.instant("profile/start", "xplane", {"log_dir": log_dir})
+    _snapshot_memory("profile/start")
     try:
         yield True
     finally:
@@ -61,6 +64,21 @@ def maybe_profile(log_dir: str | None = None):
         else:
             log.info("profiler trace written to %s", log_dir)
         events.instant("profile/stop", "xplane")
+        _snapshot_memory("profile/stop")
+
+
+def _snapshot_memory(tag: str) -> None:
+    """Per-device memory counters onto the EventBus (no-op when the
+    bus is disabled or the backend lacks memory_stats)."""
+    if not events.enabled():
+        return
+    try:
+        from container_engine_accelerators_tpu.metrics.introspection import (
+            snapshot_memory_to_bus,
+        )
+        snapshot_memory_to_bus(tag)
+    except Exception:
+        log.debug("memory snapshot failed", exc_info=True)
 
 
 class _AnnotatedSpan:

@@ -1,0 +1,444 @@
+"""XLA compile + HBM introspection (ISSUE 5): forced recompiles are
+counted AND attributed with the exact shape diff; recompile seconds
+move into the TrainRecorder's goodput bucket without double counting;
+a simulated RESOURCE_EXHAUSTED in a serve engine step writes a
+well-formed forensics bundle (per-device memory stats + non-empty
+live-array census) and the client still sees the ORIGINAL error; the
+HBM poller scrapes; /debugz?census=1 serves the live-array view; and
+the disabled path allocates nothing (the tracemalloc harness from
+test_events.py)."""
+
+import json
+import logging
+import time
+import tracemalloc
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+from prometheus_client import generate_latest
+
+from container_engine_accelerators_tpu.metrics import (
+    events,
+    introspection,
+)
+from container_engine_accelerators_tpu.metrics.introspection import (
+    HbmPoller,
+    get_tracker,
+    install,
+    is_resource_exhausted,
+    live_array_census,
+    watch,
+)
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+)
+from container_engine_accelerators_tpu.metrics.train_metrics import (
+    TrainRecorder,
+)
+
+INTROSPECTION_LOGGER = "container_engine_accelerators_tpu.metrics.introspection"  # noqa: E501
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Tracker disabled + per-process wiring dropped around every test
+    (the events-bus reset mirrors test_events.py)."""
+    events._reset_for_tests()
+    introspection._reset_for_tests()
+    yield
+    events._reset_for_tests()
+    introspection._reset_for_tests()
+
+
+def _counter(name: str, fn: str):
+    return get_tracker().registry.get_sample_value(name, {"fn": fn})
+
+
+# ---------- compile tracker + recompile attribution ----------
+
+def test_recompile_counted_and_attributed(caplog):
+    """Acceptance: jit a function, call it with two distinct shapes,
+    and the recompile counter increments with a logged diff naming the
+    changed dimension."""
+    install()
+    f = watch(jax.jit(lambda x: x * 2), "mul2_shape")
+
+    with caplog.at_level(logging.INFO, logger=INTROSPECTION_LOGGER):
+        f(jnp.ones((4,), jnp.float32))
+    assert _counter("tpu_xla_compiles_total", "mul2_shape") >= 1
+    assert not _counter("tpu_xla_recompiles_total", "mul2_shape")
+
+    with caplog.at_level(logging.WARNING, logger=INTROSPECTION_LOGGER):
+        f(jnp.ones((8,), jnp.float32))
+    assert _counter("tpu_xla_recompiles_total", "mul2_shape") == 1
+    assert _counter("tpu_xla_compiles_total", "mul2_shape") >= 2
+
+    warnings = [r.getMessage() for r in caplog.records
+                if r.levelno >= logging.WARNING]
+    assert any("recompile" in m and "mul2_shape" in m
+               and "dim 0: 4 -> 8" in m for m in warnings), warnings
+
+    # Compile-seconds histogram carries the fn label too.
+    secs = get_tracker().registry.get_sample_value(
+        "tpu_xla_compile_seconds_count",
+        {"fn": "mul2_shape", "phase": "compile"})
+    assert secs and secs >= 2
+
+
+def test_same_signature_never_recompiles():
+    install()
+    f = watch(jax.jit(lambda x: x + 1), "addone_stable")
+    for _ in range(5):
+        f(jnp.ones((16,), jnp.float32))
+    assert _counter("tpu_xla_compiles_total", "addone_stable") == 1
+    assert not _counter("tpu_xla_recompiles_total", "addone_stable")
+
+
+def test_dtype_change_named_in_diff(caplog):
+    install()
+    f = watch(jax.jit(lambda x: x * x), "sq_dtype")
+    f(jnp.ones((4,), jnp.float32))
+    with caplog.at_level(logging.WARNING, logger=INTROSPECTION_LOGGER):
+        f(jnp.ones((4,), jnp.int32))
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("float32" in m and "int32" in m for m in msgs), msgs
+
+
+def test_recompile_emits_bus_instant_and_summary():
+    events.enable(process_name="introspect")
+    install()
+    f = watch(jax.jit(lambda x: x - 1), "sub_bus")
+    f(jnp.ones((2, 2)))
+    f(jnp.ones((2, 4)))
+    names = [ev[3] for ev in events.get_bus().snapshot()]
+    assert "xla/recompile" in names
+    assert "xla/compile" in names  # listener X phases on the timeline
+    summ = get_tracker().summary()["fns"]["sub_bus"]
+    assert summ["compiles"] == 2
+    assert summ["recompiles"] == 1
+    assert summ["signatures"] == 2
+
+
+def test_recompile_moves_goodput_without_double_count():
+    # Pure-recorder math first: 2s recompile inside a 5s step leaves
+    # productive = 3, recompile = 2, nothing counted twice.
+    rec = TrainRecorder(now=0.0)
+    rec.record_recompile(2.0, fn="train_step", now=4.0)
+    rec.record_step(step=2, compute_s=5.0, tokens=100, now=5.0)
+    g = rec.goodput(now=5.0)
+    assert g["recompile"] == pytest.approx(2.0)
+    assert g["productive"] == pytest.approx(3.0)
+    assert rec.registry.get_sample_value("train_recompiles_total") == 1.0
+
+    # Integration: a watched fn attached to a recorder routes real
+    # compile seconds into the bucket on the SECOND distinct shape.
+    install(recorder=rec)
+    before = rec.goodput()["recompile"]
+    f = watch(jax.jit(lambda x: x / 2), "div_goodput")
+    f(jnp.ones((4,)))
+    assert rec.goodput()["recompile"] == pytest.approx(before)  # first
+    f(jnp.ones((6,)))
+    assert rec.goodput()["recompile"] > before
+
+
+def test_recompile_jsonl_record_merges_onto_timeline(tmp_path):
+    log_path = tmp_path / "steps.jsonl"
+    rec = TrainRecorder(now=0.0, log_path=str(log_path))
+    rec.record_recompile(0.5, fn="train_step", now=1.0)
+    rec.close()
+    records = [json.loads(line) for line in log_path.read_text().splitlines()]
+    assert records[0]["kind"] == "recompile"
+    assert records[0]["fn"] == "train_step"
+    trace = events.merge_traces(train_jsonl_paths=[str(log_path)])
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["name"] == "train/recompile"
+               and e["dur"] == pytest.approx(0.5e6) for e in evs)
+
+
+# ---------- live-array census / memory stats ----------
+
+def test_live_array_census_ranks_by_nbytes():
+    big = jnp.ones((64, 64), jnp.float32)    # 16 KiB
+    small = jnp.ones((4,), jnp.float32)
+    census = live_array_census(top_n=1000)
+    assert census["available"]
+    assert census["n_arrays"] >= 2
+    sizes = [r["nbytes"] for r in census["rows"]]
+    assert sizes == sorted(sizes, reverse=True)
+    assert any(r["shape"] == [64, 64] and r["dtype"] == "float32"
+               for r in census["rows"])
+    # Truncation is summarized, never silent.
+    one = live_array_census(top_n=1)
+    assert len(one["rows"]) == 1
+    assert one["truncated_arrays"] == one["n_arrays"] - 1
+    del big, small
+
+
+def test_device_memory_stats_degrades_on_cpu():
+    rows = introspection.device_memory_stats()
+    assert rows == []  # CPU backend has no memory_stats
+    rows = introspection.device_memory_stats(include_unavailable=True)
+    assert len(rows) == len(jax.devices())
+    assert all(r["stats_available"] is False for r in rows)
+    assert introspection.peak_hbm_bytes() is None
+
+
+# ---------- HBM poller ----------
+
+def _fake_stats():
+    return [{"device": "tpu:0", "kind": "fake v5e",
+             "stats_available": True, "bytes_in_use": 4 << 30,
+             "peak_bytes_in_use": 6 << 30, "bytes_limit": 16 << 30}]
+
+
+def test_hbm_poller_scrape_smoke():
+    events.enable(process_name="hbm")
+    poller = HbmPoller(stats_fn=_fake_stats)
+    rows = poller.poll_once()
+    assert len(rows) == 1
+    text = generate_latest(poller.registry).decode()
+    assert 'tpu_hbm_bytes_in_use{device="tpu:0"}' in text
+    labels = {"device": "tpu:0"}
+    val = poller.registry.get_sample_value
+    assert val("tpu_hbm_bytes_in_use", labels) == 4 << 30
+    assert val("tpu_hbm_peak_bytes_in_use", labels) == 6 << 30
+    assert val("tpu_hbm_bytes_limit", labels) == 16 << 30
+    assert val("tpu_hbm_utilization", labels) == 0.25
+    # Counter track on the flight-recorder timeline.
+    counters = [ev for ev in events.get_bus().snapshot()
+                if ev[0] == "C" and ev[3] == "hbm/tpu:0"]
+    assert counters and counters[0][7]["bytes_in_use"] == 4 << 30
+
+
+def test_exporters_carry_hbm_poller_and_scrape():
+    """Both metric exporters auto-attach an HbmPoller; on CPU it idles
+    (no samples) but the families are registered and /metrics serves."""
+    rec = RequestRecorder()
+    exp = ServeMetricsExporter(rec, port=0, host="127.0.0.1")
+    assert exp.hbm_poller is not None
+    exp.hbm_poller._stats_fn = _fake_stats
+    exp.start_background()
+    try:
+        exp.hbm_poller.poll_once()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.bound_port}/metrics",
+            timeout=10).read().decode()
+        assert "tpu_hbm_bytes_in_use" in text
+        assert "serve_ttft_seconds" in text  # recorder still served
+    finally:
+        exp.stop()
+
+
+# ---------- /debugz census ----------
+
+def test_debugz_census_smoke():
+    events.enable(process_name="censusz")
+    install()
+    resident = [jnp.ones((32, 32), jnp.float32),
+                jnp.ones((16, 16), jnp.float32),
+                jnp.ones((8,), jnp.float32)]
+    rec = RequestRecorder()
+    exp = ServeMetricsExporter(rec, port=0, host="127.0.0.1")
+    exp.start_background()
+    try:
+        base = f"http://127.0.0.1:{exp.bound_port}"
+        plain = json.loads(urllib.request.urlopen(
+            base + "/debugz", timeout=10).read())
+        assert "census" not in plain  # opt-in only
+        data = json.loads(urllib.request.urlopen(
+            base + "/debugz?census=1", timeout=10).read())
+        census = data["census"]
+        assert census["available"] and census["rows"]
+        assert all({"nbytes", "shape", "dtype"} <= set(r)
+                   for r in census["rows"])
+        assert len(data["memory"]) == len(jax.devices())
+        assert data["compile_cache"]["enabled"] is True
+        # census=<k> bounds the rows.
+        data2 = json.loads(urllib.request.urlopen(
+            base + "/debugz?census=2", timeout=10).read())
+        assert len(data2["census"]["rows"]) == 2
+    finally:
+        exp.stop()
+        del resident
+
+
+# ---------- OOM forensics ----------
+
+class FakeResourceExhausted(RuntimeError):
+    """Stands in for jaxlib's XlaRuntimeError, whose constructor is not
+    meant to be called from Python; the detector keys on the status
+    code in the message exactly as the real error carries it."""
+
+
+OOM_MSG = ("RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+           "123456789 bytes.")
+
+
+def test_is_resource_exhausted_spellings():
+    assert is_resource_exhausted(FakeResourceExhausted(OOM_MSG))
+    assert is_resource_exhausted(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert is_resource_exhausted(RuntimeError("Out of memory: 4GB"))
+    assert not is_resource_exhausted(ValueError("bad prompt"))
+    assert not is_resource_exhausted(RuntimeError("UNAVAILABLE: tunnel"))
+
+
+def test_oom_forensics_reraises_original(tmp_path, monkeypatch):
+    monkeypatch.setenv(introspection.OOM_DIR_ENV, str(tmp_path))
+    err = FakeResourceExhausted(OOM_MSG)
+    with pytest.raises(FakeResourceExhausted) as exc_info:
+        with introspection.oom_forensics("test/step"):
+            raise err
+    assert exc_info.value is err  # the ORIGINAL error object
+    assert introspection.LAST_BUNDLE_PATH is not None
+    bundle = json.loads(open(introspection.LAST_BUNDLE_PATH).read())
+    assert bundle["kind"] == "tpu_oom_forensics"
+    assert bundle["context"] == "test/step"
+    # Non-OOM errors pass through without a bundle.
+    introspection.LAST_BUNDLE_PATH = None
+    with pytest.raises(ValueError):
+        with introspection.oom_forensics("test/step"):
+            raise ValueError("not an oom")
+    assert introspection.LAST_BUNDLE_PATH is None
+
+
+@pytest.fixture(scope="module")
+def model():
+    from container_engine_accelerators_tpu.models import (
+        init_params,
+        llama_tiny,
+    )
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def test_engine_oom_writes_bundle_and_fails_with_original(
+        tmp_path, monkeypatch, model):
+    """Acceptance: a simulated RESOURCE_EXHAUSTED in a serve engine
+    step writes a forensics bundle containing per-device memory stats
+    and a non-empty live-array census, and the original error still
+    reaches the client."""
+    from container_engine_accelerators_tpu.cli.serve import (
+        ContinuousEngine,
+    )
+
+    monkeypatch.setenv(introspection.OOM_DIR_ENV, str(tmp_path))
+    install()
+    introspection.set_expected_hbm(
+        {"total_gb": 1.23, "hbm_gb": 16.0, "fits": True})
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, max_slots=2, max_len=128,
+                           max_prompt_len=64)
+    try:
+        # Warm the worker (compiles its step fns) on a healthy request.
+        assert len(eng.submit([1, 2, 3], 2, 0.0).result(timeout=120)) == 5
+
+        real_step = eng._step_fn
+
+        def exploding_step(*args, **kwargs):
+            raise FakeResourceExhausted(OOM_MSG)
+
+        eng._step_fn = exploding_step
+        fut = eng.submit([4, 5, 6], 4, 0.0)
+        with pytest.raises(FakeResourceExhausted) as exc_info:
+            fut.result(timeout=120)
+        assert OOM_MSG in str(exc_info.value)
+        eng._step_fn = real_step
+    finally:
+        eng.stop()
+
+    bundles = sorted(tmp_path.glob("oom-*.json"))
+    assert bundles, "no forensics bundle written"
+    bundle = json.loads(bundles[-1].read_text())
+    assert bundle["kind"] == "tpu_oom_forensics"
+    assert bundle["context"] == "serve/decode_tick"
+    assert bundle["error"]["type"] == "FakeResourceExhausted"
+    assert "RESOURCE_EXHAUSTED" in bundle["error"]["message"]
+    # Per-device memory stats: one row per device, availability marked.
+    assert len(bundle["device_memory_stats"]) == len(jax.devices())
+    # Non-empty live-array census with the fields forensics needs.
+    census = bundle["live_array_census"]
+    assert census["available"] and len(census["rows"]) > 0
+    assert all({"nbytes", "shape", "dtype"} <= set(r)
+               for r in census["rows"])
+    # Compile-cache summary covers the watched decode entrypoints.
+    assert "decode_step_slots" in bundle["compile_cache"]["fns"]
+    # The hbm_plan expectation rode along.
+    assert bundle["hbm_plan"]["expected"]["total_gb"] == 1.23
+    # Recent event ring included (well-formed even when the bus is off).
+    assert "events" in bundle["recent_events"]
+
+
+def test_trace_oom_renders_bundle(tmp_path, monkeypatch, capsys):
+    from container_engine_accelerators_tpu.cli import trace as trace_cli
+
+    monkeypatch.setenv(introspection.OOM_DIR_ENV, str(tmp_path))
+    keep = jnp.ones((8, 8))
+    path = introspection.write_oom_bundle(
+        "unit/test", FakeResourceExhausted(OOM_MSG))
+    assert path is not None
+    rc = trace_cli.main(["oom", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unit/test" in out
+    assert "FakeResourceExhausted" in out
+    assert "live arrays" in out
+    # Not-a-bundle input is a usage error, not a crash.
+    bogus = tmp_path / "x.json"
+    bogus.write_text("{}")
+    assert trace_cli.main(["oom", str(bogus)]) == 2
+    del keep
+
+
+# ---------- disabled-path zero overhead ----------
+
+def test_disabled_watch_allocates_nothing():
+    """The tracemalloc guard from test_events.py, applied to watch():
+    with the tracker disabled, a watched call performs zero retained
+    allocations inside introspection.py."""
+    tracker = get_tracker()
+    assert not tracker.enabled
+    calls = []
+    f = watch(lambda a, b: calls.append(None), "disabled_hot")
+    arg = jnp.ones((4,))
+    for _ in range(20):  # warm every code path
+        f(arg, 3)
+
+    ifile = introspection.__file__
+    tracemalloc.start()
+    try:
+        s0 = tracemalloc.take_snapshot()
+        for _ in range(500):
+            f(arg, 3)
+        s1 = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+
+    leaked = [d for d in s1.compare_to(s0, "lineno")
+              if d.size_diff > 0 and d.traceback[0].filename == ifile]
+    total = sum(d.size_diff for d in leaked)
+    assert total < 1024, (total, [str(d) for d in leaked])
+    assert len(calls) == 520  # the wrapped fn always runs
+
+    # Enabled-but-unavailable poller paths never raise either.
+    poller = HbmPoller(stats_fn=lambda: [])
+    assert poller.poll_once() == []
+
+
+def test_watch_passthrough_results_and_errors():
+    f = watch(jax.jit(lambda x: x * 3), "passthrough")
+    out = f(jnp.asarray([2.0]))
+    assert float(out[0]) == 6.0
+    install()
+    out = f(jnp.asarray([4.0]))
+    assert float(out[0]) == 12.0
+
+    def boom(x):
+        raise RuntimeError("boom")
+
+    g = watch(boom, "raising")
+    with pytest.raises(RuntimeError, match="boom"):
+        g(1)

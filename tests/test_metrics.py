@@ -136,6 +136,13 @@ def test_metric_server_scrape(tmp_path):
             'pod="train-0",tpu_chip="accel1"} 85.5' in text)
     assert ('memory_used{container="main",model="v5e",namespace="ml",'
             'pod="train-0",tpu_chip="accel1"} 8.589934592e+09' in text)
+    # Explicit-unit per-chip family (ISSUE 5 satellite): the sampler's
+    # mem_used/mem_total now reach /metrics under tpu_chip_* names.
+    assert ('tpu_chip_memory_used_bytes{model="v5e",tpu_chip="accel1"} '
+            '8.589934592e+09' in text)
+    assert ms.registry.get_sample_value(
+        "tpu_chip_memory_total_bytes",
+        {"model": "v5e", "tpu_chip": "accel0"}) == 16 << 30
     # Renamed to match the reference's request_* family; the old name
     # stays registered as a deprecated alias for one release.
     assert ('request_tpu_chips{container="main",namespace="ml",'

@@ -200,12 +200,20 @@ def main():
                 rec = latency_percentile_phase(
                     params, cache, step, toks, active, n_slots,
                     max_len, min(args.steps, 32))
+                from container_engine_accelerators_tpu.metrics import (
+                    introspection,
+                )
                 print(json.dumps({
                     "engine": engine, "slots": n_slots,
                     "kv_dtype": kv_dtype,
                     "step_ms": round(dt * 1e3, 3),
                     "tokens_per_s": round(n_slots / dt, 1),
                     "max_len": max_len,
+                    # Process-lifetime allocator high-water mark at
+                    # line-emit time (monotone across lines; null on
+                    # backends without memory_stats): the per-config
+                    # KV footprint trend reads off adjacent lines.
+                    "peak_hbm_bytes": introspection.peak_hbm_bytes(),
                     # Recorder-derived percentile columns (ms). TTFT
                     # here = first fenced decode step (no prefill/queue
                     # in this harness); TPOT = per-step inter-token gap.
