@@ -82,8 +82,29 @@ trace-smoke:
 introspect-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_introspection.py -q
 
+# Hermetic perf gate (ISSUE 6): deterministic CPU tier (no TPU, no
+# network, bounded wall clock) gated on RELATIVE regressions against
+# the committed PERF_BASELINE.json with learned per-metric noise bands,
+# plus the CompileTracker hard gate (any steady-state recompile inside
+# a measurement window fails with the dimension diff). Exits non-zero
+# on `regression:*`, zero with a loud warning on `no_signal:*`; the
+# full report lands in PERF_GATE_REPORT.json.
+perf-gate:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/perf_gate.py check
+
+# Re-learn the baseline + noise bands (k runs, spread-derived bands).
+# Run on the machine class that runs `make perf-gate`, commit the
+# refreshed PERF_BASELINE.json with the PR that moved the numbers.
+perf-baseline:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/perf_gate.py baseline
+
+# Gate math + schema + hermetic-tier acceptance tests.
+perf-gate-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf_gate.py -q
+
 # The whole observability smoke family in one target.
-smoke: obs-smoke train-obs-smoke trace-smoke introspect-smoke
+smoke: obs-smoke train-obs-smoke trace-smoke introspect-smoke \
+    perf-gate-smoke perf-gate
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -95,4 +116,5 @@ clean:
 
 .PHONY: all native test test-quick device-injector-test presubmit bench \
     perf hbm-plan obs-smoke train-obs-smoke trace-smoke \
-    introspect-smoke smoke dryrun clean
+    introspect-smoke perf-gate perf-baseline perf-gate-smoke smoke \
+    dryrun clean

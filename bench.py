@@ -17,26 +17,44 @@ measurement) — a 17% tax no deployment pays. Stall robustness (VERDICT
 r1 item 2) is kept by timing MULTIPLE independent windows and taking
 the median window; wall-clock over all windows is reported alongside so
 a systematic gap between the two estimators stays visible.
+
+Round 6: the bench sits on the shared harness
+(container_engine_accelerators_tpu/bench_harness.py). The backend
+patience loop is GONE — BENCH_r04 burned 29 minutes waiting out an
+outage and BENCH_r05's patience outlasted the driver's own wall clock
+(rc=124, nothing on stdout). One bounded probe (default 120 s,
+BENCH_PROBE_TIMEOUT_S), and every emitted JSON — success or failure —
+carries the canonical schema: metric/value/unit/percentiles/status plus
+an explicit `backend_probe` attribution block, so a blank round is
+self-explaining instead of indistinguishable from a regression.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+from container_engine_accelerators_tpu import bench_harness as harness
 # Peak-FLOPs table + detection moved to the shared metrics layer in
 # round 6; re-exported here for tools/mfu_sweep.py and any older
 # callers of `from bench import detect_peak_flops`.
-from container_engine_accelerators_tpu.metrics import events, introspection
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: F401,E501
     PEAK_TFLOPS,
     detect_peak_flops,
 )
+
+METRIC = "llama_train_tokens_per_sec_per_chip"
+UNIT = "tokens/s/chip"
+
+# The probe that admitted this run — attached to every result,
+# including the failure paths, so BENCH_r*.json always says what
+# accelerator (if any) the numbers came from.
+_LAST_PROBE: dict | None = None
 
 
 def enable_trace_sidecar() -> None:
@@ -45,38 +63,15 @@ def enable_trace_sidecar() -> None:
     (BENCH_TRACE_PATH, default BENCH_trace.json) at exit — every bench
     run yields an openable timeline (windows, recorder counters,
     profiler markers), not just the one-line JSON."""
-    events.enable(
-        dump_path=os.environ.get("BENCH_TRACE_PATH", "BENCH_trace.json"),
-        signals=True, process_name="bench")
-
-
-_SIDECAR_FILE = None
+    harness.enable_trace("BENCH_trace.json", process_name="bench")
 
 
 def _sidecar(record: dict) -> None:
-    """Append one JSON line to the partial-results sidecar
-    (BENCH_JSONL_PATH, default BENCH_partial.jsonl): config starts,
-    per-window times, failures, and the final result stream out as they
-    happen, line-buffered — a kill between SIGTERM delivery and the
-    final stdout json.dumps still leaves machine-parseable data
-    (VERDICT r5's 'parseable no matter when killed', applied to the
-    window between the handler installing and the result landing)."""
-    global _SIDECAR_FILE
-    try:
-        if _SIDECAR_FILE is None:
-            _SIDECAR_FILE = open(
-                os.environ.get("BENCH_JSONL_PATH", "BENCH_partial.jsonl"),
-                "a", buffering=1)
-        rec = dict(record)
-        rec.setdefault("t", round(time.time(), 3))
-        _SIDECAR_FILE.write(json.dumps(rec) + "\n")
-        # Mirror the JSONL stream onto the flight-recorder timeline so
-        # the trace sidecar shows config starts/windows/failures too.
-        if events.enabled():
-            events.instant(f"bench/{rec.get('event', 'event')}", "bench",
-                           rec)
-    except OSError:
-        pass  # a sidecar failure must never cost the bench itself
+    """Partial-results JSONL sidecar (BENCH_JSONL_PATH, default
+    BENCH_partial.jsonl) via the shared harness: config starts,
+    per-window times, failures and the final result stream out
+    line-buffered, so a kill at ANY point leaves parseable data."""
+    harness.sidecar(record)
 
 
 def _is_outage(msg: str) -> bool:
@@ -94,114 +89,71 @@ def _is_outage(msg: str) -> bool:
 _JSON_EMITTED = False
 
 
-def _emit_unavailable(detail: str) -> None:
-    """One structured JSON line so a backend outage reads as an outage in
-    BENCH_r*.json, not a crash with parsed=null (round-3 verdict item 1)."""
+def _emit_no_signal(cause: str, detail: str) -> None:
+    """One structured, schema-complete JSON line so a backend outage
+    reads as `status: no_signal` with probe attribution in
+    BENCH_r*.json — never a crash with parsed=null (r03), never an
+    untagged zero (r04). The legacy error/detail keys stay for older
+    trajectory tooling."""
     global _JSON_EMITTED
     _JSON_EMITTED = True
-    _sidecar({"event": "outage", "detail": detail[-400:]})
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": 0.0,
-        "unit": "tokens/s/chip",
-        "vs_baseline": 0.0,
-        "error": "tpu_unavailable",
-        "detail": detail[-400:],
-    }))
+    probe = _LAST_PROBE if _LAST_PROBE is not None else \
+        harness._empty_probe("probe_error", "no probe ran", 0.0, 0.0,
+                             "none")
+    _sidecar({"event": "no_signal", "cause": cause,
+              "detail": detail[-400:]})
+    print(json.dumps(harness.check_result(harness.no_signal_result(
+        METRIC, UNIT, probe, cause,
+        # Legacy columns: r01–r05 consumers key on error/detail and a
+        # numeric value; keep them until the trajectory tooling moves.
+        value=0.0, error="tpu_unavailable", detail=detail[-400:],
+        vs_baseline=0.0))))
 
 
 def install_kill_handler() -> None:
-    """Emit the structured outage line when the driver kills the bench.
-
-    BENCH_r05.json was rc=124/parsed=null: the driver's wall clock
-    expired mid-probe and the process died with NOTHING on stdout, so
-    the round scored as a crash instead of an outage (VERDICT r5 round-6
-    non-negotiable). SIGTERM now drains through the same structured
-    emitter as every other failure path — and skips it if the real
-    result already went out (a kill AFTER the JSON line must not append
-    a second one)."""
-    import os
-    import signal
-
-    def _handler(signum, frame):
+    """Emit the structured no_signal line when the driver kills the
+    bench. BENCH_r05.json was rc=124/parsed=null: the driver's wall
+    clock expired and the process died with NOTHING on stdout, so the
+    round scored as a crash instead of an outage. SIGTERM drains
+    through the same structured emitter as every other failure path —
+    and skips it if the real result already went out."""
+    def _on_term(signum):
         if not _JSON_EMITTED:
-            _emit_unavailable(
+            _emit_no_signal(
+                "killed_mid_run",
                 f"killed by signal {signum} mid-run (driver wall-clock "
                 "kill; treat as outage/timeout, not a crash)")
-        # os._exit skips atexit: flush the flight-recorder ring here so
-        # a driver kill still leaves the timeline sidecar (dump_now is
-        # a no-op unless enable_trace_sidecar armed it).
-        events.instant("bench/killed", "flight", {"signal": signum})
-        events.dump_now()
-        sys.stdout.flush()
-        sys.stderr.flush()
-        os._exit(0)
 
-    signal.signal(signal.SIGTERM, _handler)
+    harness.install_sigterm_flush(_on_term)
 
 
 def require_backend(budget_s: float | None = None,
-                    timeout_s: float = 120.0,
+                    timeout_s: float | None = None,
                     interval_s: float | None = None) -> bool:
-    """Prove the accelerator backend can initialise before touching it
-    in-process. With this environment's TPU plugin registered, a downed
-    tunnel makes ANY in-process jax.devices() call hang or raise inside
-    backends() with no interruptible point — so the probe runs in a
-    throwaway subprocess under a hard timeout (shared with the dryrun
-    entry: __graft_entry__.probe_default_backend).
+    """ONE bounded backend probe in a throwaway subprocess — with this
+    environment's TPU plugin registered, a downed tunnel makes ANY
+    in-process jax.devices() call hang inside backends() with no
+    interruptible point, so the probe must be killable from outside.
 
-    Patience is a BUDGET, not an attempt count (verdict r4 item 4: the
-    round-4 outage outlasted the old ~6-minute retry, zeroing the
-    round's scoreboard): keep polling every `interval_s` until
-    `budget_s` wall-clock has elapsed, so only an outage longer than
-    the whole budget — not a transient flap — produces the structured
-    `tpu_unavailable` line. Defaults: 4 min budget, 60 s between probes
-    (each probe itself may block up to `timeout_s`) — the old 30-minute
-    default outlasted the DRIVER'S wall clock, so the driver's SIGKILL
-    landed before the outage line could (BENCH_r05 rc=124/parsed=null;
-    the SIGTERM handler is the belt, this default is the suspenders).
-    Both knobs stay overridable via BENCH_BACKEND_WAIT_S /
-    BENCH_BACKEND_POLL_S when the driver's allowance is known to be
-    longer. Returns True when the backend is up; emits the outage line
-    and returns False otherwise."""
-    import os
-
-    from __graft_entry__ import probe_default_backend
-
-    def env_float(name, default):
-        # A malformed knob must degrade to the default, not crash before
-        # the structured outage line can be emitted.
-        try:
-            return float(os.environ.get(name, default))
-        except ValueError:
-            print(f"ignoring unparseable {name}={os.environ[name]!r}; "
-                  f"using {default}", file=sys.stderr)
-            return float(default)
-
-    if budget_s is None:
-        budget_s = env_float("BENCH_BACKEND_WAIT_S", 240)
-    if interval_s is None:
-        interval_s = env_float("BENCH_BACKEND_POLL_S", 60)
-    deadline = time.monotonic() + budget_s
-    attempt, last = 0, "no attempt ran"
-    while True:
-        attempt += 1
-        n_dev, last = probe_default_backend(timeout_s=timeout_s)
-        if n_dev > 0:
-            if attempt > 1:
-                print(f"backend recovered on probe {attempt}",
-                      file=sys.stderr)
-            return True
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            break
-        print(f"backend probe {attempt} failed ({last.strip()[-120:]}); "
-              f"{remaining:.0f}s of patience left", file=sys.stderr)
-        time.sleep(min(interval_s, remaining))
-    # Truncate the raw probe error FIRST: _emit_unavailable keeps only
-    # the detail tail, which must not cut off the patience accounting.
-    _emit_unavailable(f"after {attempt} probes over {budget_s:.0f}s "
-                      f"budget: {last[-300:]}")
+    The round-4/5 patience loop is deliberately gone: patience turned a
+    29-minute outage into a 29-minute-plus-nothing round (r04) and then
+    outlasted the driver's own wall clock (r05 rc=124). Fast-fail with
+    attribution is the contract now; the probe's outcome block lands in
+    the emitted JSON either way. `budget_s`/`timeout_s` both override
+    the probe timeout (smallest wins; `budget_s` kept for
+    tools/perf_fire.py's call signature), default 120 s via
+    BENCH_PROBE_TIMEOUT_S. `interval_s` is accepted and ignored — there
+    is nothing to poll anymore."""
+    global _LAST_PROBE
+    limits = [v for v in (budget_s, timeout_s) if v is not None]
+    probe_timeout = min(limits) if limits else harness.probe_timeout_s()
+    _LAST_PROBE = harness.probe_backend(timeout_s=probe_timeout)
+    _sidecar({"event": "backend_probe", **_LAST_PROBE})
+    if _LAST_PROBE["outcome"] == "ok":
+        return True
+    _emit_no_signal("backend_" + _LAST_PROBE["outcome"],
+                    _LAST_PROBE["detail"]
+                    or f"backend probe {_LAST_PROBE['outcome']}")
     return False
 
 
@@ -342,23 +294,29 @@ def _run_one(config_name, cfg_overrides, mu_dtype):
     # stays as a robustness diagnostic in `value`/`unit`.
     global _JSON_EMITTED
     _JSON_EMITTED = True
-    payload = {
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tok_per_sec_per_chip, 1),
-        "unit": f"tokens/s/chip (MFU={mfu:.3f})",
-        "vs_baseline": round(wall_mfu / 0.40, 3),
-        "vs_baseline_estimator": "wallclock",
-        "estimator": "median-window-pipelined",
-        "wallclock_tokens_per_sec_per_chip": round(wall_tok_per_sec, 1),
-        "wallclock_mfu": round(wall_mfu, 3),
-        "step_ms": step_pcts,
-        "config": config_name,
-        # Runtime high-water mark (metrics/introspection.py): lets the
-        # BENCH_r*.json trajectory catch a memory regression the same
-        # way it catches a throughput one. null where the backend
-        # exposes no memory_stats (CPU smoke runs).
-        "peak_hbm_bytes": introspection.peak_hbm_bytes(),
-    }
+    probe = _LAST_PROBE if _LAST_PROBE is not None else \
+        harness.probe_block_in_process()
+    payload = harness.make_result(
+        METRIC, round(tok_per_sec_per_chip, 1),
+        f"{UNIT} (MFU={mfu:.3f})",
+        percentiles={"step_ms": step_pcts},
+        backend_probe=probe, status="ok",
+        vs_baseline=round(wall_mfu / 0.40, 3),
+        vs_baseline_estimator="wallclock",
+        estimator="median-window-pipelined",
+        wallclock_tokens_per_sec_per_chip=round(wall_tok_per_sec, 1),
+        wallclock_mfu=round(wall_mfu, 3),
+        # step_ms stays as a top-level legacy column (r02+ consumers);
+        # the canonical home is percentiles["step_ms"].
+        step_ms=step_pcts,
+        config=config_name)
+    # Runtime high-water mark (metrics/introspection.py): lets the
+    # BENCH_r*.json trajectory catch a memory regression the same way
+    # it catches a throughput one. OMITTED with a logged reason where
+    # the backend exposes no memory_stats (CPU smoke runs) — absence
+    # means "not measurable here", never "zero".
+    harness.attach_peak_hbm(payload, context="bench")
+    harness.check_result(payload)
     _sidecar({"event": "result", **payload})
     print(json.dumps(payload))
     # Timeline sidecar lands with the result (atexit is the backstop).
@@ -375,6 +333,6 @@ if __name__ == "__main__":
     except Exception as e:  # mid-run flap: still emit the structured line
         msg = f"{type(e).__name__}: {e}"
         if _is_outage(msg):
-            _emit_unavailable(msg)
+            _emit_no_signal("backend_lost_mid_run", msg)
             sys.exit(0)
         raise

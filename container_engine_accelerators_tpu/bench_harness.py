@@ -1,0 +1,436 @@
+"""Shared bench harness (ISSUE 6 tentpole): one probe/warmup/timing/
+emission layer under bench.py, tools/serve_bench.py,
+tools/component_bench.py and the perf gate (tools/perf_gate.py).
+
+Why this exists: BENCH_r03–r05 burned three rounds of perf history on
+one backend-init flake. r03 died with a raw traceback (nothing
+parseable), r04 waited out a patience loop and emitted an untagged
+zero, r05's patience outlasted the DRIVER's wall clock so SIGKILL
+landed first (rc=124, parsed=null). Three different spellings of the
+same event, none of them machine-distinguishable from a perf
+regression. The harness makes "no data" a first-class, self-explaining
+result:
+
+**Canonical result schema.** Every bench JSON — including failures —
+is one object carrying `REQUIRED_KEYS`:
+
+  metric         str    what was measured
+  value          number|null  the headline number (null on no_signal)
+  unit           str    unit of `value`
+  percentiles    dict   series -> {"p50": ..., "p95": ..., "p99": ...}
+                        (recorder-derived where a recorder exists; {}
+                        when the run produced no samples)
+  backend_probe  dict   explicit attribution of the accelerator the
+                        numbers came from — or didn't (see below)
+  status         str    "ok" | "no_signal" | "failed"
+
+`validate_result` is the tiny schema checker the tests and the gate
+both import — one definition, so the three benches can never drift
+apart again.
+
+**Backend probe, bounded, attributed.** `probe_backend()` is a SINGLE
+attempt in a throwaway subprocess under a hard timeout (default 120 s,
+BENCH_PROBE_TIMEOUT_S): with this environment's TPU plugin registered,
+a downed tunnel makes ANY in-process jax.devices() call hang inside
+backends() with no interruptible point (the BENCH_r03 traceback), and
+patience loops are how r04/r05 died. The returned block records jax
+version, platform, device kind, device count, probe latency and
+outcome — attached to every result so a blank round explains itself.
+`probe_block_in_process()` builds the same block from an
+already-initialized backend (the CPU-hermetic tier, post-init benches).
+
+**Sidecars + SIGTERM flush.** `sidecar()` streams line-buffered JSONL
+partial results (BENCH_JSONL_PATH), `enable_trace()` arms the flight
+recorder, and `install_sigterm_flush()` routes a driver kill through a
+caller-supplied structured emitter before flushing the event ring and
+exiting — a kill at ANY point leaves parseable data.
+
+**Recompile hard gate.** `RecompileGuard` snapshots the CompileTracker
+(metrics/introspection.py) around a measurement window; any
+steady-state recompile inside the window surfaces with its fn label
+and the logged dimension diff, so the perf gate can fail the run
+instead of averaging a compile into the timings.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from container_engine_accelerators_tpu.metrics import events
+from container_engine_accelerators_tpu.metrics.request_metrics import (  # noqa: F401,E501
+    percentile,
+    percentiles,
+)
+
+log = logging.getLogger(__name__)
+
+REQUIRED_KEYS = ("metric", "value", "unit", "percentiles",
+                 "backend_probe", "status")
+STATUSES = ("ok", "no_signal", "failed")
+
+PROBE_TIMEOUT_ENV = "BENCH_PROBE_TIMEOUT_S"
+DEFAULT_PROBE_TIMEOUT_S = 120.0
+# Warmup policy shared by the benches: enough to cover compile + first
+# dispatch on every backend; each extra step costs real TPU-window time.
+DEFAULT_WARMUP_STEPS = 2
+
+_PROBE_KEYS = ("outcome", "jax_version", "platform", "device_kind",
+               "n_devices", "probe_latency_s")
+
+
+def env_float(name: str, default: float) -> float:
+    """Env knob that degrades to the default on garbage instead of
+    crashing before a structured result can be emitted."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"ignoring unparseable {name}={raw!r}; using {default}",
+              file=sys.stderr)
+        return float(default)
+
+
+def probe_timeout_s() -> float:
+    return env_float(PROBE_TIMEOUT_ENV, DEFAULT_PROBE_TIMEOUT_S)
+
+
+# One python -c line so the probe needs no repo on its sys.path; the
+# marker prefix keeps the JSON findable under jax's own stdout noise.
+_PROBE_MARKER = "BENCH_PROBE_JSON="
+_PROBE_CODE = (
+    "import json, jax\n"
+    "devs = jax.devices()\n"
+    "d = devs[0] if devs else None\n"
+    "print(%r + json.dumps({'n_devices': len(devs),"
+    " 'platform': getattr(d, 'platform', None),"
+    " 'device_kind': getattr(d, 'device_kind', None),"
+    " 'jax_version': jax.__version__}))\n" % _PROBE_MARKER
+)
+
+
+def _empty_probe(outcome: str, detail: str, latency_s: float,
+                 timeout_s: float, mode: str) -> dict:
+    return {"outcome": outcome, "jax_version": None, "platform": None,
+            "device_kind": None, "n_devices": 0,
+            "probe_latency_s": round(latency_s, 3),
+            "timeout_s": round(timeout_s, 1), "mode": mode,
+            "detail": detail[-400:]}
+
+
+def probe_backend(timeout_s: float | None = None) -> dict:
+    """ONE bounded backend-init attempt in a throwaway subprocess;
+    returns the backend_probe attribution block. Never raises, never
+    retries: fast-fail with attribution is the whole point (the old
+    patience loop is how BENCH_r04/r05 died). outcome is one of
+    "ok" | "timeout" | "init_failed" | "probe_error"."""
+    if timeout_s is None:
+        timeout_s = probe_timeout_s()
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], env=dict(os.environ),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return _empty_probe(
+            "timeout", f"backend init exceeded {timeout_s:.0f}s",
+            time.monotonic() - t0, timeout_s, "subprocess")
+    except OSError as e:
+        return _empty_probe("probe_error", f"probe spawn failed: {e}",
+                            time.monotonic() - t0, timeout_s,
+                            "subprocess")
+    latency = time.monotonic() - t0
+    if proc.returncode != 0:
+        return _empty_probe(
+            "init_failed", (proc.stderr or proc.stdout).strip(),
+            latency, timeout_s, "subprocess")
+    for line in proc.stdout.splitlines():
+        if line.startswith(_PROBE_MARKER):
+            try:
+                info = json.loads(line[len(_PROBE_MARKER):])
+            except ValueError:
+                break
+            return {"outcome": "ok" if info.get("n_devices") else
+                    "init_failed",
+                    "jax_version": info.get("jax_version"),
+                    "platform": info.get("platform"),
+                    "device_kind": info.get("device_kind"),
+                    "n_devices": int(info.get("n_devices") or 0),
+                    "probe_latency_s": round(latency, 3),
+                    "timeout_s": round(timeout_s, 1),
+                    "mode": "subprocess", "detail": ""}
+    return _empty_probe(
+        "probe_error", f"unparseable probe output: {proc.stdout[-200:]!r}",
+        latency, timeout_s, "subprocess")
+
+
+def probe_block_in_process() -> dict:
+    """The same attribution block, read off an already-initialized (or
+    known-safe, e.g. forced-CPU) backend in THIS process. Only call
+    when init cannot hang — a hermetic tier, or after a subprocess
+    probe said ok."""
+    t0 = time.monotonic()
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception as e:  # init failure still yields attribution
+        return _empty_probe("init_failed", f"{type(e).__name__}: {e}",
+                            time.monotonic() - t0, 0.0, "in_process")
+    d = devs[0] if devs else None
+    return {"outcome": "ok" if devs else "init_failed",
+            "jax_version": jax.__version__,
+            "platform": getattr(d, "platform", None),
+            "device_kind": getattr(d, "device_kind", None),
+            "n_devices": len(devs),
+            "probe_latency_s": round(time.monotonic() - t0, 3),
+            "timeout_s": 0.0, "mode": "in_process", "detail": ""}
+
+
+# ---------- canonical result schema ----------
+
+def make_result(metric: str, value, unit: str, *,
+                percentiles: dict | None = None,
+                backend_probe: dict | None = None,
+                status: str = "ok", **extra) -> dict:
+    """One schema-complete result object. Extra keys ride along after
+    the canonical ones (legacy columns, bench-specific context)."""
+    out = {"metric": metric, "value": value, "unit": unit,
+           "percentiles": percentiles if percentiles is not None else {},
+           "backend_probe": backend_probe
+           if backend_probe is not None else probe_block_in_process(),
+           "status": status}
+    out.update(extra)
+    return out
+
+
+def no_signal_result(metric: str, unit: str, backend_probe: dict,
+                     cause: str, **extra) -> dict:
+    """The structured blank: status no_signal + probe attribution, so
+    a flaked round is skippable-by-machine instead of a fake zero.
+    `value` defaults to null but may be overridden via extra (bench.py
+    keeps the legacy 0.0 its older consumers key on)."""
+    value = extra.pop("value", None)
+    return make_result(metric, value, unit, percentiles={},
+                       backend_probe=backend_probe, status="no_signal",
+                       no_signal_cause=cause, **extra)
+
+
+def validate_result(d) -> list[str]:
+    """Schema problems of one bench result object ([] when valid) —
+    the tiny checker tests and the gate both import. Accepts any
+    pNN percentile keys; inner values must be numeric or null."""
+    problems = []
+    if not isinstance(d, dict):
+        return [f"result is {type(d).__name__}, not dict"]
+    for k in REQUIRED_KEYS:
+        if k not in d:
+            problems.append(f"missing key {k!r}")
+    if "status" in d and d["status"] not in STATUSES:
+        problems.append(f"status {d['status']!r} not in {STATUSES}")
+    if "value" in d and d["value"] is not None \
+            and not isinstance(d["value"], (int, float)):
+        problems.append(f"value {d['value']!r} is not numeric/null")
+    if "metric" in d and not (isinstance(d["metric"], str)
+                              and d["metric"]):
+        problems.append("metric must be a non-empty string")
+    if "unit" in d and not isinstance(d["unit"], str):
+        problems.append("unit must be a string")
+    pcts = d.get("percentiles")
+    if pcts is not None:
+        if not isinstance(pcts, dict):
+            problems.append("percentiles must be a dict")
+        else:
+            for series, pd in pcts.items():
+                if not isinstance(pd, dict):
+                    problems.append(
+                        f"percentiles[{series!r}] must be a dict")
+                    continue
+                for pk, pv in pd.items():
+                    if not (pk.startswith("p")
+                            and pk[1:].replace(".", "", 1).isdigit()):
+                        problems.append(
+                            f"percentiles[{series!r}] key {pk!r} is "
+                            "not pNN")
+                    if pv is not None and not isinstance(
+                            pv, (int, float)):
+                        problems.append(
+                            f"percentiles[{series!r}][{pk}] not "
+                            "numeric/null")
+    probe = d.get("backend_probe")
+    if probe is not None:
+        if not isinstance(probe, dict):
+            problems.append("backend_probe must be a dict")
+        else:
+            for k in _PROBE_KEYS:
+                if k not in probe:
+                    problems.append(f"backend_probe missing {k!r}")
+    return problems
+
+
+def check_result(d) -> dict:
+    """validate_result that raises (ValueError listing every problem)
+    — the emit-time self-check, so a schema drift fails the bench that
+    introduced it instead of the consumer three rounds later."""
+    problems = validate_result(d)
+    if problems:
+        raise ValueError("bench result schema violation: "
+                         + "; ".join(problems))
+    return d
+
+
+# ---------- timing helpers ----------
+
+def build_page_tables(n_slots: int, max_pages: int):
+    """Distinct pool rows for every (slot, page): tables [n_slots,
+    max_pages] int32 and the pool size n_pages that backs them.
+
+    Steady-state serving never aliases two live (slot, page) pairs onto
+    one pool row — the allocator hands every live page its own row. An
+    earlier bench sized the pool at the engine's oversubscribed default
+    and silently pointed the overflow at the trash row, so half the
+    "cache" collapsed into one hot page and the paged numbers measured
+    a layout serving never produces (ADVICE r5). Row 0 stays reserved
+    as the trash page, exactly like the engine's pools. Shared by
+    tools/serve_bench.py and the perf gate's paged tier."""
+    import numpy as np
+
+    n_pages = n_slots * max_pages + 1
+    tables = np.arange(1, n_pages, dtype=np.int32).reshape(
+        n_slots, max_pages)
+    return tables, n_pages
+
+
+def pct_ms(samples_s, ps=(50, 95, 99)) -> dict:
+    """Per-step seconds -> {"p50": ms, ...} via the shared nearest-rank
+    helper; values rounded to µs precision."""
+    out = {}
+    for p in ps:
+        v = percentile(list(samples_s), p)
+        out[f"p{p}"] = None if v is None else round(v * 1e3, 3)
+    return out
+
+
+def median(xs):
+    return percentile(list(xs), 50)
+
+
+def attach_peak_hbm(payload: dict, context: str = "bench") -> dict:
+    """Record the runtime HBM high-water mark when the backend exposes
+    one; on backends without memory_stats (the CPU tier) the field is
+    OMITTED with a logged reason — never null, never garbage, so
+    trajectory tooling can treat presence as meaning."""
+    from container_engine_accelerators_tpu.metrics import introspection
+    peak = introspection.peak_hbm_bytes()
+    if peak is None:
+        log.info("%s: peak_hbm_bytes omitted — no local device exposes "
+                 "memory_stats() (CPU backend or old jax)", context)
+        print(f"{context}: peak_hbm_bytes omitted (backend has no "
+              "memory_stats)", file=sys.stderr)
+    else:
+        payload["peak_hbm_bytes"] = peak
+    return payload
+
+
+# ---------- sidecars + kill flush ----------
+
+_SIDECAR_FILES: dict = {}
+
+
+def sidecar(record: dict, path: str | None = None,
+            env: str = "BENCH_JSONL_PATH",
+            default: str = "BENCH_partial.jsonl") -> None:
+    """Append one JSON line to the partial-results sidecar,
+    line-buffered, mirrored onto the flight-recorder timeline — a kill
+    at ANY point leaves parseable partial data. A sidecar failure must
+    never cost the bench itself."""
+    try:
+        if path is None:
+            path = os.environ.get(env, default)
+        f = _SIDECAR_FILES.get(path)
+        if f is None:
+            f = _SIDECAR_FILES[path] = open(path, "a", buffering=1)
+        rec = dict(record)
+        rec.setdefault("t", round(time.time(), 3))
+        f.write(json.dumps(rec) + "\n")
+        if events.enabled():
+            events.instant(f"bench/{rec.get('event', 'event')}", "bench",
+                           rec)
+    except (OSError, TypeError, ValueError):
+        log.debug("bench sidecar write failed", exc_info=True)
+
+
+def enable_trace(default_path: str, env: str = "BENCH_TRACE_PATH",
+                 process_name: str = "bench") -> None:
+    """Arm the flight recorder: the EventBus ring dumps as Chrome-trace
+    JSON next to the structured results at exit, so every bench run
+    yields an openable timeline, not just the one-line JSON."""
+    events.enable(dump_path=os.environ.get(env, default_path),
+                  signals=True, process_name=process_name)
+
+
+def install_sigterm_flush(on_term) -> None:
+    """Route a driver kill through `on_term(signum)` (the caller's
+    structured no_signal emitter), then flush the flight-recorder ring
+    and both stdio streams before os._exit(0) — BENCH_r05 died with
+    NOTHING on stdout because SIGKILL beat the patience loop; the
+    SIGTERM path must never leave a blank."""
+    import signal
+
+    def _handler(signum, frame):
+        try:
+            on_term(signum)
+        except Exception:
+            log.exception("SIGTERM emitter failed")
+        events.instant("bench/killed", "flight", {"signal": signum})
+        events.dump_now()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _handler)
+
+
+# ---------- recompile hard gate ----------
+
+class RecompileGuard:
+    """Snapshot the CompileTracker's steady-state recompile counters
+    around a measurement window. `new_recompiles()` names every fn that
+    recompiled INSIDE the window, with the logged dimension diff — the
+    perf gate fails the run on any of them instead of letting a compile
+    masquerade as a slow step. The tracker must be enabled
+    (introspection.install()) for the counters to move."""
+
+    def __init__(self):
+        from container_engine_accelerators_tpu.metrics.introspection import (
+            get_tracker,
+        )
+        self._tracker = get_tracker()
+        self._before: dict = {}
+
+    def _counts(self) -> dict:
+        return {fn: d.get("recompiles", 0)
+                for fn, d in self._tracker.summary()["fns"].items()}
+
+    def __enter__(self):
+        self._before = self._counts()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def new_recompiles(self) -> list[dict]:
+        out = []
+        fns = self._tracker.summary()["fns"]
+        for fn, d in fns.items():
+            delta = d.get("recompiles", 0) - self._before.get(fn, 0)
+            if delta > 0:
+                out.append({"fn": fn, "recompiles": delta,
+                            "diff": d.get("last_recompile_diff")
+                            or "no diff recorded"})
+        return sorted(out, key=lambda r: r["fn"])

@@ -254,7 +254,7 @@ class _Watched:
     factory never read each other's signatures as recompiles."""
 
     __slots__ = ("name", "lock", "sigs", "last_sig", "compiles",
-                 "recompiles")
+                 "recompiles", "last_diff")
 
     def __init__(self, name: str):
         self.name = name
@@ -263,6 +263,11 @@ class _Watched:
         self.last_sig = None
         self.compiles = 0
         self.recompiles = 0
+        # Text of the most recent steady-state recompile's signature
+        # diff — kept so the perf gate (tools/perf_gate.py via
+        # bench_harness.RecompileGuard) can attach the offending
+        # dimension to its report, not just the count.
+        self.last_diff: str | None = None
 
 
 class CompileTracker:
@@ -405,6 +410,8 @@ class CompileTracker:
                      st.name, ctx["compile_s"])
             return
         diff = _sig_diff(prev_sig, sig)
+        with st.lock:
+            st.last_diff = diff
         self.recompiles_total.labels(fn=st.name).inc()
         log.warning(
             "steady-state XLA recompile #%d of %s (%.3fs compile "
@@ -437,10 +444,13 @@ class CompileTracker:
                 d = fns.setdefault(st.name, {"compiles": 0,
                                              "recompiles": 0,
                                              "signatures": 0,
-                                             "last_signature": None})
+                                             "last_signature": None,
+                                             "last_recompile_diff": None})
                 d["compiles"] += st.compiles
                 d["recompiles"] += st.recompiles
                 d["signatures"] += len(st.sigs)
+                if st.last_diff is not None:
+                    d["last_recompile_diff"] = st.last_diff
                 if st.last_sig is not None:
                     d["last_signature"] = [
                         f"{k}: {_fmt_entry((k, s, t))}"
@@ -761,5 +771,6 @@ def _reset_for_tests() -> None:
                 st.last_sig = None
                 st.compiles = 0
                 st.recompiles = 0
+                st.last_diff = None
     _EXPECTED_HBM = None
     LAST_BUNDLE_PATH = None

@@ -22,10 +22,10 @@ def _bench_sidecar_to_tmp(tmp_path, monkeypatch):
     """The bench tests below drive bench's outage/ladder paths, which
     stream partial results to the JSONL sidecar — route it into the
     test tmpdir so suite runs never litter the repo root."""
-    import bench
+    from container_engine_accelerators_tpu import bench_harness
 
     monkeypatch.setenv("BENCH_JSONL_PATH", str(tmp_path / "partial.jsonl"))
-    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)
+    monkeypatch.setattr(bench_harness, "_SIDECAR_FILES", {})
 
 
 def test_env_forces_cpu_mesh_detection(monkeypatch):
@@ -117,13 +117,16 @@ def test_env_forced_dryrun_failure_propagates(monkeypatch):
 
 
 def test_bench_emits_structured_outage_line(monkeypatch, capsys):
-    """bench.require_backend: probe exhaustion must print ONE parseable
-    JSON line carrying error=tpu_unavailable (never a traceback)."""
+    """bench.require_backend: a failed probe must print ONE parseable,
+    schema-complete JSON line carrying status=no_signal + the
+    backend_probe attribution block (never a traceback). The legacy
+    error=tpu_unavailable column stays for older trajectory tooling."""
     import json
 
     import bench
+    from container_engine_accelerators_tpu import bench_harness
 
-    real_run = entry.subprocess.run
+    real_run = bench_harness.subprocess.run
 
     def crash_run(cmd, **kw):
         cmd = [cmd[0], "-c",
@@ -131,12 +134,14 @@ def test_bench_emits_structured_outage_line(monkeypatch, capsys):
                "sys.exit(1)"]
         return real_run(cmd, **kw)
 
-    # bench delegates to the shared probe in __graft_entry__.
-    monkeypatch.setattr(entry.subprocess, "run", crash_run)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    assert not bench.require_backend(budget_s=0.0, timeout_s=30.0)
+    # bench delegates to the shared probe in bench_harness.
+    monkeypatch.setattr(bench_harness.subprocess, "run", crash_run)
+    assert not bench.require_backend(timeout_s=30.0)
     out = capsys.readouterr().out.strip().splitlines()
     rec = json.loads(out[-1])
+    assert bench_harness.validate_result(rec) == []
+    assert rec["status"] == "no_signal"
+    assert rec["backend_probe"]["outcome"] == "init_failed"
     assert rec["error"] == "tpu_unavailable"
     assert rec["metric"] == "llama_train_tokens_per_sec_per_chip"
     assert "tunnel down" in rec["detail"]
@@ -179,54 +184,54 @@ def test_bench_config_ladder_aborts_on_outage(monkeypatch):
     assert calls == ["tri+save_attn+bf16mu"]
 
 
-def test_bench_patience_rides_out_transient_outage(monkeypatch, capsys):
-    """Verdict r4 item 4: patience is a wall-clock BUDGET. A probe that
-    recovers on attempt 4 must yield True (and no outage line) as long
-    as the budget hasn't expired — a transient flap can't zero a
-    round's scoreboard."""
-    import bench
-
-    calls = {"n": 0}
-
-    def flaky_probe(timeout_s):
-        calls["n"] += 1
-        if calls["n"] >= 4:
-            return 1, ""
-        return 0, "UNAVAILABLE: tunnel down"
-
-    clock = {"t": 0.0}
-    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
-    monkeypatch.setattr(bench.time, "sleep",
-                        lambda s: clock.__setitem__("t", clock["t"] + s))
-    import __graft_entry__ as ge
-    monkeypatch.setattr(ge, "probe_default_backend", flaky_probe)
-    assert bench.require_backend(budget_s=1800.0, interval_s=150.0)
-    assert calls["n"] == 4
-    assert bench.time.monotonic() == pytest.approx(450.0)  # 3 waits
-    assert capsys.readouterr().out.strip() == ""  # no outage JSON line
-
-
-def test_bench_patience_budget_bounds_total_wait(monkeypatch, capsys):
-    """An outage longer than the budget still terminates: probes stop
-    once the budget is spent and the structured line records the spend."""
+def test_bench_probe_is_single_and_bounded(monkeypatch, capsys):
+    """ISSUE 6 satellite: the r04/r05 patience loop is GONE. A dead
+    backend costs exactly ONE bounded probe — no retries, no sleeps —
+    and the structured no_signal line goes out immediately. (r04 burned
+    29 minutes of patience; r05's patience outlasted the driver's wall
+    clock and the round died with nothing on stdout.)"""
     import json
 
     import bench
+    from container_engine_accelerators_tpu import bench_harness
 
     calls = {"n": 0}
 
-    def dead_probe(timeout_s):
+    def dead_probe(timeout_s=None):
         calls["n"] += 1
-        return 0, "UNAVAILABLE: tunnel down"
+        return bench_harness._empty_probe(
+            "init_failed", "UNAVAILABLE: tunnel down", 0.5,
+            timeout_s or 120.0, "subprocess")
 
-    clock = {"t": 0.0}
-    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
-    monkeypatch.setattr(bench.time, "sleep",
-                        lambda s: clock.__setitem__("t", clock["t"] + s))
-    import __graft_entry__ as ge
-    monkeypatch.setattr(ge, "probe_default_backend", dead_probe)
+    monkeypatch.setattr(bench_harness, "probe_backend", dead_probe)
+    monkeypatch.setattr(
+        bench.time, "sleep",
+        lambda s: pytest.fail("fast-fail probe must never sleep"))
     assert not bench.require_backend(budget_s=600.0, interval_s=150.0)
-    assert calls["n"] == 5  # t=0,150,300,450,600 then budget exhausted
+    assert calls["n"] == 1  # single probe, regardless of legacy budget
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["status"] == "no_signal"
+    assert rec["no_signal_cause"] == "backend_init_failed"
     assert rec["error"] == "tpu_unavailable"
-    assert "5 probes" in rec["detail"]
+
+
+def test_bench_probe_timeout_fast_fails(monkeypatch, capsys):
+    """A wedged backend init reads as outcome=timeout within the probe
+    budget (default 120 s, BENCH_PROBE_TIMEOUT_S) — never a hang."""
+    import json
+
+    import bench
+    from container_engine_accelerators_tpu import bench_harness
+
+    real_run = bench_harness.subprocess.run
+
+    def slow_run(cmd, **kw):
+        cmd = [cmd[0], "-c", "import time; time.sleep(30)"]
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(bench_harness.subprocess, "run", slow_run)
+    assert not bench.require_backend(timeout_s=1.0)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["status"] == "no_signal"
+    assert rec["backend_probe"]["outcome"] == "timeout"
+    assert "exceeded" in rec["backend_probe"]["detail"]

@@ -1,0 +1,376 @@
+"""Perf-gate coverage (ISSUE 6): the gate math edge cases, the torn-
+baseline re-parse, the canonical bench schema, and the hermetic tier's
+acceptance properties — an injected 2× slowdown trips the gate naming
+the metric, an injected steady-state recompile fails with the dimension
+diff, and two back-to-back hermetic runs agree within band.
+
+The pure-math tests run against hand-built tier dicts (no jax); the
+tier tests run the REAL CPU-hermetic tier with tiny k/steps — compiles
+land once per process, so repeat runs are cheap.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu import bench_harness as harness  # noqa: E402,E501
+from tools import perf_gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _tracker_off_after():
+    """run_hermetic_tier enables the process-wide CompileTracker; leave
+    the suite the way we found it so later modules' disabled-path
+    assumptions hold."""
+    yield
+    from container_engine_accelerators_tpu.metrics import introspection
+    introspection.get_tracker().disable()
+
+
+def _ok_probe(platform="cpu"):
+    return {"outcome": "ok", "jax_version": "0.0-test",
+            "platform": platform, "device_kind": platform,
+            "n_devices": 1, "probe_latency_s": 0.001, "timeout_s": 0.0,
+            "mode": "in_process", "detail": ""}
+
+
+def _fake_tier(metrics=None, probe=None, recompiles=()):
+    metrics = metrics if metrics is not None else {
+        "m": {"samples": [10.0, 10.0, 10.0], "unit": "ms",
+              "percentiles": {"p50": 10.0}}}
+    return {"metrics": metrics, "results": [],
+            "backend_probe": probe or _ok_probe(),
+            "recompiles": list(recompiles), "k": 3, "steps": 5,
+            "wall_s": 0.01}
+
+
+def _write_baseline(path, metrics, platform="cpu"):
+    with open(path, "w") as f:
+        json.dump({"kind": "perf_baseline", "version": 1,
+                   "host": {"platform": platform},
+                   "metrics": metrics}, f)
+    return str(path)
+
+
+# ---------- gate math ----------
+
+def test_exactly_at_threshold_passes():
+    """The band means 'allowed drift', inclusive: rel_change == band is
+    ok; only STRICTLY above regresses."""
+    base = {"m": {"value": 100.0, "band": 0.4, "unit": "ms"}}
+    verdict, rows = perf_gate.compare(base, {"m": 140.0})
+    assert verdict == "ok"
+    assert rows[0]["verdict"] == "ok"
+    verdict, rows = perf_gate.compare(base, {"m": 140.5})
+    assert verdict == "regression:m"
+    assert rows[0]["verdict"] == "regression"
+
+
+def test_regression_names_the_worst_metric():
+    base = {"a": {"value": 10.0, "band": 0.1, "unit": "ms"},
+            "b": {"value": 10.0, "band": 0.1, "unit": "ms"}}
+    verdict, rows = perf_gate.compare(base, {"a": 11.5, "b": 30.0})
+    assert verdict == "regression:b"
+    assert {r["metric"]: r["verdict"] for r in rows} == {
+        "a": "regression", "b": "regression"}
+
+
+def test_improvement_never_regresses():
+    base = {"m": {"value": 100.0, "band": 0.05, "unit": "ms"}}
+    verdict, _ = perf_gate.compare(base, {"m": 20.0})
+    assert verdict == "ok"
+
+
+def test_band_scale_widens_tolerance():
+    base = {"m": {"value": 100.0, "band": 0.2, "unit": "ms"}}
+    assert perf_gate.compare(base, {"m": 130.0})[0] == "regression:m"
+    assert perf_gate.compare(base, {"m": 130.0},
+                             band_scale=2.0)[0] == "ok"
+
+
+def test_zero_variance_baseline_gets_floor_band():
+    """k identical samples must still learn a non-zero band — a
+    variance-free refresh cannot mean 'gate on any noise at all'."""
+    bands = perf_gate.learn_bands(
+        {"m": {"samples": [5.0] * 5, "unit": "ms"}})
+    assert bands["m"]["value"] == pytest.approx(5.0)
+    assert bands["m"]["band"] == pytest.approx(perf_gate.BAND_FLOOR)
+    # And a within-floor wobble then passes the gate.
+    verdict, _ = perf_gate.compare(bands, {"m": 5.0 * (
+        1 + perf_gate.BAND_FLOOR * 0.9)})
+    assert verdict == "ok"
+
+
+def test_spread_widens_learned_band():
+    bands = perf_gate.learn_bands(
+        {"m": {"samples": [1.0, 2.0, 3.0], "unit": "ms"}})
+    # spread = (3-1)/2 = 1.0 -> band = SPREAD_MULT * 1.0
+    assert bands["m"]["band"] == pytest.approx(
+        perf_gate.SPREAD_MULT * 1.0)
+
+
+def test_nonpositive_baseline_metric_dropped(capsys):
+    bands = perf_gate.learn_bands(
+        {"bad": {"samples": [0.0, 0.0], "unit": "ms"},
+         "good": {"samples": [2.0, 2.0], "unit": "ms"}})
+    assert set(bands) == {"good"}
+    assert "dropping bad" in capsys.readouterr().err
+
+
+def test_missing_metric_is_no_signal_new_metric_is_informational():
+    """Lost coverage must be loud (no_signal), not an implicit pass;
+    a metric the baseline has never seen is informational."""
+    base = {"a": {"value": 10.0, "band": 0.5, "unit": "ms"},
+            "b": {"value": 10.0, "band": 0.5, "unit": "ms"}}
+    verdict, rows = perf_gate.compare(base, {"a": 10.0, "c": 1.0})
+    assert verdict == "no_signal:missing_metric:b"
+    by_metric = {r["metric"]: r["verdict"] for r in rows}
+    assert by_metric == {"a": "ok", "b": "missing", "c": "new"}
+
+
+def test_torn_baseline_json_reparse(tmp_path):
+    """A torn/partial/garbage baseline must read as a no_signal cause,
+    never a crash and never a fake pass/fail."""
+    path = tmp_path / "PERF_BASELINE.json"
+    good = {"kind": "perf_baseline", "version": 1,
+            "metrics": {"m": {"value": 5.0, "band": 0.4, "unit": "ms"}}}
+    path.write_text(json.dumps(good))
+    loaded, problem = perf_gate.load_baseline(str(path))
+    assert problem is None and "m" in loaded["metrics"]
+
+    # Torn mid-write (the crash-safe JSONL torture, applied here).
+    path.write_text(json.dumps(good)[: len(json.dumps(good)) // 2])
+    assert perf_gate.load_baseline(str(path)) == (
+        None, "baseline_unreadable")
+    # Valid JSON, wrong shape.
+    path.write_text(json.dumps({"metrics": []}))
+    assert perf_gate.load_baseline(str(path)) == (
+        None, "baseline_unreadable")
+    # Entries with garbage values are filtered; all-garbage = unreadable.
+    path.write_text(json.dumps(
+        {"metrics": {"m": {"value": "fast", "band": 0.1}}}))
+    assert perf_gate.load_baseline(str(path)) == (
+        None, "baseline_unreadable")
+    # Clean miss is a distinct cause.
+    assert perf_gate.load_baseline(str(tmp_path / "nope.json")) == (
+        None, "baseline_missing")
+
+
+def test_gate_no_signal_on_missing_baseline_exits_zero(tmp_path, capsys):
+    tier = _fake_tier()
+    code, report = perf_gate.gate_check(
+        tier, str(tmp_path / "nope.json"),
+        report_path=str(tmp_path / "report.json"))
+    assert code == 0
+    assert report["verdict"] == "no_signal:baseline_missing"
+    assert "no signal" in capsys.readouterr().err
+    on_disk = json.loads((tmp_path / "report.json").read_text())
+    assert on_disk["verdict"] == "no_signal:baseline_missing"
+
+
+def test_gate_backend_unavailable_beats_everything(tmp_path):
+    """No data beats regression: you cannot fail what you could not
+    measure — but it must be no_signal, never ok."""
+    bl = _write_baseline(tmp_path / "b.json",
+                         {"m": {"value": 1.0, "band": 0.1,
+                                "unit": "ms"}})
+    probe = harness._empty_probe("timeout", "backend init exceeded 5s",
+                                 5.0, 5.0, "subprocess")
+    tier = _fake_tier(probe=probe)
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r.json"))
+    assert code == 0
+    assert report["verdict"] == "no_signal:backend_unavailable"
+
+
+def test_gate_platform_mismatch_is_no_signal(tmp_path):
+    bl = _write_baseline(tmp_path / "b.json",
+                         {"m": {"value": 10.0, "band": 0.4,
+                                "unit": "ms"}}, platform="tpu")
+    code, report = perf_gate.gate_check(
+        _fake_tier(), bl, report_path=str(tmp_path / "r.json"))
+    assert code == 0
+    assert report["verdict"] == "no_signal:platform_mismatch"
+
+
+def test_gate_recompile_hard_gate(tmp_path):
+    """A steady-state recompile inside the window fails the run even
+    when every timing is in band — the numbers are tainted — and the
+    report carries the dimension diff."""
+    bl = _write_baseline(tmp_path / "b.json",
+                         {"m": {"value": 10.0, "band": 0.4,
+                                "unit": "ms"}})
+    diff = "(args[1].length): int32[4] -> int32[7] (dim 0: 4 -> 7)"
+    tier = _fake_tier(recompiles=[{"fn": "decode_step_slots",
+                                   "recompiles": 1, "diff": diff}])
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r.json"))
+    assert code == perf_gate.EXIT_REGRESSION
+    assert report["verdict"] == "regression:recompile:decode_step_slots"
+    assert report["recompiles"][0]["diff"] == diff
+
+
+def test_slowdown_injection_parse(capsys):
+    assert perf_gate.parse_slowdown_injection(None) is None
+    assert perf_gate.parse_slowdown_injection("a_ms:2.5") == ("a_ms", 2.5)
+    assert perf_gate.parse_slowdown_injection("garbage") is None
+    assert "malformed" in capsys.readouterr().err
+
+
+# ---------- canonical schema helper ----------
+
+def test_validate_result_accepts_canonical_and_catches_drift():
+    good = harness.make_result(
+        "m", 1.0, "ms", percentiles={"step_ms": {"p50": 1.0, "p95": 2.0}},
+        backend_probe=_ok_probe(), status="ok")
+    assert harness.validate_result(good) == []
+    assert harness.check_result(good) is good
+
+    for missing in harness.REQUIRED_KEYS:
+        bad = dict(good)
+        bad.pop(missing)
+        assert any(missing in p for p in harness.validate_result(bad))
+    assert harness.validate_result({**good, "status": "meh"})
+    assert harness.validate_result({**good, "value": "fast"})
+    assert harness.validate_result(
+        {**good, "percentiles": {"s": {"q50": 1.0}}})
+    assert harness.validate_result(
+        {**good, "backend_probe": {"outcome": "ok"}})  # missing fields
+    with pytest.raises(ValueError, match="schema violation"):
+        harness.check_result({**good, "status": "meh"})
+
+
+def test_no_signal_result_is_schema_complete():
+    probe = harness._empty_probe("timeout", "backend init exceeded 9s",
+                                 9.0, 9.0, "subprocess")
+    r = harness.no_signal_result("m", "tokens/s", probe,
+                                 "backend_timeout")
+    assert harness.validate_result(r) == []
+    assert r["status"] == "no_signal"
+    assert r["no_signal_cause"] == "backend_timeout"
+    assert r["percentiles"] == {}
+
+
+def test_backfilled_blank_rounds_are_tagged():
+    """Satellite: BENCH_r03–r05 (the flaked rounds) carry an explicit
+    status=no_signal so trajectory tooling skips them instead of
+    scoring them as crashes/zeros."""
+    for n in (3, 4, 5):
+        data = json.loads(
+            open(os.path.join(REPO, f"BENCH_r0{n}.json")).read())
+        assert data["status"] == "no_signal", f"BENCH_r0{n}.json untagged"
+        assert data["no_signal_cause"]
+    # The rounds that produced real numbers stay untagged.
+    for n in (1, 2):
+        data = json.loads(
+            open(os.path.join(REPO, f"BENCH_r0{n}.json")).read())
+        assert "status" not in data
+
+
+def test_attach_peak_hbm_omitted_on_cpu(capsys):
+    """Satellite: on backends without memory_stats the field is OMITTED
+    with a logged reason — never null, never garbage."""
+    payload = {"metric": "m"}
+    harness.attach_peak_hbm(payload, context="gate-test")
+    assert "peak_hbm_bytes" not in payload  # CPU test backend
+    assert "omitted" in capsys.readouterr().err
+
+
+# ---------- the real CPU-hermetic tier ----------
+
+@pytest.fixture(scope="module")
+def tier():
+    return perf_gate.run_hermetic_tier(k=2, steps=6)
+
+
+def test_tier_is_hermetic_schema_complete_and_clean(tier):
+    assert tier["backend_probe"]["outcome"] == "ok"
+    assert tier["backend_probe"]["platform"] == "cpu"
+    assert set(tier["metrics"]) == {
+        "train_step_ms", "decode_step_slots_ms", "decode_step_paged_ms",
+        "matmul_scan_ms"}
+    for result in tier["results"]:
+        assert harness.validate_result(result) == [], result["metric"]
+        assert result["status"] == "ok"
+        assert result["value"] > 0
+    # No steady-state recompile during a clean tier run: warmup owns
+    # every compile, the measurement windows own none.
+    assert tier["recompiles"] == []
+    for name, info in tier["metrics"].items():
+        assert len(info["samples"]) == 2
+        assert all(s > 0 for s in info["samples"]), (name, info)
+
+
+def test_injected_slowdown_trips_gate_naming_metric(
+        tier, tmp_path, monkeypatch):
+    """Acceptance: an artificial 2× slowdown fails the gate and the
+    verdict NAMES the offending metric. Baseline is built from the same
+    tier run, so rel_change is exactly 1.0 > any sane band."""
+    metrics = perf_gate.learn_bands(
+        {name: {"samples": info["samples"], "unit": info["unit"]}
+         for name, info in tier["metrics"].items()})
+    bl = _write_baseline(tmp_path / "b.json", metrics)
+    monkeypatch.setenv(perf_gate.INJECT_SLOWDOWN_ENV,
+                       "train_step_ms:2.0")
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r.json"))
+    assert code == perf_gate.EXIT_REGRESSION
+    assert report["verdict"] == "regression:train_step_ms"
+    row = {r["metric"]: r for r in report["rows"]}["train_step_ms"]
+    assert row["verdict"] == "regression"
+    assert row["rel_change"] == pytest.approx(1.0, abs=0.01)
+    # And without the injection the same tier passes its own baseline.
+    monkeypatch.delenv(perf_gate.INJECT_SLOWDOWN_ENV)
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r2.json"))
+    assert code == 0 and report["verdict"] == "ok"
+
+
+def test_injected_recompile_fails_gate_with_dim_diff(
+        tmp_path, monkeypatch):
+    """Acceptance: a steady-state recompile INSIDE a measurement window
+    (injected: the watched slot-decode executable called at an off
+    shape) fails the gate with the dimension diff in the report."""
+    monkeypatch.setenv(perf_gate.INJECT_RECOMPILE_ENV, "1")
+    tier = perf_gate.run_hermetic_tier(k=1, steps=4)
+    assert tier["recompiles"], "injected recompile was not observed"
+    fns = [r["fn"] for r in tier["recompiles"]]
+    assert "decode_step_slots" in fns
+    metrics = perf_gate.learn_bands(
+        {name: {"samples": info["samples"], "unit": info["unit"]}
+         for name, info in tier["metrics"].items()})
+    bl = _write_baseline(tmp_path / "b.json", metrics)
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r.json"))
+    assert code == perf_gate.EXIT_REGRESSION
+    assert report["verdict"].startswith("regression:recompile:")
+    rc = [r for r in report["recompiles"]
+          if r["fn"] == "decode_step_slots"][0]
+    assert "->" in rc["diff"]  # the exact dimension change, attributed
+
+
+def test_two_hermetic_runs_agree_within_band(tier, tmp_path):
+    """Acceptance (determinism): learn a baseline, then two
+    back-to-back hermetic runs both gate `ok` against it — the tier is
+    repeatable inside its own learned noise bands."""
+    ns = argparse.Namespace(out=str(tmp_path / "PERF_BASELINE.json"),
+                            k=2, steps=6)
+    assert perf_gate.cmd_baseline(ns) == 0
+    baseline = json.loads((tmp_path / "PERF_BASELINE.json").read_text())
+    assert baseline["kind"] == "perf_baseline"
+    assert set(baseline["metrics"]) == set(tier["metrics"])
+    verdicts = []
+    for i in range(2):
+        t = perf_gate.run_hermetic_tier(k=2, steps=6)
+        code, report = perf_gate.gate_check(
+            t, ns.out, report_path=str(tmp_path / f"r{i}.json"))
+        verdicts.append((code, report["verdict"]))
+    assert verdicts == [(0, "ok"), (0, "ok")]

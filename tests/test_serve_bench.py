@@ -55,13 +55,29 @@ def test_tiny_smoke_emits_all_engine_dtype_combos(monkeypatch, capsys,
     combos = {(ln["engine"], ln["kv_dtype"]) for ln in lines}
     assert combos == {("slot", "bf16"), ("slot", "int8"),
                       ("paged", "bf16"), ("paged", "int8")}
+    from container_engine_accelerators_tpu import bench_harness
+
     for ln in lines:
+        # Canonical schema (ISSUE 6): every line is schema-complete —
+        # metric/value/unit/percentiles/backend_probe/status — and the
+        # probe attributes the backend the numbers came from.
+        assert bench_harness.validate_result(ln) == [], ln
+        assert ln["status"] == "ok"
+        assert ln["metric"] == "serve_decode_tokens_per_s"
+        assert ln["value"] == ln["tokens_per_s"]
+        assert ln["backend_probe"]["outcome"] == "ok"
+        assert ln["backend_probe"]["platform"] == "cpu"
+        # peak_hbm_bytes is OMITTED on the CPU backend (no
+        # memory_stats) — absence means "not measurable", never null.
+        assert "peak_hbm_bytes" not in ln
         assert ln["tokens_per_s"] > 0
         assert ln["step_ms"] > 0
         # Recorder-derived latency percentile columns (ISSUE 2): every
-        # cell carries p50/p95/p99 TTFT and TPOT in ms, ordered.
+        # cell carries p50/p95/p99 TTFT and TPOT in ms, ordered — both
+        # as legacy top-level columns and under `percentiles`.
         for col in ("ttft_ms", "tpot_ms", "decode_step_ms"):
             pcts = ln[col]
+            assert ln["percentiles"][col] == pcts
             assert set(pcts) == {"p50", "p95", "p99"}, (col, pcts)
             assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"], \
                 (col, pcts)

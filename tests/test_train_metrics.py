@@ -309,10 +309,11 @@ def test_train_cli_tiny_smoke(tmp_path, capsys):
 
 def test_bench_sidecar_streams_lines(tmp_path, monkeypatch):
     import bench
+    from container_engine_accelerators_tpu import bench_harness
 
     path = str(tmp_path / "partial.jsonl")
     monkeypatch.setenv("BENCH_JSONL_PATH", path)
-    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)
+    monkeypatch.setattr(bench_harness, "_SIDECAR_FILES", {})
     bench._sidecar({"event": "config_start", "config": "x"})
     bench._sidecar({"event": "window", "config": "x", "window_s": 1.5})
     # Every line is complete on disk the moment _sidecar returns —
@@ -320,5 +321,6 @@ def test_bench_sidecar_streams_lines(tmp_path, monkeypatch):
     lines = [json.loads(l) for l in open(path)]
     assert [l["event"] for l in lines] == ["config_start", "window"]
     assert all("t" in l for l in lines)
-    bench._SIDECAR_FILE.close()
-    monkeypatch.setattr(bench, "_SIDECAR_FILE", None)
+    for f in bench_harness._SIDECAR_FILES.values():
+        f.close()
+    monkeypatch.setattr(bench_harness, "_SIDECAR_FILES", {})

@@ -14,21 +14,24 @@ Components:
   - norm_rope: rms_norm + rope (HBM-bound elementwise)
 
 Prints one JSON line per component with achieved TFLOP/s and fraction
-of the 197 TFLOP/s v5e bf16 peak.
+of the 197 TFLOP/s v5e bf16 peak. Round 6: every line is
+schema-complete through the shared bench harness
+(metric/value/unit/percentiles/backend_probe/status), the backend is
+admitted by one bounded subprocess probe, and a failed probe emits a
+structured no_signal line instead of hanging in-process.
 """
 
 from __future__ import annotations
 
-import functools
 import json
+import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-sys.path.insert(0, ".")
-
+from container_engine_accelerators_tpu import bench_harness as harness  # noqa: E402,E501
 from container_engine_accelerators_tpu.metrics.request_metrics import (  # noqa: E402,E501
     percentile,
 )
@@ -39,11 +42,18 @@ from container_engine_accelerators_tpu.metrics.train_metrics import (  # noqa: E
 B, S, D, F, H, KV, HD = 5, 2048, 2048, 8192, 16, 8, 128
 L = 8  # scan length — amortizes dispatch, mimics stacked-layer weights
 
+# The probe that admitted this run; set once in main(), attached to
+# every component line.
+_PROBE: dict | None = None
 
-def timed(fn, *args, iters=8, warmup=2):
+
+def timed(fn, *args, iters=8, warmup=harness.DEFAULT_WARMUP_STEPS):
     """Returns the raw per-iteration times; report() derives the
     median/p95 through the shared nearest-rank helper
     (metrics/request_metrics.percentile) instead of local sort math."""
+    import jax
+    import jax.numpy as jnp
+
     # Reduce to a scalar INSIDE jit: fetching a large array over the
     # tunnel costs seconds and would swamp the compute being measured.
     sfn = jax.jit(lambda *a: jnp.sum(fn(*a).astype(jnp.float32)))
@@ -61,15 +71,22 @@ def report(name, times, flops):
     peak = detect_peak_flops()
     median_s = percentile(times, 50)
     tflops = flops / median_s / 1e12
-    print(json.dumps({
-        "component": name, "median_s": round(median_s, 5),
-        "p95_s": round(percentile(times, 95), 5),
-        "tflops": round(tflops, 1),
-        "frac_peak": round(tflops * 1e12 / peak, 3),
-    }), flush=True)
+    line = harness.make_result(
+        f"component_{name}_tflops", round(tflops, 1), "TFLOP/s",
+        percentiles={"iter_s": {"p50": round(median_s, 5),
+                                "p95": round(percentile(times, 95), 5)}},
+        backend_probe=_PROBE, status="ok",
+        # Legacy columns (perf_fire/PERF_RESULTS consumers).
+        component=name, median_s=round(median_s, 5),
+        p95_s=round(percentile(times, 95), 5),
+        tflops=round(tflops, 1),
+        frac_peak=round(tflops * 1e12 / peak, 3))
+    print(json.dumps(harness.check_result(line)), flush=True)
 
 
 def scan_op(body, x, weights):
+    import jax
+
     def step(carry, w):
         return body(carry, w), None
     y, _ = jax.lax.scan(step, x, weights)
@@ -77,6 +94,20 @@ def scan_op(body, x, weights):
 
 
 def main():
+    global _PROBE
+    # One bounded probe before any in-process device touch: a downed
+    # tunnel fast-fails with attribution instead of wedging (the
+    # bench.py contract, shared through the harness).
+    _PROBE = harness.probe_backend()
+    if _PROBE["outcome"] != "ok":
+        print(json.dumps(harness.check_result(harness.no_signal_result(
+            "component_bench", "TFLOP/s", _PROBE,
+            "backend_" + _PROBE["outcome"]))), flush=True)
+        return
+
+    import jax
+    import jax.numpy as jnp
+
     key = jax.random.key(0)
     tok = B * S
 
@@ -197,13 +228,18 @@ def main():
         y, _ = jax.lax.scan(body, xb, jnp.arange(L))
         return y
 
-    t = percentile(timed(norm_rope, xb), 50)
+    times = timed(norm_rope, xb)
+    t = percentile(times, 50)
     # report bandwidth instead of flops: bytes ~ L * 4 passes * size
     nbytes = L * 4 * xb.size * 2
-    print(json.dumps({
-        "component": "norm_rope", "median_s": round(t, 5),
-        "gbps": round(nbytes / t / 1e9, 1),
-    }), flush=True)
+    line = harness.make_result(
+        "component_norm_rope_gbps", round(nbytes / t / 1e9, 1), "GB/s",
+        percentiles={"iter_s": {"p50": round(t, 5),
+                                "p95": round(percentile(times, 95), 5)}},
+        backend_probe=_PROBE, status="ok",
+        component="norm_rope", median_s=round(t, 5),
+        gbps=round(nbytes / t / 1e9, 1))
+    print(json.dumps(harness.check_result(line)), flush=True)
 
 
 if __name__ == "__main__":

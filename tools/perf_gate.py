@@ -1,0 +1,616 @@
+"""Hermetic perf gate (ISSUE 6 tentpole): a deterministic CPU tier
+that makes a performance regression impossible to hide behind an infra
+flake — and an infra flake impossible to score as a regression.
+
+Three rounds of perf history (BENCH_r03–r05) are blank because the TPU
+backend flaked during init; nothing in the repo could say whether the
+next blank round is "the tunnel was down" or "PR N made decode 2×
+slower". This tool closes that hole with a tier that needs NO
+accelerator, NO network, and a bounded wall clock:
+
+  python tools/perf_gate.py baseline   # learn PERF_BASELINE.json + bands
+  python tools/perf_gate.py check      # gate against the committed baseline
+
+**The tier.** Four micro-benchmarks of the real hot paths on the CPU
+backend (forced in-process — the env var alone does not override this
+environment's TPU plugin), tiny shapes, fixed seeds:
+
+  train_step_ms          make_train_step on llama_tiny (TrainRecorder)
+  decode_step_slots_ms   slot-engine decode step     (RequestRecorder)
+  decode_step_paged_ms   paged-engine decode step    (RequestRecorder)
+  matmul_scan_ms         stacked scan matmul (the component_bench shape
+                         family, shrunk to tier-1 budget)
+
+Each metric runs k independent passes; the per-pass value is the
+recorder-derived p50 step time and the metric's value is the
+median-of-k — two layers of medians so one scheduler hiccup cannot
+move the number. Every emitted result is schema-complete
+(bench_harness.REQUIRED_KEYS) and self-validated.
+
+**The gate.** `check` compares each metric against the committed
+PERF_BASELINE.json *relatively*: regression iff
+current/baseline - 1 > band, where the per-metric noise band was
+LEARNED at baseline-refresh time from the spread of k runs (floored at
+BAND_FLOOR — a zero-variance baseline must not gate on noise). Exactly
+at the threshold passes; strictly above fails. The verdict is machine-
+checkable:
+
+  ok                         all metrics within band, no recompiles
+  regression:<metric>        the named metric left its band
+  regression:recompile:<fn>  a steady-state recompile fired INSIDE a
+                             measurement window (CompileTracker hard
+                             gate) — the report carries the exact
+                             dimension diff
+  no_signal:<cause>          the gate could not measure: backend probe
+                             failed, baseline missing/unreadable/
+                             platform-mismatched — exit 0 with a LOUD
+                             warning, because "no data" must never be
+                             scored, but must never block a PR on infra
+                             either
+
+Exit codes: 2 on any regression, 0 otherwise. The full report —
+verdict, per-metric rows, recompile diffs, backend_probe attribution,
+tier wall clock — lands in PERF_GATE_REPORT.json (atomic write).
+
+Test hooks (used by tests/test_perf_gate.py to prove the gate trips):
+PERF_GATE_INJECT_SLOWDOWN="metric:factor" multiplies that metric's
+measured samples; PERF_GATE_INJECT_RECOMPILE=1 calls the watched slot
+decode step once with an off-shape input inside the guarded window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from container_engine_accelerators_tpu import bench_harness as harness  # noqa: E402,E501
+
+DEFAULT_BASELINE = "PERF_BASELINE.json"
+DEFAULT_REPORT = "PERF_GATE_REPORT.json"
+BASELINE_VERSION = 1
+
+# Relative noise-band floor: a zero-variance baseline (k identical
+# samples — tests pin this) still tolerates this much drift before
+# gating, because CPU CI timing is never variance-free even when one
+# refresh happened to be. 2× the observed k-run spread on top, so a
+# machine whose noise is genuinely wider learns a wider band.
+BAND_FLOOR = 0.40
+SPREAD_MULT = 2.0
+
+K_DEFAULT = 3
+BASELINE_K_DEFAULT = 5
+STEPS_DEFAULT = 25
+
+K_ENV = "PERF_GATE_K"
+STEPS_ENV = "PERF_GATE_STEPS"
+BAND_SCALE_ENV = "PERF_GATE_BAND_SCALE"
+INJECT_SLOWDOWN_ENV = "PERF_GATE_INJECT_SLOWDOWN"
+INJECT_RECOMPILE_ENV = "PERF_GATE_INJECT_RECOMPILE"
+
+EXIT_OK = 0
+EXIT_REGRESSION = 2
+
+
+# ---------- gate math (pure, unit-tested in tests/test_perf_gate.py) ----------
+
+def learn_bands(samples: dict, floor: float = BAND_FLOOR,
+                spread_mult: float = SPREAD_MULT) -> dict:
+    """metric -> {"samples": [ms...], "unit": ...} measured at refresh
+    time, out: the baseline `metrics` block with per-metric noise
+    bands: band = max(floor, spread_mult * (max-min)/median). Metrics
+    whose median is not positive are dropped with a warning — a zero
+    baseline cannot anchor a relative gate."""
+    out = {}
+    for name, info in sorted(samples.items()):
+        vals = [float(v) for v in info["samples"]]
+        med = harness.median(vals)
+        if not vals or med is None or med <= 0:
+            print(f"perf-gate: dropping {name} from baseline "
+                  f"(non-positive median in {vals})", file=sys.stderr)
+            continue
+        spread = (max(vals) - min(vals)) / med
+        out[name] = {
+            "value": round(med, 4),
+            "band": round(max(floor, spread_mult * spread), 4),
+            "unit": info.get("unit", "ms"),
+            "samples": [round(v, 4) for v in vals],
+        }
+    return out
+
+
+def load_baseline(path: str) -> tuple[dict | None, str | None]:
+    """(baseline, None) or (None, cause). Tolerates a torn/partial
+    file the same way read_metrics_jsonl tolerates a torn tail: any
+    parse or shape problem is `baseline_unreadable`, a clean miss is
+    `baseline_missing` — both no_signal causes, never crashes."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError:
+        return None, "baseline_missing"
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        return None, "baseline_unreadable"
+    if not isinstance(data, dict) or not isinstance(
+            data.get("metrics"), dict):
+        return None, "baseline_unreadable"
+    metrics = {}
+    for name, entry in data["metrics"].items():
+        if (isinstance(entry, dict)
+                and isinstance(entry.get("value"), (int, float))
+                and isinstance(entry.get("band"), (int, float))
+                and entry["value"] > 0 and entry["band"] >= 0):
+            metrics[name] = entry
+    if not metrics:
+        return None, "baseline_unreadable"
+    data = dict(data)
+    data["metrics"] = metrics
+    return data, None
+
+
+def compare(baseline_metrics: dict, current: dict,
+            band_scale: float = 1.0) -> tuple[str, list[dict]]:
+    """Relative comparison of current values against the baseline.
+    Returns (verdict, rows). Regression iff rel_change is STRICTLY
+    above the (scaled) band — exactly-at-threshold passes, so the
+    band's meaning is 'allowed drift', not 'allowed drift minus
+    epsilon'. A baseline metric the tier no longer produces is a
+    no_signal (the gate lost coverage, which must be loud, not an
+    implicit pass); a new metric absent from the baseline is
+    informational until the next refresh."""
+    rows = []
+    worst_name, worst_rel = None, None
+    missing = []
+    for name, base in sorted(baseline_metrics.items()):
+        cur = current.get(name)
+        if cur is None:
+            missing.append(name)
+            rows.append({"metric": name, "baseline": base["value"],
+                         "current": None, "rel_change": None,
+                         "band": round(base["band"] * band_scale, 4),
+                         "verdict": "missing"})
+            continue
+        rel = cur / base["value"] - 1.0
+        band = base["band"] * band_scale
+        regressed = rel > band
+        rows.append({"metric": name, "baseline": base["value"],
+                     "current": round(float(cur), 4),
+                     "rel_change": round(rel, 4),
+                     "band": round(band, 4),
+                     "verdict": "regression" if regressed else "ok"})
+        if regressed and (worst_rel is None or rel > worst_rel):
+            worst_name, worst_rel = name, rel
+    for name in sorted(set(current) - set(baseline_metrics)):
+        rows.append({"metric": name, "baseline": None,
+                     "current": round(float(current[name]), 4),
+                     "rel_change": None, "band": None,
+                     "verdict": "new"})
+    if worst_name is not None:
+        return f"regression:{worst_name}", rows
+    if missing:
+        return f"no_signal:missing_metric:{missing[0]}", rows
+    return "ok", rows
+
+
+def parse_slowdown_injection(raw: str | None) -> tuple[str, float] | None:
+    if not raw:
+        return None
+    try:
+        name, factor = raw.rsplit(":", 1)
+        return name, float(factor)
+    except ValueError:
+        print(f"perf-gate: ignoring malformed "
+              f"{INJECT_SLOWDOWN_ENV}={raw!r} (want metric:factor)",
+              file=sys.stderr)
+        return None
+
+
+# ---------- the CPU-hermetic tier ----------
+
+def _force_cpu_hermetic() -> None:
+    """CPU, in-process, BEFORE any device query: the env var alone does
+    not override this environment's TPU platform plugin, and a downed
+    tunnel hangs any in-process init (BENCH_r03) — the hermetic tier
+    must never even look at the plugin."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (pytest), necessarily cpu there
+
+
+def _train_bench():
+    """('train_step_ms', warmed measure fn): fenced llama_tiny train
+    steps on a 1-device mesh, percentiles from TrainRecorder — the
+    recorder the real training loop exports, not ad-hoc math."""
+    import jax
+
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes, make_mesh,
+    )
+    from container_engine_accelerators_tpu.training import (
+        create_train_state, make_optimizer, make_train_step,
+    )
+    from container_engine_accelerators_tpu.training.data import (
+        synthetic_batches,
+    )
+    from container_engine_accelerators_tpu.training.train import shard_batch
+
+    cfg = llama.llama_tiny()
+    mesh = make_mesh(MeshAxes(dp=1, fsdp=1, sp=1, tp=1),
+                     devices=jax.devices()[:1])
+    opt = make_optimizer(warmup_steps=2, decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    step_fn = make_train_step(cfg, mesh, opt)
+    batch_size, seq_len = 2, 64
+    batch = shard_batch(
+        next(iter(synthetic_batches(cfg.vocab_size, batch_size, seq_len,
+                                    num_batches=1))), mesh)
+    tokens = batch_size * seq_len
+    box = [state]
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        box[0], metrics = step_fn(box[0], batch)
+        float(metrics["loss"])
+
+    def measure(n_steps: int):
+        rec = TrainRecorder()
+        times = []
+        for i in range(n_steps):
+            t0 = time.perf_counter()
+            box[0], metrics = step_fn(box[0], batch)
+            float(metrics["loss"])  # per-step fence: this tier is latency
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            rec.record_steps(1, dt, tokens)
+        return times, rec.pct_ms("step")
+
+    return "train_step_ms", measure, None
+
+
+def _decode_bench(paged: bool):
+    """Slot/paged decode step, per-step fenced, percentiles from
+    RequestRecorder. For the paged engine the page tables are truly
+    distinct rows (bench_harness.build_page_tables — the serve_bench
+    fix, shared). Also returns the recompile-injection hook: one call
+    of the SAME watched executable at an off shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_paged,
+        _jitted_decode_step_slots,
+        init_paged_cache,
+        init_slot_cache,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_slots, max_len, page = 4, 128, 32
+    if paged:
+        max_pages = max_len // page
+        tables, n_pages = harness.build_page_tables(n_slots, max_pages)
+        cache = init_paged_cache(cfg, n_slots, n_pages, page, max_pages)
+        cache = cache._replace(tables=jnp.asarray(tables))
+        step = _jitted_decode_step_paged(cfg)
+    else:
+        cache = init_slot_cache(cfg, n_slots, max_len)
+        step = _jitted_decode_step_slots(cfg)
+    def fresh_len(n=n_slots):
+        # A fresh buffer per use: the cache is DONATED by the step, so
+        # a shared length array would be dead after the first call.
+        return jnp.full((n,), max_len // 4, jnp.int32)
+
+    cache = cache._replace(length=fresh_len())
+    toks = jnp.ones((n_slots,), jnp.int32)
+    active = jnp.ones((n_slots,), bool)
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        logits, cache = step(params, cache, toks, active)
+        float(jnp.sum(logits))
+    box = [cache, toks]
+
+    def measure(n_steps: int):
+        # Reset the sequence position so every pass times the SAME
+        # length trajectory — determinism over realism here.
+        box[0] = box[0]._replace(length=fresh_len())
+        rec = RequestRecorder()
+        times = []
+        for _ in range(n_steps):
+            t0 = time.monotonic()
+            last, box[0] = step(params, box[0], box[1], active)
+            box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            float(jnp.sum(last))
+            dt = time.monotonic() - t0
+            times.append(dt)
+            rec.observe_decode_step(dt)
+        return times, rec.pct_ms("decode_step")
+
+    perturb = None
+    if not paged:
+        def perturb():
+            # 7 slots: a shape no test or engine default uses, so the
+            # watched executable REALLY compiles a new signature inside
+            # the guarded window (the injected steady-state recompile).
+            odd = 7
+            c2 = init_slot_cache(cfg, odd, max_len)
+            c2 = c2._replace(length=fresh_len(odd))
+            out, _ = step(params, c2, jnp.ones((odd,), jnp.int32),
+                          jnp.ones((odd,), bool))
+            float(jnp.sum(out))
+
+    name = "decode_step_paged_ms" if paged else "decode_step_slots_ms"
+    return name, measure, perturb
+
+
+def _matmul_bench():
+    """Stacked scan matmul — the component_bench shape family shrunk to
+    the tier-1 budget, watched for compile attribution like the real
+    entrypoints."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+
+    L, M = 8, 256
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (M, M), jnp.bfloat16)
+    w = jax.random.normal(key, (L, M, M), jnp.bfloat16)
+
+    def scan_mm(x, w):
+        def body(c, wi):
+            return (c @ wi).astype(jnp.bfloat16), None
+        y, _ = jax.lax.scan(body, x, w)
+        return jnp.sum(y.astype(jnp.float32))
+
+    fn = watch(jax.jit(scan_mm), "perf_gate_matmul_scan")
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        float(fn(x, w))
+
+    def measure(n_steps: int):
+        times = []
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            float(fn(x, w))
+            times.append(time.perf_counter() - t0)
+        return times, harness.pct_ms(times)
+
+    return "matmul_scan_ms", measure, None
+
+
+def run_hermetic_tier(k: int | None = None, steps: int | None = None,
+                      inject_recompile: bool | None = None) -> dict:
+    """Run the whole CPU-hermetic tier: setup+warmup every bench (all
+    compiles land HERE), then measure k passes per metric inside ONE
+    RecompileGuard window. Returns samples, recorder percentiles,
+    schema-complete per-metric results, the backend_probe block, and
+    any steady-state recompiles observed inside the window."""
+    if k is None:
+        k = int(harness.env_float(K_ENV, K_DEFAULT))
+    if steps is None:
+        steps = int(harness.env_float(STEPS_ENV, STEPS_DEFAULT))
+    if inject_recompile is None:
+        inject_recompile = bool(os.environ.get(INJECT_RECOMPILE_ENV))
+    _force_cpu_hermetic()
+
+    from container_engine_accelerators_tpu.metrics import introspection
+    introspection.install()  # enable the compile tracker: the hard gate
+
+    t_start = time.monotonic()
+    probe = harness.probe_block_in_process()
+    if probe["outcome"] != "ok":
+        return {"metrics": {}, "results": [], "backend_probe": probe,
+                "recompiles": [], "k": k, "steps": steps,
+                "wall_s": round(time.monotonic() - t_start, 2)}
+
+    benches = [_train_bench(), _decode_bench(paged=False),
+               _decode_bench(paged=True), _matmul_bench()]
+    metrics: dict = {}
+    results: list = []
+    with harness.RecompileGuard() as guard:
+        for name, measure, perturb in benches:
+            if inject_recompile and perturb is not None:
+                perturb()  # steady-state recompile INSIDE the window
+            samples_ms, pcts = [], {}
+            for _ in range(k):
+                times, pcts = measure(steps)
+                p50 = harness.median(times)
+                samples_ms.append(round(p50 * 1e3, 4))
+            value = round(harness.median(samples_ms), 4)
+            metrics[name] = {"samples": samples_ms, "unit": "ms",
+                             "percentiles": pcts}
+            results.append(harness.check_result(harness.make_result(
+                name, value, "ms",
+                percentiles={name.removesuffix("_ms"): pcts},
+                backend_probe=probe, status="ok",
+                samples_ms=samples_ms, k=k, steps_per_pass=steps,
+                tier="cpu-hermetic")))
+        recompiles = guard.new_recompiles()
+    return {"metrics": metrics, "results": results,
+            "backend_probe": probe, "recompiles": recompiles,
+            "k": k, "steps": steps,
+            "wall_s": round(time.monotonic() - t_start, 2)}
+
+
+# ---------- verdicts, reports, commands ----------
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def tier_current_values(tier: dict) -> dict:
+    """metric -> median-of-k value, with the test-only slowdown
+    injection applied (so the full gate path can be exercised without
+    actually making the code slower)."""
+    current = {name: harness.median(info["samples"])
+               for name, info in tier["metrics"].items()}
+    inject = parse_slowdown_injection(
+        os.environ.get(INJECT_SLOWDOWN_ENV))
+    if inject is not None:
+        name, factor = inject
+        if name in current:
+            print(f"perf-gate: INJECTED slowdown {factor}x on {name} "
+                  "(test hook)", file=sys.stderr)
+            current[name] = current[name] * factor
+    return current
+
+
+def gate_check(tier: dict, baseline_path: str,
+               band_scale: float | None = None,
+               report_path: str = DEFAULT_REPORT) -> tuple[int, dict]:
+    """Compare a tier run against the committed baseline; write the
+    report; return (exit_code, report). Verdict precedence: no data
+    beats everything (you cannot fail what you could not measure), a
+    recompile inside the window beats a clean comparison (the numbers
+    are tainted), then the per-metric comparison."""
+    if band_scale is None:
+        band_scale = harness.env_float(BAND_SCALE_ENV, 1.0)
+    baseline, problem = load_baseline(baseline_path)
+    current = tier_current_values(tier)
+    rows: list = []
+    if tier["backend_probe"]["outcome"] != "ok":
+        verdict = "no_signal:backend_unavailable"
+    elif tier["recompiles"]:
+        first = tier["recompiles"][0]
+        verdict = f"regression:recompile:{first['fn']}"
+    elif baseline is None:
+        verdict = f"no_signal:{problem}"
+    elif baseline.get("host", {}).get("platform") not in (
+            None, tier["backend_probe"]["platform"]):
+        verdict = "no_signal:platform_mismatch"
+    else:
+        verdict, rows = compare(baseline["metrics"], current,
+                                band_scale)
+
+    report = {
+        "kind": "perf_gate_report",
+        "version": 1,
+        "t": round(time.time(), 3),
+        "verdict": verdict,
+        "rows": rows,
+        "recompiles": tier["recompiles"],
+        "backend_probe": tier["backend_probe"],
+        "baseline_path": baseline_path,
+        "band_scale": band_scale,
+        "tier_wall_s": tier["wall_s"],
+        "k": tier["k"],
+        "steps_per_pass": tier["steps"],
+        "results": tier["results"],
+    }
+    try:
+        _write_json_atomic(report_path, report)
+    except OSError as e:
+        print(f"perf-gate: report write failed: {e}", file=sys.stderr)
+
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    for rc in tier["recompiles"]:
+        print(f"perf-gate: steady-state recompile of {rc['fn']} inside "
+              f"the measurement window: {rc['diff']}", file=sys.stderr)
+    print(f"PERF GATE VERDICT: {verdict}", flush=True)
+    if verdict.startswith("regression"):
+        return EXIT_REGRESSION, report
+    if verdict.startswith("no_signal"):
+        print("PERF GATE WARNING: no signal — this run proves NOTHING "
+              f"about performance ({verdict}). Fix the cause before "
+              "trusting the trajectory.", file=sys.stderr)
+    return EXIT_OK, report
+
+
+def cmd_check(args) -> int:
+    tier = run_hermetic_tier(k=args.k, steps=args.steps)
+    code, _ = gate_check(tier, args.baseline,
+                         band_scale=args.band_scale,
+                         report_path=args.report)
+    return code
+
+
+def cmd_baseline(args) -> int:
+    tier = run_hermetic_tier(k=args.k or BASELINE_K_DEFAULT,
+                             steps=args.steps)
+    if tier["backend_probe"]["outcome"] != "ok":
+        print("perf-gate: backend probe failed — refusing to write a "
+              "baseline with no data", file=sys.stderr)
+        return 1
+    if tier["recompiles"]:
+        for rc in tier["recompiles"]:
+            print(f"perf-gate: recompile of {rc['fn']} during baseline "
+                  f"measurement: {rc['diff']}", file=sys.stderr)
+        print("perf-gate: refusing to write a recompile-tainted "
+              "baseline", file=sys.stderr)
+        return 1
+    samples = {name: {"samples": info["samples"], "unit": info["unit"]}
+               for name, info in tier["metrics"].items()}
+    baseline = {
+        "kind": "perf_baseline",
+        "version": BASELINE_VERSION,
+        "tier": "cpu-hermetic",
+        "t": round(time.time(), 3),
+        "k": tier["k"],
+        "steps_per_pass": tier["steps"],
+        "band_floor": BAND_FLOOR,
+        "spread_mult": SPREAD_MULT,
+        "host": {
+            "platform": tier["backend_probe"]["platform"],
+            "device_kind": tier["backend_probe"]["device_kind"],
+            "jax_version": tier["backend_probe"]["jax_version"],
+        },
+        "metrics": learn_bands(samples),
+    }
+    _write_json_atomic(args.out, baseline)
+    for name, m in sorted(baseline["metrics"].items()):
+        print(json.dumps({"metric": name, **{k: m[k] for k in
+                          ("value", "band", "unit", "samples")}}),
+              flush=True)
+    print(f"perf-gate: baseline -> {args.out} "
+          f"({len(baseline['metrics'])} metrics, "
+          f"{tier['wall_s']}s tier wall clock)", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Deterministic CPU-hermetic perf gate")
+    sub = ap.add_subparsers(dest="cmd")
+    chk = sub.add_parser("check", help="gate against the baseline")
+    chk.add_argument("--baseline", default=DEFAULT_BASELINE)
+    chk.add_argument("--report", default=DEFAULT_REPORT)
+    chk.add_argument("--k", type=int, default=None)
+    chk.add_argument("--steps", type=int, default=None)
+    chk.add_argument("--band-scale", type=float, default=None)
+    base = sub.add_parser("baseline",
+                          help="re-learn the baseline + noise bands")
+    base.add_argument("--out", default=DEFAULT_BASELINE)
+    base.add_argument("--k", type=int, default=None)
+    base.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args(argv)
+    if args.cmd == "baseline":
+        return cmd_baseline(args)
+    if args.cmd is None:
+        args = chk.parse_args([])
+    return cmd_check(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,13 @@ has no prefill/queue stage, so its "TTFT" is the first decode step's
 latency — the decode floor under the serving number, not the serving
 number itself.
 
+Round 6: sits on the shared bench harness. Every line is
+schema-complete (metric/value/unit/percentiles/backend_probe/status),
+the backend is admitted by ONE bounded subprocess probe instead of an
+in-process init that can hang (BENCH_r03's failure mode), and a failed
+probe emits a structured `status: no_signal` line instead of a
+traceback.
+
 Usage:  python tools/serve_bench.py [--slots 8,16,32] [--steps 64]
                                     [--kv-dtypes bf16,int8]
 """
@@ -33,27 +40,16 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from container_engine_accelerators_tpu import bench_harness as harness  # noqa: E402,E501
+from container_engine_accelerators_tpu.bench_harness import (  # noqa: F401,E402,E501
+    build_page_tables,  # re-export: tests/test_serve_bench.py imports it here
+)
 
-def build_page_tables(n_slots: int, max_pages: int):
-    """Distinct pool rows for every (slot, page): tables [n_slots,
-    max_pages] int32 and the pool size n_pages that backs them.
-
-    Steady-state serving never aliases two live (slot, page) pairs onto
-    one pool row — the allocator hands every live page its own row. The
-    earlier bench sized the pool at the engine's oversubscribed default
-    and silently pointed the overflow at the trash row, so half the
-    "cache" collapsed into one hot page and the paged numbers measured
-    a layout serving never produces (ADVICE r5). Row 0 stays reserved
-    as the trash page, exactly like the engine's pools."""
-    n_pages = n_slots * max_pages + 1
-    tables = np.arange(1, n_pages, dtype=np.int32).reshape(
-        n_slots, max_pages)
-    return tables, n_pages
+METRIC = "serve_decode_tokens_per_s"
+UNIT = "tokens/s"
 
 
 def latency_percentile_phase(params, cache, step, toks, active,
@@ -126,8 +122,20 @@ def main():
     if args.tiny:
         # In-process force: the env var alone does not override this
         # environment's TPU platform plugin, and a downed tunnel would
-        # hang the smoke test (BASELINE.md tunnel notes).
+        # hang the smoke test (BASELINE.md tunnel notes). A forced-CPU
+        # init cannot hang, so the in-process probe block is safe.
         jax.config.update("jax_platforms", "cpu")
+        probe = harness.probe_block_in_process()
+    else:
+        # ONE bounded subprocess probe before any in-process device
+        # touch (the bench.py contract: fast-fail with attribution, no
+        # patience loop). A failed probe still yields a parseable line.
+        probe = harness.probe_backend()
+    if probe["outcome"] != "ok":
+        print(json.dumps(harness.check_result(harness.no_signal_result(
+            METRIC, UNIT, probe, "backend_" + probe["outcome"]))),
+            flush=True)
+        return
     import jax.numpy as jnp
 
     from container_engine_accelerators_tpu.models import llama
@@ -157,8 +165,9 @@ def main():
                     max_pages = max_len // args.page
                     # Every active slot's pages truly distinct — the
                     # steady state serving produces (see
-                    # build_page_tables); aliasing them onto the trash
-                    # row would collapse the measured cache footprint.
+                    # bench_harness.build_page_tables); aliasing them
+                    # onto the trash row would collapse the measured
+                    # cache footprint.
                     tables, n_pages = build_page_tables(n_slots,
                                                         max_pages)
                     cache = init_paged_cache(cfg, n_slots, n_pages,
@@ -200,27 +209,28 @@ def main():
                 rec = latency_percentile_phase(
                     params, cache, step, toks, active, n_slots,
                     max_len, min(args.steps, 32))
-                from container_engine_accelerators_tpu.metrics import (
-                    introspection,
-                )
-                print(json.dumps({
-                    "engine": engine, "slots": n_slots,
-                    "kv_dtype": kv_dtype,
-                    "step_ms": round(dt * 1e3, 3),
-                    "tokens_per_s": round(n_slots / dt, 1),
-                    "max_len": max_len,
-                    # Process-lifetime allocator high-water mark at
-                    # line-emit time (monotone across lines; null on
-                    # backends without memory_stats): the per-config
-                    # KV footprint trend reads off adjacent lines.
-                    "peak_hbm_bytes": introspection.peak_hbm_bytes(),
-                    # Recorder-derived percentile columns (ms). TTFT
-                    # here = first fenced decode step (no prefill/queue
-                    # in this harness); TPOT = per-step inter-token gap.
-                    "ttft_ms": rec.pct_ms("ttft"),
-                    "tpot_ms": rec.pct_ms("tpot"),
-                    "decode_step_ms": rec.pct_ms("decode_step"),
-                }), flush=True)
+                # Recorder-derived percentile columns (ms). TTFT here =
+                # first fenced decode step (no prefill/queue in this
+                # harness); TPOT = per-step inter-token gap. The same
+                # dicts double as the legacy top-level columns.
+                pcts = {"ttft_ms": rec.pct_ms("ttft"),
+                        "tpot_ms": rec.pct_ms("tpot"),
+                        "decode_step_ms": rec.pct_ms("decode_step")}
+                line = harness.make_result(
+                    METRIC, round(n_slots / dt, 1), UNIT,
+                    percentiles=pcts, backend_probe=probe, status="ok",
+                    engine=engine, slots=n_slots, kv_dtype=kv_dtype,
+                    step_ms=round(dt * 1e3, 3), max_len=max_len,
+                    tokens_per_s=round(n_slots / dt, 1), **pcts)
+                # Process-lifetime allocator high-water mark at
+                # line-emit time (monotone across lines): the
+                # per-config KV footprint trend reads off adjacent
+                # lines. OMITTED with a logged reason on backends
+                # without memory_stats — absence means "not measurable
+                # here", never zero.
+                harness.attach_peak_hbm(line, context="serve_bench")
+                print(json.dumps(harness.check_result(line)),
+                      flush=True)
     # Sidecar next to the JSON result lines: the whole sweep as one
     # openable timeline (atexit also dumps, but a wrapper that keeps
     # the process alive shouldn't delay the file).
