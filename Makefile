@@ -32,10 +32,28 @@ test-quick:
 device-injector-test:
 	$(PYTHON) -m pytest tests/test_nri.py -q
 
-presubmit:
+presubmit: lint
 	$(PYTHON) -m compileall -q container_engine_accelerators_tpu tests \
 	    bench.py __graft_entry__.py
 	$(PYTHON) build/check_boilerplate.py
+
+# Postmortem-derived invariants as a machine-checked tier (ISSUE 7):
+# tools/tpulint.py gates the tree against LINT_BASELINE.json — new
+# findings exit 2, deliberate exceptions carry inline
+# `# tpulint: allow=TPLnnn(reason)` pragmas. Pure stdlib ast, no jax,
+# ~1 s; see CONTRIBUTING.md for the rule table.
+lint:
+	$(PYTHON) tools/tpulint.py check
+
+# Regenerate the grandfathered-findings baseline (commit it WITH the
+# PR that changes it, mirroring perf-baseline).
+lint-baseline:
+	$(PYTHON) tools/tpulint.py baseline
+
+# Rule fixtures + pragma/fingerprint contracts + baseline-gate verdicts
+# + the clean-self-run and no-jax-import acceptance checks.
+lint-smoke:
+	$(PYTHON) -m pytest tests/test_tpulint.py tests/test_wakeq.py -q
 
 bench:
 	$(PYTHON) bench.py
@@ -103,8 +121,8 @@ perf-gate-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf_gate.py -q
 
 # The whole observability smoke family in one target.
-smoke: obs-smoke train-obs-smoke trace-smoke introspect-smoke \
-    perf-gate-smoke perf-gate
+smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
+    introspect-smoke perf-gate-smoke perf-gate
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -114,7 +132,7 @@ dryrun:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-quick device-injector-test presubmit bench \
-    perf hbm-plan obs-smoke train-obs-smoke trace-smoke \
-    introspect-smoke perf-gate perf-baseline perf-gate-smoke smoke \
-    dryrun clean
+.PHONY: all native test test-quick device-injector-test presubmit \
+    lint lint-baseline lint-smoke bench perf hbm-plan obs-smoke \
+    train-obs-smoke trace-smoke introspect-smoke perf-gate \
+    perf-baseline perf-gate-smoke smoke dryrun clean

@@ -83,8 +83,12 @@ def main(argv=None) -> int:
         time.sleep(args.poll_interval)
 
     os.makedirs(os.path.dirname(args.ready_file) or ".", exist_ok=True)
-    with open(args.ready_file, "w") as f:
+    # The readiness stamp is what node probes poll for — it must appear
+    # whole or not at all (TPL003).
+    tmp = f"{args.ready_file}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(f"{len(info.discover())}\n")
+    os.replace(tmp, args.ready_file)
     log.info("TPU runtime ready (%d chips); stamped %s",
              len(info.discover()), args.ready_file)
     if args.once:

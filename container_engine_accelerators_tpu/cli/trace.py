@@ -51,8 +51,12 @@ def _write(trace: dict, out_path: str) -> None:
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(out_path, "w") as f:
+    # tmp + os.replace: Perfetto/chrome://tracing may be pointed at the
+    # output while a re-merge runs; never show it a torn file (TPL003).
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(trace, f)
+    os.replace(tmp, out_path)
     n = sum(1 for e in trace.get("traceEvents", ())
             if e.get("ph") != "M")
     print(f"wrote {out_path}: {n} events from "

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import logging
 import os
-import queue
 import threading
 import time
 from concurrent import futures
@@ -41,6 +40,7 @@ from container_engine_accelerators_tpu.deviceplugin.devutil import (
     DeviceInfo,
     SysfsDeviceInfo,
 )
+from container_engine_accelerators_tpu.utils.wakeq import WakeQueue
 
 log = logging.getLogger(__name__)
 
@@ -77,7 +77,11 @@ class TPUManager:
         self._chips: dict[int, Chip] = {}
         self._subslices: dict[str, subslice.Subslice] = {}
         self._lock = threading.Lock()
-        self._listeners: list[queue.SimpleQueue] = []
+        # WakeQueue, not SimpleQueue: the ListAndWatch pump does a
+        # timed get, the exact shape of PR 2's lost-wakeup hang (a
+        # health flip's put could be missed and the kubelet resync
+        # delayed a full poll — or forever). See utils/wakeq.py.
+        self._listeners: list[WakeQueue] = []
         self._stop = threading.Event()
         self.restarts = 0  # observable for tests
 
@@ -162,8 +166,8 @@ class TPUManager:
             return [pb.Device.FromString(d.SerializeToString())
                     for d in self.devices.values()]
 
-    def add_listener(self) -> queue.SimpleQueue:
-        q: queue.SimpleQueue = queue.SimpleQueue()
+    def add_listener(self) -> WakeQueue:
+        q = WakeQueue()
         with self._lock:
             self._listeners.append(q)
         return q

@@ -588,6 +588,10 @@ def write_merged(out_path: str, dump_paths=(), train_jsonl_paths=(),
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(out_path, "w") as f:
+    # Same atomic idiom as dump(): a viewer re-reading the merged
+    # timeline must never race a re-merge into a torn file (TPL003).
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(trace, f)
+    os.replace(tmp, out_path)
     return trace

@@ -169,6 +169,7 @@ def live_array_census(top_n: int = 32) -> dict:
                    "dtype": str(a.dtype)}
             try:
                 row["sharding"] = str(a.sharding)
+            # tpulint: allow=TPL009(census must never raise mid-OOM; sharding is best-effort decoration)
             except Exception:
                 pass
         except Exception:

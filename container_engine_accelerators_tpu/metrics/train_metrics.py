@@ -267,8 +267,13 @@ class TrainRecorder:
         if self._hb_path is None:
             return
         try:
-            with open(self._hb_path, "w") as f:
+            # tmp + os.replace: the monitor keys on mtime, but replace
+            # also keeps the `pid step` content always whole for the
+            # human debugging a stall (TPL003).
+            tmp = f"{self._hb_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
                 f.write(f"{os.getpid()} {self._last_step}\n")
+            os.replace(tmp, self._hb_path)
         except OSError:
             log.exception("heartbeat touch failed; disabling")
             self._hb_path = None
@@ -579,6 +584,7 @@ class HangWatchdog:
     def check(self, now: float | None = None) -> list[int]:
         """Scan the heartbeat dir once; returns straggler process ids,
         oldest heartbeat first. `now` is WALL time (file mtimes)."""
+        # tpulint: allow=TPL004(wall-vs-wall, ages come from file mtimes)
         now = time.time() if now is None else now
         ages: dict[int, float] = {}
         try:

@@ -932,12 +932,14 @@ def _jitted_prefill_suffix_paged(cfg: LlamaConfig):
 
 @functools.lru_cache(maxsize=32)
 def _jitted_set_slot_pages():
-    return jax.jit(set_slot_pages, donate_argnums=(0,))
+    return _watched_jit(
+        jax.jit(set_slot_pages, donate_argnums=(0,)), "set_slot_pages")
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_assign_pages():
-    return jax.jit(assign_pages, donate_argnums=(0,))
+    return _watched_jit(
+        jax.jit(assign_pages, donate_argnums=(0,)), "assign_pages")
 
 
 def pick_tokens(logits: jnp.ndarray, temps: jnp.ndarray,
@@ -974,7 +976,7 @@ def _jitted_prefill_suffix_slot(cfg: LlamaConfig):
 
 @functools.lru_cache(maxsize=32)
 def _jitted_pick_tokens():
-    return jax.jit(pick_tokens)
+    return _watched_jit(jax.jit(pick_tokens), "pick_tokens")
 
 
 @functools.lru_cache(maxsize=32)

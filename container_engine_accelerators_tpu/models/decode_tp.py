@@ -47,12 +47,10 @@ from container_engine_accelerators_tpu.models.llama import LlamaConfig
 
 TP_AXIS = "tp"
 
-# jax >= 0.5 exposes shard_map at the top level; 0.4.x keeps it in
-# experimental. Resolve once so _smap works on both.
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-else:
-    from jax.experimental.shard_map import shard_map as _shard_map
+# Version compat (jax>=0.5 top-level vs 0.4.x experimental) lives in
+# parallel/spmd_util.compat_shard_map — the single entry every manual
+# region routes through (tpulint TPL005). This module grew its own shim
+# first; it now shares the common one.
 
 
 def validate_tp(cfg: LlamaConfig, tp: int) -> None:
@@ -178,18 +176,15 @@ def shard_cache(cache, mesh: Mesh):
 
 
 def _smap(fn, mesh, in_specs, out_specs):
-    # check_vma=False: the pallas decode kernels have no replication
-    # rule, and the replication invariants here are by construction
-    # (psum/all_gather before every replicated output).
-    import inspect
-    kw = {}
-    sig = inspect.signature(_shard_map)
-    if "check_vma" in sig.parameters:
-        kw["check_vma"] = False
-    elif "check_rep" in sig.parameters:   # the 0.4.x spelling
-        kw["check_rep"] = False
-    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, **kw)
+    # Replication/VMA checking is off inside compat_shard_map: the
+    # pallas decode kernels have no replication rule, and the
+    # invariants here hold by construction (psum/all_gather before
+    # every replicated output).
+    from container_engine_accelerators_tpu.parallel.spmd_util import (
+        compat_shard_map,
+    )
+    return compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)
 
 
 

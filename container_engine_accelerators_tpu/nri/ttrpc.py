@@ -21,6 +21,7 @@ import struct
 import threading
 
 from container_engine_accelerators_tpu.nri import ttrpc_messages_pb2 as tpb
+from container_engine_accelerators_tpu.utils.wakeq import WakeQueue
 
 log = logging.getLogger(__name__)
 
@@ -40,13 +41,17 @@ class Mux:
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._wlock = threading.Lock()
-        self._queues: dict[int, queue.SimpleQueue] = {}
+        # WakeQueue, not SimpleQueue: MuxConn.recv_exact does timed
+        # gets — PR 2's lost-wakeup class would stall an RPC stream a
+        # full timeout (or wedge it) when a frame's put races the
+        # C-level timed wait. See utils/wakeq.py.
+        self._queues: dict[int, WakeQueue] = {}
         self._closed = threading.Event()
         threading.Thread(target=self._read_loop, daemon=True,
                          name="nri-mux-read").start()
 
     def conn(self, conn_id: int) -> "MuxConn":
-        q = self._queues.setdefault(conn_id, queue.SimpleQueue())
+        q = self._queues.setdefault(conn_id, WakeQueue())
         return MuxConn(self, conn_id, q)
 
     def send(self, conn_id: int, payload: bytes) -> None:
@@ -74,7 +79,7 @@ class Mux:
                 if payload is None:
                     break
                 self._queues.setdefault(
-                    conn_id, queue.SimpleQueue()).put(payload)
+                    conn_id, WakeQueue()).put(payload)
         except OSError:
             pass
         finally:
@@ -97,7 +102,7 @@ class MuxConn:
     Go mux's net.Conn Write calls land for header+payload pairs coalesced
     by the ttrpc channel writer (each ttrpc message is one Write)."""
 
-    def __init__(self, mux: Mux, conn_id: int, q: queue.SimpleQueue):
+    def __init__(self, mux: Mux, conn_id: int, q: WakeQueue):
         self._mux = mux
         self._conn_id = conn_id
         self._q = q

@@ -547,6 +547,7 @@ def _node_lost(node: dict, now: float | None = None) -> bool:
         t = calendar.timegm(time.strptime(ltt, "%Y-%m-%dT%H:%M:%SZ"))
     except ValueError:
         return True
+    # tpulint: allow=TPL004(wall-vs-wall, t is a K8s lastTransitionTime)
     now = time.time() if now is None else now
     return now - t >= NODE_LOST_GRACE_SECONDS
 

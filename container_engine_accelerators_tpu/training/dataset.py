@@ -27,9 +27,13 @@ def write_token_file(tokens, path: str, vocab_size: int) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
         arr.tofile(f)
-    with open(path + ".json", "w") as f:
+    # Meta lands atomically AFTER the token file: a loader that sees
+    # the .json can always mmap the tokens it describes (TPL003).
+    tmp = f"{path}.json.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump({"magic": MAGIC, "dtype": arr.dtype.name,
                    "count": int(arr.size), "vocab_size": vocab_size}, f)
+    os.replace(tmp, path + ".json")
 
 
 class TokenDataset:

@@ -1,0 +1,314 @@
+"""tpulint coverage (ISSUE 7): every rule's good/bad fixture pair, the
+pragma and fingerprint contracts, the baseline gate's perf_gate-style
+verdicts (new finding -> exit 2, torn/missing baseline -> loud
+no_signal pass, stale entries reported), and the two acceptance
+properties that keep the tool honest — a self-run over the real tree
+is clean against the committed baseline, and importing/running the
+linter never imports jax.
+
+Pure AST: no jax, no devices, sub-second per test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools import tpulint  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE_IDS = [r.id for r in tpulint.RULES]
+
+
+def rules_hit(relpath, source):
+    findings, _ = tpulint.lint_source(relpath, source)
+    return [f["rule"] for f in findings]
+
+
+# ---------- per-rule fixtures ----------
+
+@pytest.mark.parametrize("rule", tpulint.RULES, ids=RULE_IDS)
+def test_bad_fixture_flags(rule):
+    assert rule.id in rules_hit(rule.fixture_path, rule.bad), \
+        f"{rule.id} bad fixture did not flag"
+
+
+@pytest.mark.parametrize("rule", tpulint.RULES, ids=RULE_IDS)
+def test_good_fixture_clean(rule):
+    hits = rules_hit(rule.fixture_path, rule.good)
+    assert hits == [], \
+        f"{rule.id} good fixture flagged: {hits}"
+
+
+@pytest.mark.parametrize("rule", tpulint.RULES, ids=RULE_IDS)
+def test_rule_metadata_complete(rule):
+    """Each rule carries its postmortem rationale and fixture pair —
+    the framework contract the docs table is generated from."""
+    assert rule.id and rule.title
+    assert len(rule.rationale) > 80, "rationale must cite its postmortem"
+    assert rule.bad and rule.good
+    assert rule.applies(rule.fixture_path)
+
+
+def test_scoped_rules_ignore_out_of_scope_files():
+    """TPL002/TPL008 only patrol the decode/train step files; TPL006
+    only the metrics recorders."""
+    hot_loop = tpulint.HostSyncInHotLoop()
+    assert not hot_loop.applies("container_engine_accelerators_tpu/"
+                                "cli/serve.py")
+    assert hot_loop.applies("container_engine_accelerators_tpu/"
+                            "models/decode_tp.py")
+    assert rules_hit("container_engine_accelerators_tpu/cli/serve.py",
+                     hot_loop.bad) == []
+    lock = tpulint.BlockingUnderLock()
+    assert rules_hit("container_engine_accelerators_tpu/cli/serve.py",
+                     lock.bad) == []
+
+
+def test_tests_are_out_of_scope():
+    """tests/ exercise banned patterns on purpose and must not be
+    scanned by the default targets."""
+    files = list(tpulint.iter_py_files(REPO))
+    assert files, "default targets scanned nothing"
+    assert not any(f.startswith("tests") for f in files)
+    assert not any(f.endswith("_pb2.py") for f in files)
+
+
+# ---------- pragma contract ----------
+
+def test_pragma_on_line_suppresses():
+    src = "import queue\nq = queue.SimpleQueue()  " \
+          "# tpulint: allow=TPL001(fixture transition)\n"
+    findings, suppressed = tpulint.lint_source("pkg/x.py", src)
+    assert findings == []
+    assert [s["rule"] for s in suppressed] == ["TPL001"]
+    assert suppressed[0]["allowed"] == "fixture transition"
+
+
+def test_pragma_on_line_above_suppresses():
+    src = "import queue\n# tpulint: allow=TPL001(reviewed)\n" \
+          "q = queue.SimpleQueue()\n"
+    findings, _ = tpulint.lint_source("pkg/x.py", src)
+    assert findings == []
+
+
+def test_pragma_requires_reason():
+    src = "import queue\nq = queue.SimpleQueue()  " \
+          "# tpulint: allow=TPL001()\n"
+    findings, _ = tpulint.lint_source("pkg/x.py", src)
+    assert [f["rule"] for f in findings] == ["TPL001"]
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = "import queue\nq = queue.SimpleQueue()  " \
+          "# tpulint: allow=TPL009(wrong rule)\n"
+    findings, _ = tpulint.lint_source("pkg/x.py", src)
+    assert [f["rule"] for f in findings] == ["TPL001"]
+
+
+# ---------- fingerprints ----------
+
+def test_fingerprint_survives_line_drift():
+    """Baseline keys must not churn when unrelated lines are added
+    above a grandfathered finding."""
+    rule = tpulint.BannedSimpleQueue()
+    f1, _ = tpulint.lint_source("pkg/x.py", rule.bad)
+    f2, _ = tpulint.lint_source("pkg/x.py", "# one\n# two\n" + rule.bad)
+    assert f1[0]["line"] != f2[0]["line"]
+    assert f1[0]["fingerprint"] == f2[0]["fingerprint"]
+
+
+def test_fingerprint_distinguishes_duplicate_lines():
+    rule = tpulint.BannedSimpleQueue()
+    src = "import queue\nq = queue.SimpleQueue()\nq = queue.SimpleQueue()\n"
+    findings, _ = tpulint.lint_source("pkg/x.py", src)
+    fps = [f["fingerprint"] for f in findings]
+    assert len(fps) == 2 and len(set(fps)) == 2
+
+
+# ---------- baseline gate (the perf_gate philosophy) ----------
+
+def make_tree(tmp_path, source, relpath=None):
+    relpath = relpath or tpulint.Rule.fixture_path
+    full = tmp_path / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(source)
+    return str(tmp_path)
+
+
+def check(root, out=None):
+    argv = ["--root", root, "check"] + (["--out", out] if out else [])
+    return tpulint.main(argv)
+
+
+def test_no_baseline_is_loud_no_signal_pass(tmp_path, capsys):
+    root = make_tree(tmp_path, tpulint.BannedSimpleQueue().bad)
+    rc = check(root)
+    cap = capsys.readouterr()
+    report = json.loads(cap.out)
+    assert rc == 0, "missing baseline must not block (perf_gate rule)"
+    assert report["verdict"] == "no_signal:baseline_missing"
+    assert "WARNING" in cap.err
+
+
+def test_torn_baseline_is_no_signal(tmp_path, capsys):
+    root = make_tree(tmp_path, tpulint.BannedSimpleQueue().bad)
+    (tmp_path / "LINT_BASELINE.json").write_text('{"version": 1, "fi')
+    rc = check(root)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["verdict"] == "no_signal:baseline_unreadable"
+
+
+def test_wrong_baseline_version_is_no_signal(tmp_path, capsys):
+    root = make_tree(tmp_path, tpulint.BannedSimpleQueue().bad)
+    (tmp_path / "LINT_BASELINE.json").write_text(
+        '{"version": 999, "findings": []}')
+    rc = check(root)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["verdict"] == "no_signal:baseline_version"
+
+
+def test_grandfathered_then_new_finding(tmp_path, capsys):
+    """The adoption story end-to-end: baseline grandfathers today's
+    debt (exit 0), a NEW violation fails with exit 2 naming it, and
+    paying the old debt surfaces the stale entry."""
+    bad = tpulint.BannedSimpleQueue().bad
+    root = make_tree(tmp_path, bad)
+    assert tpulint.main(["--root", root, "baseline"]) == 0
+    capsys.readouterr()
+
+    assert check(root) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "ok"
+    assert len(report["findings"]) == 1 and report["new"] == []
+
+    # A second, new violation in another file -> exit 2.
+    make_tree(tmp_path, "import threading\nthreading.Thread(target=f)\n",
+              "container_engine_accelerators_tpu/other.py")
+    assert check(root) == 2
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "new_findings:1"
+    assert report["new"][0]["rule"] == "TPL007"
+
+    # Pay both debts -> ok, with the stale baseline entry reported.
+    make_tree(tmp_path, "x = 1\n")
+    make_tree(tmp_path, "y = 2\n",
+              "container_engine_accelerators_tpu/other.py")
+    assert check(root) == 0
+    cap = capsys.readouterr()
+    report = json.loads(cap.out)
+    assert report["verdict"] == "ok"
+    assert len(report["stale"]) == 1
+    assert "stale" in cap.err
+
+
+@pytest.mark.parametrize("rule", tpulint.RULES, ids=RULE_IDS)
+def test_injected_violation_of_each_rule_exits_2(tmp_path, capsys, rule):
+    """Acceptance: with an empty committed baseline, injecting a
+    violation of ANY rule fails the gate with exit 2."""
+    root = make_tree(tmp_path, rule.bad, rule.fixture_path)
+    (tmp_path / "LINT_BASELINE.json").write_text(
+        json.dumps({"version": 1, "tool": "tpulint", "findings": []}))
+    rc = check(root)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert rule.id in {f["rule"] for f in report["new"]}
+
+
+def test_pragma_downgrades_gate_to_ok(tmp_path, capsys):
+    src = "import queue\n# tpulint: allow=TPL001(reviewed exception)\n" \
+          "q = queue.SimpleQueue()\n"
+    root = make_tree(tmp_path, src)
+    (tmp_path / "LINT_BASELINE.json").write_text(
+        json.dumps({"version": 1, "findings": []}))
+    rc = check(root)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["verdict"] == "ok"
+    assert [s["rule"] for s in report["suppressed"]] == ["TPL001"]
+
+
+def test_parse_error_is_reported_not_fatal(tmp_path, capsys):
+    root = make_tree(tmp_path, "def broken(:\n")
+    (tmp_path / "LINT_BASELINE.json").write_text(
+        json.dumps({"version": 1, "findings": []}))
+    rc = check(root)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert len(report["parse_errors"]) == 1
+
+
+def test_report_out_written_atomically(tmp_path, capsys):
+    root = make_tree(tmp_path, "x = 1\n")
+    (tmp_path / "LINT_BASELINE.json").write_text(
+        json.dumps({"version": 1, "findings": []}))
+    out = str(tmp_path / "LINT_REPORT.json")
+    assert check(root, out=out) == 0
+    capsys.readouterr()
+    with open(out) as f:
+        assert json.load(f)["verdict"] == "ok"
+
+
+# ---------- acceptance: the real tree, and no jax ----------
+
+def test_self_run_over_real_tree_is_clean_and_fast():
+    """The shipped tree gates clean against the committed baseline —
+    the SimpleQueue sites are FIXED, not grandfathered (no TPL001 in
+    the baseline; here: none anywhere) — inside the <5 s budget."""
+    t0 = time.monotonic()
+    result = tpulint.run(REPO)
+    g = tpulint.gate(result, os.path.join(REPO, "LINT_BASELINE.json"))
+    wall = time.monotonic() - t0
+    assert g["verdict"] == "ok", (g["verdict"], g["new"][:5])
+    assert g["new"] == []
+    assert result["checked_files"] > 50
+    assert wall < 5.0, f"lint took {wall:.1f}s; budget is <5s"
+    with open(os.path.join(REPO, "LINT_BASELINE.json")) as f:
+        baseline = json.load(f)
+    assert not any(b["rule"] == "TPL001" for b in baseline["findings"])
+
+
+def test_suppressions_in_real_tree_all_carry_reasons():
+    result = tpulint.run(REPO)
+    assert result["suppressed"], "expected the documented pragmas"
+    for s in result["suppressed"]:
+        assert s["allowed"].strip()
+
+
+def test_linter_imports_no_jax():
+    """`make lint` must work on a machine with no accelerator stack:
+    importing and RUNNING the linter never pulls in jax."""
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from tools import tpulint; "
+        "assert 'jax' not in sys.modules, 'import pulled in jax'; "
+        "tpulint.main(['--root', %r, 'check']); "
+        "assert 'jax' not in sys.modules, 'check pulled in jax'"
+        % (REPO, REPO))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_cli_check_subprocess_exit_zero():
+    """The exact `make lint` entry point, end to end."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpulint.py"),
+         "check"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert json.loads(proc.stdout)["verdict"] == "ok"
+
+
+def test_rules_cli_lists_all_rules(capsys):
+    assert tpulint.main(["rules"]) == 0
+    table = json.loads(capsys.readouterr().out)
+    assert [r["id"] for r in table] == RULE_IDS

@@ -1,0 +1,139 @@
+"""WakeQueue: the PR 2 lost-wakeup regression class, exercised head-on.
+
+The seed bug: queue.SimpleQueue's C-level timed get could miss the
+wakeup of a put racing the wait, leaving the consumer asleep for the
+full timeout (or forever) with an item already queued. These tests
+drive the exact shape that wedged — a timed-get consumer racing a
+producer — against utils/wakeq.WakeQueue, plus the two call sites that
+moved onto it (deviceplugin listener fan-out; NRI mux streams are
+covered end-to-end by tests/test_nri.py)."""
+
+import queue
+import threading
+import time
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    HEALTHY,
+    UNHEALTHY,
+    MockDeviceInfo,
+    TPUConfig,
+    TPUManager,
+)
+from container_engine_accelerators_tpu.utils.wakeq import WakeQueue
+
+
+def _fake_devfs(tmp_path, n=2):
+    dev = tmp_path / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(n):
+        (dev / f"accel{i}").touch()
+    return str(dev)
+
+
+def test_timed_get_consumer_races_producer():
+    """The regression shape: a consumer doing short timed gets while a
+    producer races puts at it. Every item must arrive, in order, well
+    inside the sum-of-timeouts a lost wakeup would burn."""
+    q = WakeQueue()
+    n = 400
+    got = []
+    done = threading.Event()
+
+    def consume():
+        while len(got) < n:
+            try:
+                got.append(q.get(timeout=0.05))
+            except queue.Empty:
+                continue
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for i in range(n):
+        q.put(i)
+        if i % 50 == 0:
+            time.sleep(0.001)  # jitter the race window around the wait
+    assert done.wait(10.0), f"consumer wedged: {len(got)}/{n} items"
+    assert got == list(range(n))
+
+
+def test_put_wakes_parked_consumer_promptly():
+    """A consumer parked deep in a long timed get must be woken by the
+    put itself — not by timeout expiry (the lost-wakeup symptom)."""
+    q = WakeQueue()
+    out = []
+
+    def consume():
+        out.append(q.get(timeout=5.0))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.1)  # let it park
+    t0 = time.monotonic()
+    q.put("item")
+    t.join(2.0)
+    assert not t.is_alive()
+    assert out == ["item"]
+    assert time.monotonic() - t0 < 1.0, "woken by timeout, not the put"
+
+
+def test_get_timeout_raises_empty():
+    q = WakeQueue()
+    t0 = time.monotonic()
+    try:
+        q.get(timeout=0.1)
+        raise AssertionError("expected queue.Empty")
+    except queue.Empty:
+        pass
+    assert 0.05 <= time.monotonic() - t0 < 2.0
+
+
+def test_blocking_get_without_timeout():
+    q = WakeQueue()
+    out = []
+    t = threading.Thread(target=lambda: out.append(q.get()), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    q.put(42)
+    t.join(2.0)
+    assert out == [42]
+
+
+def test_fifo_and_nonblocking_helpers():
+    q = WakeQueue()
+    assert q.empty()
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3
+    assert q.get_nowait() == 0
+    assert [q.get(timeout=0.1) for _ in range(2)] == [1, 2]
+
+
+def test_manager_listener_woken_by_health_flip(tmp_path):
+    """deviceplugin integration: the ListAndWatch pump's timed get must
+    see a health transition's wake immediately — this put/timed-get
+    pair is exactly where the SimpleQueue class of bug would delay (or
+    lose) a kubelet resync."""
+    info = MockDeviceInfo(_fake_devfs(tmp_path))
+    m = TPUManager(TPUConfig(), info)
+    m.discover()
+    q = m.add_listener()
+    woken = threading.Event()
+
+    def pump():
+        try:
+            q.get(timeout=5.0)
+            woken.set()
+        except queue.Empty:
+            pass
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    time.sleep(0.05)  # park the pump in its timed get
+    t0 = time.monotonic()
+    m.set_device_health("accel0", UNHEALTHY)
+    assert woken.wait(2.0), "listener never woken by health flip"
+    assert time.monotonic() - t0 < 1.0
+    assert m.devices["accel0"].health == UNHEALTHY
+    m.set_device_health("accel0", HEALTHY)
+    m.remove_listener(q)

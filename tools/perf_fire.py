@@ -30,7 +30,7 @@ sys.path.insert(0, REPO)
 
 def run_stage(name, cmd, timeout, results):
     print(f"--- {name}: {' '.join(cmd)}", file=sys.stderr, flush=True)
-    t0 = time.time()
+    t0 = time.monotonic()  # stage duration, not a timestamp (TPL004)
     try:
         # cwd=REPO: stage paths are repo-relative, and the tool must
         # work from any cwd — a wasted uptime window is the one failure
@@ -50,7 +50,7 @@ def run_stage(name, cmd, timeout, results):
                 pass
     results[name] = {
         "status": "ok" if proc.returncode == 0 else f"rc={proc.returncode}",
-        "seconds": round(time.time() - t0, 1),
+        "seconds": round(time.monotonic() - t0, 1),
         "lines": lines,
         "stderr_tail": proc.stderr[-500:],
     }
@@ -87,8 +87,12 @@ def main():
                   results)
         run_stage("mfu_sweep", [py, "tools/mfu_sweep.py"], 1800,
                   results)
-    with open(args.out, "w") as f:
+    # Atomic: PERF_RESULTS.json may be scraped while a window is still
+    # firing; never expose a torn report (TPL003).
+    tmp = f"{args.out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(results, f, indent=1)
+    os.replace(tmp, args.out)
     print(f"wrote {args.out}", file=sys.stderr)
     return 0
 

@@ -224,8 +224,9 @@ def _force_cpu_hermetic() -> None:
     import jax
     try:
         jax.config.update("jax_platforms", "cpu")
+    # tpulint: allow=TPL009(backend already initialized under pytest, necessarily cpu there)
     except Exception:
-        pass  # backend already initialized (pytest), necessarily cpu there
+        pass
 
 
 def _train_bench():

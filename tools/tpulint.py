@@ -1,0 +1,878 @@
+"""tpulint (ISSUE 7 tentpole): the repo's postmortems as a machine-
+checked static-analysis tier.
+
+PRs 1-6 each paid for a correctness invariant the hard way — the
+SimpleQueue lost-wakeup hang (PR 2), the per-step device_get fence that
+cost real MFU (PR 3), the compat_shard_map spelling that keeps jax
+0.4.x from aborting in backend_compile (PR 3), atomic tmp+os.replace
+dumps so readers never see torn files (PRs 4-6), steady-state
+recompiles as silent throughput cliffs (PR 5). Until now every one of
+those was enforced by comments and reviewer memory. This tool makes
+each of them a permanently-failing check, the way tools/perf_gate.py
+did for perf regressions.
+
+  python tools/tpulint.py check      # gate against LINT_BASELINE.json
+  python tools/tpulint.py baseline   # regenerate the baseline
+
+**Design constraints.** Pure stdlib `ast` — importing this module must
+never import jax (tests enforce it), so `make lint` runs in a couple of
+seconds on any machine, including CI boxes with no accelerator stack.
+Each rule is a class carrying its ID, a rationale citing the
+PR/postmortem that motivated it, a visitor, and good/bad fixture
+snippets that double as its tests (tests/test_tpulint.py iterates
+RULES and asserts bad flags / good does not).
+
+**Suppression.** Two mechanisms, two meanings:
+
+  - `# tpulint: allow=TPL002(reason)` on the finding line (or the line
+    directly above) — a DELIBERATE exception, reviewed in place, with
+    a mandatory non-empty reason. E.g. the two sanctioned log-boundary
+    fences in training/train.py.
+  - LINT_BASELINE.json — grandfathered debt. `check` fails (exit 2)
+    only on findings whose fingerprint is NOT in the committed
+    baseline, the same relative-to-baseline philosophy as the perf
+    gate, so the tool is adoptable in one PR while new violations are
+    hard-blocked. The shipped baseline is empty: every finding in the
+    tree at adoption time was either fixed or pragma'd with a reason.
+
+Fingerprints hash (rule, file, normalized source line, occurrence
+index) — NOT the line number — so unrelated edits above a grandfathered
+finding don't churn the baseline.
+
+Verdicts mirror the perf gate: `ok`, `new_findings:<n>` (exit 2),
+`no_signal:baseline_missing` / `no_signal:baseline_unreadable` /
+`no_signal:baseline_version` (exit 0 with a LOUD warning — "no
+baseline" must never be scored as a pass silently, but must not block
+a PR on a torn checkout either). Stale baseline entries (fingerprint
+no longer found — the debt was paid) are reported so the baseline can
+be re-shrunk with `baseline`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_BASELINE = "LINT_BASELINE.json"
+BASELINE_VERSION = 1
+
+# What `check` scans by default: the package and its tooling. tests/
+# are deliberately out of scope — fixtures there exercise the banned
+# patterns on purpose.
+DEFAULT_TARGETS = (
+    "container_engine_accelerators_tpu",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+# Generated protobuf modules are not ours to lint.
+EXCLUDED_SUFFIXES = ("_pb2.py",)
+EXCLUDED_DIRS = ("__pycache__",)
+
+PRAGMA_RE = re.compile(r"#\s*tpulint:\s*allow=([A-Z]{3}\d{3})\(([^()]*)\)")
+
+
+# ---------- AST helpers ----------
+
+def qualname(node) -> str | None:
+    """Dotted name of a Name/Attribute chain ('jax.device_get',
+    'self._lock'); None for anything more dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return qualname(call.func)
+
+
+_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class FileCtx:
+    """One parsed file + the per-node parent map the rules share."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def ancestors(self, node):
+        cur = self.parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(cur)
+
+    def in_loop(self, node) -> bool:
+        """True if node executes inside a for/while/comprehension body
+        of its own function (a nested def resets the context)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNC_NODES):
+                return False
+            if isinstance(anc, _LOOP_NODES):
+                return True
+        return False
+
+    def enclosing_function(self, node):
+        """Nearest enclosing def (or the Module)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)):
+                return anc
+        return self.tree
+
+    def pragma_allowed(self, rule_id: str, lineno: int) -> str | None:
+        """Non-empty reason if `# tpulint: allow=<rule>(reason)` sits on
+        this line or the line directly above; else None."""
+        for ln in (lineno, lineno - 1):
+            for m in PRAGMA_RE.finditer(self.line_text(ln)):
+                if m.group(1) == rule_id and m.group(2).strip():
+                    return m.group(2).strip()
+        return None
+
+
+def _subtree_calls(node):
+    """Call nodes under `node`, not descending into nested defs."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, _FUNC_NODES):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            stack.append(child)
+
+
+def _norm(base: str) -> str:
+    """Last path component without leading underscores, lowered —
+    matches `self._lock`, `self._wlock`, `LOCK` alike."""
+    return base.rsplit(".", 1)[-1].lstrip("_").lower()
+
+
+# ---------- rule framework ----------
+
+class Rule:
+    """One invariant. Subclasses set id/title/rationale, the fixture
+    pair (bad must flag, good must not — at fixture_path, so scoped
+    rules see an in-scope file), and implement check()."""
+
+    id = ""
+    title = ""
+    rationale = ""
+    bad = ""
+    good = ""
+    fixture_path = "container_engine_accelerators_tpu/example.py"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, ctx: FileCtx):
+        """Yield (lineno, message) pairs."""
+        raise NotImplementedError
+
+
+class BannedSimpleQueue(Rule):
+    id = "TPL001"
+    title = "queue.SimpleQueue on a request/stream/listener path"
+    rationale = (
+        "PR 2 postmortem: SimpleQueue's C-level timed get can lose a "
+        "put's wakeup and block until timeout — or forever — wedging "
+        "engines (~1/10^3 creations on this CPython). cli/serve.py "
+        "replaced it with the Condition-based queue.Queue plus a "
+        "threading.Event wake set AFTER put; utils/wakeq.WakeQueue "
+        "packages that pattern for listener/stream fan-out. Any "
+        "SimpleQueue construction is banned in package code."
+    )
+    bad = "import queue\nq = queue.SimpleQueue()\n"
+    good = ("from container_engine_accelerators_tpu.utils.wakeq import"
+            " WakeQueue\nq = WakeQueue()\n")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and call_name(node) in (
+                    "queue.SimpleQueue", "SimpleQueue"):
+                yield (node.lineno,
+                       "queue.SimpleQueue constructed; use "
+                       "utils/wakeq.WakeQueue (queue.Queue + Event "
+                       "wake, the cli/serve.py pattern from PR 2)")
+            elif (isinstance(node, ast.ImportFrom)
+                  and node.module == "queue"
+                  and any(a.name == "SimpleQueue" for a in node.names)):
+                yield (node.lineno,
+                       "SimpleQueue imported from queue; use "
+                       "utils/wakeq.WakeQueue instead")
+
+
+class HostSyncInHotLoop(Rule):
+    id = "TPL002"
+    title = "host synchronization inside a hot loop"
+    rationale = (
+        "PR 3 postmortem: a per-step jax.device_get fence in "
+        "training/train.py serialized host and device and cost real "
+        "MFU; the fix moved all fences to the log boundary. In the "
+        "decode/train step files, device_get, block_until_ready and "
+        "int()/float() of a computed value inside a for/while body "
+        "re-introduce that fence. The sanctioned log-boundary fences "
+        "carry a `# tpulint: allow=TPL002(reason)` pragma."
+    )
+    fixture_path = "container_engine_accelerators_tpu/training/train.py"
+    bad = ("import jax\n"
+           "def fit(steps, state, step_fn):\n"
+           "    for i in range(steps):\n"
+           "        state, m = step_fn(state)\n"
+           "        loss = jax.device_get(m)\n")
+    good = ("import jax\n"
+            "def fit(steps, state, step_fn):\n"
+            "    for i in range(steps):\n"
+            "        state, m = step_fn(state)\n"
+            "    loss = jax.device_get(m)\n")
+
+    def applies(self, relpath):
+        base = os.path.basename(relpath)
+        return (relpath.replace(os.sep, "/").endswith(
+                    "training/train.py")
+                or ("models/" in relpath.replace(os.sep, "/")
+                    and base.startswith("decode")))
+
+    def check(self, ctx):
+        for call in (n for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.Call)):
+            if not ctx.in_loop(call):
+                continue
+            name = call_name(call) or ""
+            if name == "device_get" or name.endswith(".device_get"):
+                yield (call.lineno,
+                       "device_get inside a loop body: a per-iteration "
+                       "host fence (PR 3's MFU regression); hoist to "
+                       "the log boundary or pragma with a reason")
+            elif (isinstance(call.func, ast.Attribute)
+                  and call.func.attr == "block_until_ready"):
+                yield (call.lineno,
+                       "block_until_ready inside a loop body is a "
+                       "per-iteration host fence")
+            elif (name in ("int", "float") and len(call.args) == 1
+                  and isinstance(call.args[0], ast.Call)):
+                yield (call.lineno,
+                       f"{name}() of a computed value inside a loop "
+                       "body forces a device->host transfer per "
+                       "iteration")
+
+
+class NonAtomicWrite(Rule):
+    id = "TPL003"
+    title = "non-atomic write to a shared-read path"
+    rationale = (
+        "PRs 4-6 postmortems: dumps that other processes read (trace "
+        "dumps, perf reports, OOM bundles) are written tmp + "
+        "os.replace so a reader racing a writer — or a crash mid-dump "
+        "— never sees a torn file (metrics/events.py dump(), "
+        "tools/perf_gate.py _write_json_atomic). open(path, 'w') + "
+        "json.dump/write with no os.replace in the same function "
+        "regresses that."
+    )
+    bad = ("import json\n"
+           "def dump(obj, path):\n"
+           "    with open(path, 'w') as f:\n"
+           "        json.dump(obj, f)\n")
+    good = ("import json, os\n"
+            "def dump(obj, path):\n"
+            "    tmp = f'{path}.tmp.{os.getpid()}'\n"
+            "    with open(tmp, 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+            "    os.replace(tmp, path)\n")
+
+    @staticmethod
+    def _open_mode(call: ast.Call):
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            return call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                return kw.value.value
+        return None
+
+    @staticmethod
+    def _path_is_tmpish(call: ast.Call) -> bool:
+        """True when the path expression names itself a temp file —
+        that's the first half of the atomic idiom."""
+        if not call.args:
+            return False
+        seg = ast.dump(call.args[0])
+        return "tmp" in seg.lower()
+
+    def check(self, ctx):
+        for withnode in (n for n in ast.walk(ctx.tree)
+                         if isinstance(n, (ast.With, ast.AsyncWith))):
+            for item in withnode.items:
+                call = item.context_expr
+                if not (isinstance(call, ast.Call)
+                        and call_name(call) == "open"):
+                    continue
+                mode = self._open_mode(call)
+                if not (isinstance(mode, str)
+                        and mode.rstrip("t+") == "w"):
+                    continue
+                if self._path_is_tmpish(call):
+                    continue
+                writes = any(
+                    (call_name(c) or "").endswith("json.dump")
+                    or call_name(c) == "json.dump"
+                    or (isinstance(c.func, ast.Attribute)
+                        and c.func.attr in ("write", "dump"))
+                    for c in _subtree_calls(withnode))
+                if not writes:
+                    continue
+                fn = ctx.enclosing_function(withnode)
+                replaced = any(call_name(c) == "os.replace"
+                               for c in _subtree_calls(fn))
+                if not replaced:
+                    yield (call.lineno,
+                           "open(path, 'w') dump without tmp + "
+                           "os.replace: a reader racing this writer "
+                           "sees a torn file (the events.py dump() "
+                           "idiom is required)")
+
+
+class WallClockDuration(Rule):
+    id = "TPL004"
+    title = "duration measured with time.time()"
+    rationale = (
+        "Bench/metrics postmortems (r04/r05 noise attribution): "
+        "time.time() steps under NTP slew and clock jumps, so "
+        "durations built from it are unattributable noise. Measurement "
+        "paths must use time.monotonic()/perf_counter(). "
+        "metrics/events.py's single (unix, monotonic) anchor pair is "
+        "the one sanctioned wall-clock capture; wall-vs-wall "
+        "comparisons (K8s timestamps, file mtimes) carry pragmas."
+    )
+    bad = ("import time\n"
+           "def run():\n"
+           "    t0 = time.time()\n"
+           "    work()\n"
+           "    return time.time() - t0\n")
+    good = ("import time\n"
+            "def run():\n"
+            "    t0 = time.monotonic()\n"
+            "    work()\n"
+            "    return time.monotonic() - t0\n")
+
+    @staticmethod
+    def _is_time_time(node) -> bool:
+        return (isinstance(node, ast.Call)
+                and call_name(node) in ("time.time", "time"))
+
+    def check(self, ctx):
+        funcs: dict[ast.AST, list] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                funcs.setdefault(ctx.enclosing_function(node),
+                                 []).append(node)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    or isinstance(node, ast.BinOp)):
+                continue
+            if isinstance(node, ast.BinOp):
+                if isinstance(node.op, ast.Sub) and (
+                        self._is_time_time(node.left)
+                        or self._is_time_time(node.right)):
+                    yield (node.lineno,
+                           "time.time() arithmetic: durations must use "
+                           "time.monotonic()/perf_counter() (wall "
+                           "clock slews)")
+                continue
+            # Assign of a value containing time.time() to simple names,
+            # later subtracted in the same function.
+            if not any(self._is_time_time(sub)
+                       for sub in ast.walk(node.value)):
+                continue
+            names = {t.id for t in node.targets
+                     if isinstance(t, ast.Name)}
+            if not names:
+                continue
+            fn = ctx.enclosing_function(node)
+            for sub in funcs.get(fn, ()):
+                for side in (sub.left, sub.right):
+                    if isinstance(side, ast.Name) and side.id in names:
+                        yield (node.lineno,
+                               f"'{side.id}' holds time.time() and is "
+                               "used in subtraction: wall-clock "
+                               "duration (use monotonic, or pragma if "
+                               "comparing against external wall-clock "
+                               "stamps)")
+                        break
+                else:
+                    continue
+                break
+
+
+class RawShardMap(Rule):
+    id = "TPL005"
+    title = "raw shard_map spelling outside spmd_util"
+    rationale = (
+        "PR 3 postmortem: jax >= 0.5 spells it jax.shard_map "
+        "(check_vma=), 0.4.x keeps it in experimental with check_rep=; "
+        "the wrong spelling on 0.4.x aborts the process inside "
+        "backend_compile. parallel/spmd_util.compat_shard_map is the "
+        "single version-compat entry; raw jax.shard_map or the "
+        "experimental import anywhere else bypasses it."
+    )
+    bad = ("from jax.experimental.shard_map import shard_map\n"
+           "f = shard_map(lambda x: x, mesh, in_specs=None,"
+           " out_specs=None)\n")
+    good = ("from container_engine_accelerators_tpu.parallel.spmd_util"
+            " import compat_shard_map\n"
+            "f = compat_shard_map(lambda x: x, mesh=mesh,"
+            " in_specs=None, out_specs=None)\n")
+
+    def applies(self, relpath):
+        return not relpath.replace(os.sep, "/").endswith(
+            "parallel/spmd_util.py")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Attribute)
+                    and qualname(node) == "jax.shard_map"):
+                yield (node.lineno,
+                       "raw jax.shard_map: route through "
+                       "parallel/spmd_util.compat_shard_map (0.4.x "
+                       "aborts in backend_compile otherwise)")
+            elif isinstance(node, ast.ImportFrom) and (
+                    node.module == "jax.experimental.shard_map"
+                    or (node.module == "jax.experimental"
+                        and any(a.name == "shard_map"
+                                for a in node.names))):
+                yield (node.lineno,
+                       "experimental shard_map import: route through "
+                       "parallel/spmd_util.compat_shard_map")
+
+
+class BlockingUnderLock(Rule):
+    id = "TPL006"
+    title = "blocking call while holding a recorder lock"
+    rationale = (
+        "PR 2/PR 4 class: metrics recorders are called from engine hot "
+        "paths and scrape threads; sleeping, socket/subprocess I/O or "
+        "a timed queue get inside `with self._lock:` turns a shared "
+        "lock into a convoy (and a scrape stall into an engine "
+        "stall). Do the blocking work outside the critical section, "
+        "snapshotting under the lock — the set_device_health / "
+        "EventBus.snapshot() shape."
+    )
+    fixture_path = "container_engine_accelerators_tpu/metrics/example.py"
+    bad = ("import time, threading\n"
+           "class Rec:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def poke(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(0.1)\n")
+    good = ("import time, threading\n"
+            "class Rec:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poke(self):\n"
+            "        with self._lock:\n"
+            "            snap = 1\n"
+            "        time.sleep(0.1)\n")
+
+    _BLOCKING_ATTRS = ("recv", "send", "sendall", "accept", "connect")
+
+    def applies(self, relpath):
+        return "/metrics/" in relpath.replace(os.sep, "/")
+
+    def check(self, ctx):
+        for withnode in (n for n in ast.walk(ctx.tree)
+                         if isinstance(n, ast.With)):
+            if not any(
+                    (q := qualname(item.context_expr)) is not None
+                    and _norm(q).endswith("lock")
+                    for item in withnode.items):
+                continue
+            for call in _subtree_calls(withnode):
+                name = call_name(call) or ""
+                blocking = None
+                if name == "time.sleep" or name.endswith(".sleep"):
+                    blocking = "sleep"
+                elif name.startswith(("subprocess.", "socket.")):
+                    blocking = name
+                elif name == "open":
+                    blocking = "file open"
+                elif (isinstance(call.func, ast.Attribute)
+                      and call.func.attr in self._BLOCKING_ATTRS):
+                    blocking = f".{call.func.attr}()"
+                elif (isinstance(call.func, ast.Attribute)
+                      and call.func.attr == "get"
+                      and any(kw.arg == "timeout"
+                              for kw in call.keywords)):
+                    blocking = "timed queue get"
+                if blocking:
+                    yield (call.lineno,
+                           f"{blocking} inside a `with ...lock:` body: "
+                           "blocking under a recorder lock convoys "
+                           "every caller; snapshot under the lock, "
+                           "block outside it")
+
+
+class NonDaemonThread(Rule):
+    id = "TPL007"
+    title = "threading.Thread without daemon=True"
+    rationale = (
+        "PR 2/PR 4 class: every long-lived thread here (batcher, "
+        "pollers, watchdogs, mux readers) is daemon=True so a crashing "
+        "or exiting process never hangs on a forgotten worker at "
+        "interpreter shutdown; orderly teardown is the explicit "
+        "stop()/join path, not the default join-on-exit. A non-daemon "
+        "thread (or a dynamic daemon= value) needs a pragma arguing "
+        "why shutdown must block on it."
+    )
+    bad = ("import threading\n"
+           "t = threading.Thread(target=print)\n"
+           "t.start()\n")
+    good = ("import threading\n"
+            "t = threading.Thread(target=print, daemon=True)\n"
+            "t.start()\n")
+
+    def check(self, ctx):
+        for call in (n for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.Call)):
+            if call_name(call) not in ("threading.Thread", "Thread"):
+                continue
+            daemon = None
+            for kw in call.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is True):
+                yield (call.lineno,
+                       "threading.Thread without daemon=True: a "
+                       "forgotten worker blocks interpreter shutdown; "
+                       "pass daemon=True and tear down via "
+                       "stop()/join explicitly")
+
+
+class UnwatchedJit(Rule):
+    id = "TPL008"
+    title = "jitted step-path callable not wrapped by introspection.watch"
+    rationale = (
+        "PR 5 postmortem: steady-state recompiles are silent "
+        "throughput cliffs — minutes per compile through the tunnel — "
+        "and only executables wrapped by metrics/introspection.watch "
+        "get recompile attribution with the exact dimension diff (the "
+        "CompileTracker hard gate in the perf tier depends on it). In "
+        "the decode/train step files every jax.jit must go through "
+        "watch/_watched_jit; immediately-invoked one-shot jits "
+        "(init-time allocation) are exempt."
+    )
+    fixture_path = "container_engine_accelerators_tpu/models/decode.py"
+    bad = ("import jax\n"
+           "def make_step(cfg):\n"
+           "    return jax.jit(lambda x: x)\n")
+    good = ("import jax\n"
+            "def make_step(cfg):\n"
+            "    return _watched_jit(jax.jit(lambda x: x), 'step')\n")
+
+    applies = HostSyncInHotLoop.applies
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if qualname(target) == "jax.jit":
+                        yield (node.lineno,
+                               f"@jax.jit on '{node.name}' without "
+                               "introspection.watch: recompiles here "
+                               "escape attribution; wrap the jitted "
+                               "callable in watch(fn, name)")
+                continue
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "jax.jit"):
+                continue
+            parent = ctx.parent.get(node)
+            if isinstance(parent, ast.Call):
+                if parent.func is node:
+                    continue  # jax.jit(...)() one-shot init
+                pname = (call_name(parent) or "").rsplit(".", 1)[-1]
+                if pname in ("watch", "_watched_jit"):
+                    continue
+            yield (node.lineno,
+                   "jax.jit result not wrapped by introspection.watch/"
+                   "_watched_jit: steady-state recompiles on this "
+                   "executable escape attribution (PR 5)")
+
+
+class SilentExceptSwallow(Rule):
+    id = "TPL009"
+    title = "broad exception swallowed with no log or event"
+    rationale = (
+        "Observability-arc postmortems: a bare/broad `except: pass` "
+        "erases exactly the evidence the flight recorder and OOM "
+        "forensics exist to keep. Narrow, deliberate swallows "
+        "(FileNotFoundError on an optional unlink, queue.Empty on a "
+        "drain) are idiomatic and stay legal; swallowing Exception/"
+        "BaseException/bare except with a pass-only body needs at "
+        "least a log/debug event — or a pragma arguing why not."
+    )
+    bad = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    good = ("def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except FileNotFoundError:\n"
+            "        pass\n")
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = [qualname(e) for e in t.elts] if isinstance(
+            t, ast.Tuple) else [qualname(t)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body = node.body
+            silent = (len(body) == 1
+                      and (isinstance(body[0], ast.Pass)
+                           or (isinstance(body[0], ast.Expr)
+                               and isinstance(body[0].value, ast.Constant)
+                               and body[0].value.value is Ellipsis)))
+            if silent and self._broad(node):
+                yield (node.lineno,
+                       "broad except with pass-only body swallows the "
+                       "evidence the recorders exist to keep; log it, "
+                       "narrow the type, or pragma with a reason")
+
+
+RULES: tuple[Rule, ...] = (
+    BannedSimpleQueue(), HostSyncInHotLoop(), NonAtomicWrite(),
+    WallClockDuration(), RawShardMap(), BlockingUnderLock(),
+    NonDaemonThread(), UnwatchedJit(), SilentExceptSwallow(),
+)
+
+
+# ---------- scanning + findings ----------
+
+def iter_py_files(root: str, targets=DEFAULT_TARGETS):
+    for target in targets:
+        full = os.path.join(root, target)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                yield os.path.relpath(full, root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDED_DIRS)
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                if fname.endswith(EXCLUDED_SUFFIXES):
+                    continue
+                yield os.path.relpath(os.path.join(dirpath, fname), root)
+
+
+def fingerprint(rule_id: str, relpath: str, norm_line: str,
+                occurrence: int) -> str:
+    key = f"{rule_id}|{relpath}|{norm_line}|{occurrence}"
+    return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+
+def lint_source(relpath: str, source: str,
+                rules=RULES) -> tuple[list[dict], list[dict]]:
+    """-> (findings, suppressed) for one file; relpath uses '/'
+    separators in the output for stable fingerprints across OSes."""
+    relpath = relpath.replace(os.sep, "/")
+    ctx = FileCtx(relpath, source)
+    findings, suppressed = [], []
+    seen: dict[tuple, int] = {}
+    for rule in rules:
+        if not rule.applies(relpath):
+            continue
+        for lineno, message in rule.check(ctx):
+            reason = ctx.pragma_allowed(rule.id, lineno)
+            norm = ctx.line_text(lineno)
+            k = seen.get((rule.id, norm), 0)
+            seen[(rule.id, norm)] = k + 1
+            rec = {"file": relpath, "line": lineno, "rule": rule.id,
+                   "message": message,
+                   "fingerprint": fingerprint(rule.id, relpath, norm, k)}
+            if reason is not None:
+                rec["allowed"] = reason
+                suppressed.append(rec)
+            else:
+                findings.append(rec)
+    order = {r.id: i for i, r in enumerate(rules)}
+    findings.sort(key=lambda f: (f["file"], f["line"], order[f["rule"]]))
+    return findings, suppressed
+
+
+def run(root: str = REPO, targets=DEFAULT_TARGETS, rules=RULES) -> dict:
+    findings, suppressed, errors = [], [], []
+    n_files = 0
+    for relpath in iter_py_files(root, targets):
+        n_files += 1
+        try:
+            with open(os.path.join(root, relpath), encoding="utf-8") as f:
+                source = f.read()
+            fnd, sup = lint_source(relpath, source, rules)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append({"file": relpath.replace(os.sep, "/"),
+                           "error": f"{type(e).__name__}: {e}"})
+            continue
+        findings.extend(fnd)
+        suppressed.extend(sup)
+    return {"findings": findings, "suppressed": suppressed,
+            "errors": errors, "checked_files": n_files}
+
+
+# ---------- baseline gate (the perf_gate philosophy) ----------
+
+def load_baseline(path: str):
+    """-> (fingerprint set, None) or (None, no_signal cause)."""
+    if not os.path.exists(path):
+        return None, "baseline_missing"
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None, "baseline_unreadable"
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        return None, "baseline_version"
+    try:
+        fps = {f["fingerprint"] for f in data.get("findings", [])}
+    except (TypeError, KeyError):
+        return None, "baseline_unreadable"
+    return fps, None
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def gate(result: dict, baseline_path: str) -> dict:
+    fps, problem = load_baseline(baseline_path)
+    findings = result["findings"]
+    if problem is not None:
+        return {"verdict": f"no_signal:{problem}", "new": findings,
+                "stale": [], "exit_code": 0}
+    new = [f for f in findings if f["fingerprint"] not in fps]
+    current = {f["fingerprint"] for f in findings}
+    stale = sorted(fp for fp in fps if fp not in current)
+    verdict = f"new_findings:{len(new)}" if new else "ok"
+    return {"verdict": verdict, "new": new, "stale": stale,
+            "exit_code": 2 if new else 0}
+
+
+def rule_table() -> list[dict]:
+    return [{"id": r.id, "title": r.title, "rationale": r.rationale}
+            for r in RULES]
+
+
+def cmd_check(args) -> int:
+    t0 = time.monotonic()
+    result = run(args.root, rules=RULES)
+    g = gate(result, os.path.join(args.root, args.baseline))
+    report = {
+        "tool": "tpulint", "verdict": g["verdict"],
+        "checked_files": result["checked_files"],
+        "findings": result["findings"],
+        "new": g["new"], "stale": g["stale"],
+        "suppressed": result["suppressed"],
+        "parse_errors": result["errors"],
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    if args.out:
+        _write_json_atomic(args.out, report)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if g["verdict"].startswith("no_signal"):
+        print(f"tpulint: WARNING {g['verdict']} — nothing was gated; "
+              f"restore {args.baseline} (or regenerate with "
+              "`python tools/tpulint.py baseline`)", file=sys.stderr)
+    for f in g["new"]:
+        print(f"tpulint: NEW {f['rule']} {f['file']}:{f['line']} "
+              f"{f['message']}", file=sys.stderr)
+    if g["stale"]:
+        print(f"tpulint: {len(g['stale'])} stale baseline entr"
+              f"{'y' if len(g['stale']) == 1 else 'ies'} (debt paid) — "
+              "shrink with `python tools/tpulint.py baseline`",
+              file=sys.stderr)
+    return g["exit_code"]
+
+
+def cmd_baseline(args) -> int:
+    result = run(args.root, rules=RULES)
+    path = os.path.join(args.root, args.baseline)
+    _write_json_atomic(path, {
+        "version": BASELINE_VERSION, "tool": "tpulint",
+        "findings": result["findings"],
+        "rules": [r.id for r in RULES],
+    })
+    print(f"tpulint: baseline -> {path} "
+          f"({len(result['findings'])} grandfathered finding(s))",
+          file=sys.stderr)
+    return 0
+
+
+def cmd_rules(args) -> int:
+    print(json.dumps(rule_table(), indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="repo postmortems as a machine-checked lint tier")
+    p.add_argument("--root", default=REPO)
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline path, relative to --root")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("check", help="gate the tree against the baseline")
+    c.add_argument("--out", default="",
+                   help="also write the report JSON here (atomic)")
+    c.set_defaults(fn=cmd_check)
+    b = sub.add_parser("baseline", help="regenerate the baseline")
+    b.set_defaults(fn=cmd_baseline)
+    r = sub.add_parser("rules", help="print the rule table as JSON")
+    r.set_defaults(fn=cmd_rules)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
