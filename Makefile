@@ -100,6 +100,15 @@ trace-smoke:
 introspect-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_introspection.py -q
 
+# tpu-doctor smoke (ISSUE 8, fifth member of the obs-smoke family):
+# per-detector verdicts on synthetic streams, SLO burn math, replay
+# (`trace doctor`) over synthetic timelines, and the live e2e — four
+# injected fault classes through cli/inject_fault.py producing one
+# correctly-classed incident bundle each, replay over the same run's
+# dump reproducing identical verdicts, clean runs staying quiet.
+doctor-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_doctor.py -q
+
 # Hermetic perf gate (ISSUE 6): deterministic CPU tier (no TPU, no
 # network, bounded wall clock) gated on RELATIVE regressions against
 # the committed PERF_BASELINE.json with learned per-metric noise bands,
@@ -122,7 +131,7 @@ perf-gate-smoke:
 
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
-    introspect-smoke perf-gate-smoke perf-gate
+    introspect-smoke doctor-smoke perf-gate-smoke perf-gate
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -134,5 +143,5 @@ clean:
 
 .PHONY: all native test test-quick device-injector-test presubmit \
     lint lint-baseline lint-smoke bench perf hbm-plan obs-smoke \
-    train-obs-smoke trace-smoke introspect-smoke perf-gate \
-    perf-baseline perf-gate-smoke smoke dryrun clean
+    train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
+    perf-gate perf-baseline perf-gate-smoke smoke dryrun clean

@@ -1,14 +1,34 @@
-"""inject-fault — append synthetic TPU error records to the health
-checker's JSONL feed, validating the health pipeline end to end: record ->
-device Unhealthy -> ListAndWatch -> kubelet deschedules; Node condition +
-Event appear.
+"""inject-fault — chaos injection for the health pipeline AND the
+tpu-doctor (ISSUE 8).
 
-This is the analog of the reference's intentional-Xid-31 CUDA demo
-(reference demo/gpu-error/illegal-memory-access/vectorAdd.cu, which
-loops an out-of-bounds kernel to trip the health checker).
+Default kind (`health`) appends synthetic TPU error records to the
+health checker's JSONL feed, validating that pipeline end to end:
+record -> device Unhealthy -> ListAndWatch -> kubelet deschedules;
+Node condition + Event appear. This is the analog of the reference's
+intentional-Xid-31 CUDA demo (reference
+demo/gpu-error/illegal-memory-access/vectorAdd.cu, which loops an
+out-of-bounds kernel to trip the health checker).
 
   python -m container_engine_accelerators_tpu.cli.inject_fault \
       --chip 0 --error-class HBM_ECC_UNCORRECTABLE
+
+The doctor kinds append fault COMMANDS to a JSONL fault log that a
+live process started with `serve --fault-listen PATH` tails
+(metrics/doctor.py FaultListener) — each trips a real failure mode in
+that process so the doctor's detectors are exercised end to end, the
+ROADMAP item 4 chaos-harness primitive:
+
+  --kind hang            worker-thread sleep with slots occupied
+                         (--seconds)
+  --kind recompile-storm N real steady-state recompiles of a watched
+                         jit (--count)
+  --kind hbm-climb       fabricated hbm/<device> exhaustion climb
+                         (--seconds, --device)
+  --kind queue-collapse  fabricated queue-depth growth, zero admits
+                         (--seconds, --depth)
+
+  python -m container_engine_accelerators_tpu.cli.inject_fault \
+      --kind hang --seconds 5 --fault-log /tmp/faults.jsonl
 """
 
 from __future__ import annotations
@@ -26,9 +46,41 @@ from container_engine_accelerators_tpu.healthcheck.health_checker import (
     DEFAULT_ERROR_LOG,
 )
 
+FAULT_KINDS = ("health", "hang", "recompile-storm", "hbm-climb",
+               "queue-collapse")
+
+
+def _append_jsonl(path: str, record: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # Single-line O_APPEND write: tailers (health checker, fault
+    # listener) only consume complete newline-terminated lines, so a
+    # reader never parses a torn record.
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _doctor_record(args) -> dict:
+    kind = args.kind.replace("-", "_")
+    rec: dict = {"kind": kind}
+    if kind == "hang":
+        rec["seconds"] = args.seconds
+    elif kind == "recompile_storm":
+        rec["n"] = args.count
+    elif kind == "hbm_climb":
+        rec.update(device=args.device, seconds=args.seconds,
+                   start_frac=args.start_frac, end_frac=args.end_frac)
+    elif kind == "queue_collapse":
+        rec.update(depth=args.depth, seconds=args.seconds)
+    return rec
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kind", default="health", choices=FAULT_KINDS,
+                   help="health = JSONL error record for the health "
+                        "checker (default); the rest are doctor/chaos "
+                        "fault commands for a --fault-listen process")
+    # health kind
     p.add_argument("--chip", type=int, default=0,
                    help="-1 targets the whole host")
     p.add_argument("--error-class", default="HBM_ECC_UNCORRECTABLE",
@@ -37,15 +89,40 @@ def main(argv=None) -> int:
     p.add_argument("--error-log", default=DEFAULT_ERROR_LOG)
     p.add_argument("--repeat", type=int, default=1)
     p.add_argument("--interval", type=float, default=1.0)
+    # doctor kinds
+    p.add_argument("--fault-log", default=None,
+                   help="fault-command JSONL the target process tails "
+                        "(its serve --fault-listen path); required "
+                        "for non-health kinds")
+    p.add_argument("--seconds", type=float, default=5.0,
+                   help="hang sleep / fabricated-climb duration")
+    p.add_argument("--count", type=int, default=4,
+                   help="recompile-storm: steady-state recompiles to "
+                        "force")
+    p.add_argument("--device", default="injected:0",
+                   help="hbm-climb: device label for the fabricated "
+                        "hbm/<device> track")
+    p.add_argument("--start-frac", type=float, default=0.5)
+    p.add_argument("--end-frac", type=float, default=0.97)
+    p.add_argument("--depth", type=int, default=8,
+                   help="queue-collapse: fabricated final queue depth")
     args = p.parse_args(argv)
 
-    os.makedirs(os.path.dirname(args.error_log) or ".", exist_ok=True)
+    if args.kind != "health":
+        if not args.fault_log:
+            p.error(f"--kind {args.kind} requires --fault-log (the "
+                    "target's serve --fault-listen path)")
+        rec = _doctor_record(args)
+        _append_jsonl(args.fault_log, rec)
+        print(f"injected {args.kind} fault command -> {args.fault_log}: "
+              f"{json.dumps(rec)}")
+        return 0
+
     for i in range(args.repeat):
-        with open(args.error_log, "a") as f:
-            f.write(json.dumps({
-                "chip": args.chip,
-                "class": args.error_class,
-                "message": args.message}) + "\n")
+        _append_jsonl(args.error_log, {
+            "chip": args.chip,
+            "class": args.error_class,
+            "message": args.message})
         print(f"injected {args.error_class} for chip {args.chip} "
               f"({i + 1}/{args.repeat})")
         if i + 1 < args.repeat:

@@ -7,11 +7,20 @@ measure it).
 Modes:
   default    one-shot /generate POSTs; reports request latency.
   --stream   SSE /generate (stream=true); additionally reports TTFT =
-             first `data:` event arrival minus request start, per
-             request, as p50/p90/p99.
+             first `data:` event arrival minus request start, and TPOT
+             = inter-token gaps, per request, as p50/p90/p99.
 
-Prints ONE human line per percentile block plus a final JSON summary
-line (machine-consumable, mirrors bench.py's one-line discipline).
+SLO gating (ISSUE 8: loadgen is the SLO driver for chaos runs and CI):
+  --slo-ttft-p99-ms M   fail unless client-observed TTFT p99 <= M
+  --slo-tpot-p99-ms M   fail unless pooled inter-token-gap p99 <= M
+Both require --stream (the latencies are client-clocked). On any
+violation the run prints a structured `SLO FAIL` line and exits 3
+(errors still exit 1; the codes are distinguishable on purpose — a
+chaos schedule treats "server broke" and "server slow" differently).
+
+Prints ONE human line per percentile block, an `SLO PASS|FAIL` line
+when gating, plus a final JSON summary line (machine-consumable,
+mirrors bench.py's one-line discipline).
 """
 
 from __future__ import annotations
@@ -36,7 +45,8 @@ def percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
 
 def one_request(url: str, tokens: list[int], max_new: int,
                 stream: bool, timeout: float) -> dict:
-    """Returns {"latency": s, "ttft": s|None, "tokens": n_generated}."""
+    """Returns {"latency": s, "ttft": s|None, "tokens": n_generated,
+    "gaps": [inter-token seconds]} (gaps only in stream mode)."""
     body = {"tokens": tokens, "max_new_tokens": max_new}
     if stream:
         body["stream"] = True
@@ -49,8 +59,11 @@ def one_request(url: str, tokens: list[int], max_new: int,
             if "error" in out:
                 raise RuntimeError(out["error"])
             return {"latency": time.perf_counter() - t0, "ttft": None,
-                    "tokens": len(out["tokens"]) - len(tokens)}
+                    "tokens": len(out["tokens"]) - len(tokens),
+                    "gaps": []}
         ttft = None
+        last_tok_t = None
+        gaps: list[float] = []
         n_tok = 0
         for line in resp:
             line = line.decode().strip()
@@ -60,13 +73,17 @@ def one_request(url: str, tokens: list[int], max_new: int,
             if "error" in ev:
                 raise RuntimeError(ev["error"])
             if "token" in ev:
+                now = time.perf_counter()
                 if ttft is None:
-                    ttft = time.perf_counter() - t0
+                    ttft = now - t0
+                else:
+                    gaps.append(now - last_tok_t)
+                last_tok_t = now
                 n_tok += 1
             if ev.get("done"):
                 break
         return {"latency": time.perf_counter() - t0, "ttft": ttft,
-                "tokens": n_tok}
+                "tokens": n_tok, "gaps": gaps}
 
 
 def main(argv=None) -> int:
@@ -79,9 +96,20 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--prompt-len", type=int, default=8)
     p.add_argument("--stream", action="store_true",
-                   help="SSE mode: measure time-to-first-token")
+                   help="SSE mode: measure time-to-first-token and "
+                        "inter-token gaps")
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                   help="fail (exit 3) unless client-observed TTFT "
+                        "p99 <= this; requires --stream")
+    p.add_argument("--slo-tpot-p99-ms", type=float, default=None,
+                   help="fail (exit 3) unless pooled inter-token-gap "
+                        "p99 <= this; requires --stream")
     args = p.parse_args(argv)
+    if ((args.slo_ttft_p99_ms is not None
+         or args.slo_tpot_p99_ms is not None) and not args.stream):
+        p.error("--slo-ttft-p99-ms/--slo-tpot-p99-ms require --stream "
+                "(the latencies are client-clocked off the SSE feed)")
 
     def req_i(i: int) -> dict:
         tokens = [(i * 7 + j) % 100 + 1 for j in range(args.prompt_len)]
@@ -110,14 +138,54 @@ def main(argv=None) -> int:
         "tokens_per_sec": round(
             sum(r["tokens"] for r in results) / wall, 1),
     }
+    slo_violated = False
     if args.stream:
         ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
         tt = percentiles(ttfts)
         print("ttft " + " ".join(f"{k}={v * 1e3:.0f}ms"
                                  for k, v in tt.items()))
         summary["ttft_ms"] = {k: round(v * 1e3, 1) for k, v in tt.items()}
+        gaps = [g for r in results for g in r["gaps"]]
+        if gaps:
+            tp = percentiles(gaps)
+            print("tpot " + " ".join(f"{k}={v * 1e3:.1f}ms"
+                                     for k, v in tp.items()))
+            summary["tpot_ms"] = {k: round(v * 1e3, 2)
+                                  for k, v in tp.items()}
+        # SLO gate: one structured pass/fail line per objective plus a
+        # `slo` block in the JSON summary — the assertion surface for
+        # chaos schedules and CI (metrics/doctor.py is the server-side
+        # twin of this client-side verdict).
+        checks = []
+        if args.slo_ttft_p99_ms is not None:
+            obs = tt["p99"] * 1e3 if ttfts else float("nan")
+            checks.append(("ttft_p99_ms", args.slo_ttft_p99_ms, obs))
+        if args.slo_tpot_p99_ms is not None:
+            obs = (percentiles(gaps)["p99"] * 1e3 if gaps
+                   else float("nan"))
+            checks.append(("tpot_p99_ms", args.slo_tpot_p99_ms, obs))
+        if checks:
+            slo = {}
+            for name, limit, obs in checks:
+                # NaN (no samples at all) fails closed: a run that
+                # produced no tokens cannot claim it met a latency SLO.
+                ok = obs <= limit
+                slo[name] = {"limit": limit,
+                             "observed": (round(obs, 2)
+                                          if obs == obs else None),
+                             "ok": bool(ok)}
+                if not ok:
+                    slo_violated = True
+            summary["slo"] = slo
+            verdict = "PASS" if not slo_violated else "FAIL"
+            print(f"SLO {verdict} " + " ".join(
+                f"{n}={v['observed']}/{v['limit']}"
+                f"[{'ok' if v['ok'] else 'VIOLATED'}]"
+                for n, v in slo.items()))
     print(json.dumps(summary))
-    return 1 if errors else 0
+    if errors:
+        return 1
+    return 3 if slo_violated else 0
 
 
 if __name__ == "__main__":

@@ -129,6 +129,17 @@ def _use_mesh(mesh):
         else None
 
 
+def _maybe_injected_hang(engine):
+    """Consume a FaultListener hang (engine.fault_hang_s): the worker
+    thread itself sleeps, so the stall is indistinguishable from a
+    real wedge — which is the point: the doctor must detect a hang,
+    not be told about one."""
+    s, engine.fault_hang_s = engine.fault_hang_s, 0.0
+    if s > 0:
+        log.warning("injected hang: worker sleeping %.1fs", s)
+        time.sleep(s)
+
+
 class BatchingEngine:
     def __init__(self, params, cfg, max_batch: int = 8,
                  window_ms: float = 5.0, max_prompt_len: int = 1024,
@@ -153,6 +164,10 @@ class BatchingEngine:
         self._work = threading.Event()
         self.batches_run = 0
         self.requests_served = 0
+        # Chaos hook (metrics/doctor.py FaultListener): a nonzero value
+        # makes the worker sleep that long at its next loop top — a
+        # real hang (slots occupied, no ticks) for the doctor e2e.
+        self.fault_hang_s = 0.0
         self._stop = threading.Event()
         self.thread = threading.Thread(target=self._worker, daemon=True,
                                        name="serve-batcher")
@@ -200,6 +215,7 @@ class BatchingEngine:
 
         pending: list = []
         while not self._stop.is_set():
+            _maybe_injected_hang(self)
             # Only block for new traffic when nothing is deferred —
             # otherwise a bucket-mismatched request parked in `pending`
             # would starve until unrelated requests arrive.
@@ -355,6 +371,10 @@ class ContinuousEngine:
         # the worker; _pump_queue never issues a timed queue-get).
         self.queue: queue.Queue = queue.Queue()
         self._work = threading.Event()
+        # Chaos hook (metrics/doctor.py FaultListener), same contract
+        # as BatchingEngine: worker sleeps this long at its next loop
+        # top, producing a real slots-occupied/no-ticks hang.
+        self.fault_hang_s = 0.0
         self.steps_run = 0          # decode iterations (all slots at once)
         self.prefills_run = 0       # completed request prefills
         self.prefill_chunks_run = 0
@@ -488,6 +508,7 @@ class ContinuousEngine:
         self._fresh_state()
 
         while not self._stop.is_set():
+            _maybe_injected_hang(self)
             self._pump_queue()
             with annotate("serve/admit"):
                 self._admit_phase()
@@ -1142,6 +1163,29 @@ def main(argv=None) -> int:
                         "exit/crash and on SIGUSR2 (a directory gets a "
                         "per-pid file); TPU_TRACE_DUMP env is the "
                         "flagless equivalent")
+    p.add_argument("--doctor", action="store_true",
+                   help="run the streaming tpu-doctor (metrics/"
+                        "doctor.py): detectors over the flight "
+                        "recorder + recorders emit deduplicated "
+                        "incident bundles (engine hang, recompile "
+                        "storm, OOM precursor, queue collapse, SLO "
+                        "burn ...), doctor/<class> timeline instants, "
+                        "and tpu_doctor_incidents_total / "
+                        "tpu_slo_burn_rate on the metrics port; "
+                        "/debugz?doctor=1 serves live verdicts. "
+                        "Enables the EventBus if no --trace-dump "
+                        "armed it")
+    p.add_argument("--doctor-dir", default=None,
+                   help="directory for doctor incident bundles "
+                        "(default: TPU_DOCTOR_DIR env, else next to "
+                        "the trace dump, else the cwd)")
+    p.add_argument("--fault-listen", default=None,
+                   help="CHAOS/TEST ONLY: tail this JSONL fault-"
+                        "command file (written by `inject_fault "
+                        "--kind ... --fault-log`) and inject the "
+                        "faults into this process — engine hangs, "
+                        "recompile storms, fabricated HBM/queue "
+                        "telemetry")
     p.add_argument("--moe-decode-ep", action="store_true",
                    help="with --tp > 1 on an MoE model: shard experts "
                         "over the tp axis (n_experts/tp per chip + one "
@@ -1239,6 +1283,22 @@ def main(argv=None) -> int:
                 kv_dtype=args.kv_dtype, chip=_detect_chip()))
         except Exception:
             log.debug("hbm_plan expectation unavailable", exc_info=True)
+    if args.doctor:
+        from container_engine_accelerators_tpu.metrics import doctor
+        if not events.enabled():
+            # The detectors read the flight recorder; --doctor without
+            # a dump path still needs the ring live.
+            events.enable(process_name="serve")
+        doc = doctor.Doctor(
+            registry=recorder.registry, request_recorder=recorder,
+            out_dir=args.doctor_dir if args.doctor_dir else "auto")
+        doc.start()
+        doctor.set_active(doc)
+    if args.fault_listen:
+        from container_engine_accelerators_tpu.metrics.doctor import (
+            FaultListener,
+        )
+        FaultListener(args.fault_listen, engine=engine).start()
     if args.metrics_port is not None:
         exporter = ServeMetricsExporter(recorder, port=args.metrics_port,
                                         host=args.metrics_host)

@@ -32,7 +32,24 @@ Three subcommands:
                summary, and the hbm_plan expectation vs what was
                observed.
 
-Exit code 0 on success; 2 on bad usage (argparse).
+  trace doctor Replay a merged timeline (or a raw EventBus dump)
+               through the tpu-doctor detector registry
+               (metrics/doctor.py) and print the verdicts — the SAME
+               detectors the live `serve --doctor` / `train --doctor`
+               run, so a post-mortem, a chaos run and CI share one
+               diagnosis engine:
+
+                 trace doctor merged.json            # human verdicts
+                 trace doctor merged.json --json     # one JSON each
+                 trace doctor merged.json --fail-on-incident  # CI
+
+               --window / --interval shrink the detection windows for
+               short traces (e.g. chaos scenarios measured in
+               seconds); --out-dir additionally writes each verdict as
+               an incident bundle.
+
+Exit code 0 on success; 2 on bad usage (argparse); `trace doctor
+--fail-on-incident` exits 1 when any incident fires.
 """
 
 from __future__ import annotations
@@ -160,6 +177,44 @@ def cmd_oom(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    from container_engine_accelerators_tpu.metrics import doctor
+
+    try:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"trace doctor: cannot read {args.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    cfg = doctor.DoctorConfig()
+    if args.window is not None:
+        cfg.fast_window_s = args.window
+        cfg.slow_window_s = args.window * 5
+        cfg.hang_after_s = min(cfg.hang_after_s, args.window)
+        cfg.clear_after_s = min(cfg.clear_after_s, args.window)
+    if args.interval is not None:
+        cfg.poll_interval_s = args.interval
+    incidents = doctor.replay(trace, config=cfg, out_dir=args.out_dir)
+    if args.json:
+        for inc in incidents:
+            print(json.dumps(inc))
+    else:
+        n_ev = sum(1 for e in trace.get("traceEvents", ())
+                   if e.get("ph") != "M")
+        print(f"trace doctor: {len(incidents)} incident(s) over "
+              f"{n_ev} events")
+        for inc in incidents:
+            print(f"  [{inc['class']}] {inc['subject']} "
+                  f"(confidence {inc['confidence']:.2f}): "
+                  f"{inc['summary']}")
+            if inc.get("bundle_path"):
+                print(f"      bundle: {inc['bundle_path']}")
+    if args.fail_on_incident and incidents:
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="trace", description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)  # noqa: E501
@@ -195,6 +250,27 @@ def main(argv=None) -> int:
     o.add_argument("--top", type=int, default=10,
                    help="live-array census rows to show")
     o.set_defaults(fn=cmd_oom)
+
+    dr = sub.add_parser("doctor", help="replay a merged timeline "
+                                       "through the tpu-doctor "
+                                       "detector registry")
+    dr.add_argument("trace", help="merged timeline (trace merge) or "
+                                  "raw EventBus dump JSON")
+    dr.add_argument("--window", type=float, default=None,
+                    help="fast detection window seconds (slow = 5x; "
+                         "also caps hang/clear thresholds) — shrink "
+                         "for short traces")
+    dr.add_argument("--interval", type=float, default=None,
+                    help="replay clock step seconds (default: the "
+                         "doctor poll interval)")
+    dr.add_argument("--json", action="store_true",
+                    help="print one JSON incident per line")
+    dr.add_argument("--out-dir", default=None,
+                    help="also write each verdict as an incident "
+                         "bundle under this directory")
+    dr.add_argument("--fail-on-incident", action="store_true",
+                    help="exit 1 if any incident fires (CI gate)")
+    dr.set_defaults(fn=cmd_doctor)
 
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)

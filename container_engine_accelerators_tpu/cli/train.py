@@ -81,6 +81,18 @@ def main(argv=None) -> int:
                         "exit/crash and on SIGUSR2 (a directory gets a "
                         "per-pid file); TPU_TRACE_DUMP env is the "
                         "flagless equivalent")
+    p.add_argument("--doctor", action="store_true",
+                   help="run the streaming tpu-doctor (metrics/"
+                        "doctor.py) over this process: recompile-"
+                        "storm / OOM-precursor / straggler / goodput-"
+                        "burn detectors emit deduplicated incident "
+                        "bundles and tpu_doctor_incidents_total / "
+                        "tpu_slo_burn_rate on the metrics port; "
+                        "enables the EventBus if --trace-dump didn't")
+    p.add_argument("--doctor-dir", default=None,
+                   help="directory for doctor incident bundles "
+                        "(default: TPU_DOCTOR_DIR env, else next to "
+                        "the trace dump, else the cwd)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -147,6 +159,19 @@ def main(argv=None) -> int:
             seq_len=args.seq_len, chip=_detect_chip()))
     except Exception:
         log.debug("hbm_plan expectation unavailable", exc_info=True)
+    doc = None
+    if args.doctor:
+        from container_engine_accelerators_tpu.metrics import (
+            doctor as doctor_mod,
+        )
+        if not events.enabled():
+            events.enable(process_name="train")
+        doc = doctor_mod.Doctor(
+            registry=recorder.registry, train_recorder=recorder,
+            heartbeat_dir=args.heartbeat_dir,
+            out_dir=args.doctor_dir if args.doctor_dir else "auto")
+        doc.start()
+        doctor_mod.set_active(doc)
     opt = make_optimizer()
     state, _ = fit(cfg, mesh, opt, batches,
                    ckpt_dir=args.ckpt_dir, save_every=args.save_every,
@@ -159,6 +184,10 @@ def main(argv=None) -> int:
 
     summary = recorder.summary()
     summary["final_step"] = int(jax.device_get(state.step))
+    if doc is not None:
+        doc.poll_once()  # final evaluation over the tail of the run
+        doc.stop()
+        summary["doctor_incidents"] = len(doc.incidents)
     print(json.dumps(summary))
     recorder.close()
     return 0

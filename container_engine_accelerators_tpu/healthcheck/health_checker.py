@@ -255,6 +255,7 @@ class TPUHealthChecker:
         # been observed: it drives external auto-repair, so a routine
         # app-level error (HBM_OOM) on a healthy node must never set it.
         self._critical_seen = False
+        self._last_event: dict | None = None
         self._stopped = False
         self._last_heartbeat = 0.0
 
@@ -297,6 +298,11 @@ class TPUHealthChecker:
         self.health_events.labels(error_class=ev.error_class).inc()
         self.health_last_event_ts.set(time.time())
         critical = ev.error_class in self.config.health_critical_errors
+        self._last_event = {"class": ev.error_class,
+                            "chip": ev.chip_index,
+                            "critical": critical,
+                            "message": ev.message[:200],
+                            "t": round(time.time(), 3)}
         if events.enabled():
             # On the flight-recorder timeline a fabric/chip fault lines
             # up against the serving/training spans it degraded.
@@ -316,6 +322,16 @@ class TPUHealthChecker:
             # the condition (auto-repair trigger) needs a critical error.
             if self._critical_seen:
                 self.update_condition()
+
+    def error_summary(self) -> dict:
+        """Checker state for in-process consumers — the doctor
+        (metrics/doctor.py) attaches this to health_storm verdicts so
+        the incident bundle carries the same error map the K8s node
+        condition would, without needing a cluster."""
+        return {"counts": dict(self.error_counts),
+                "critical_seen": self._critical_seen,
+                "last_event": (dict(self._last_event)
+                               if self._last_event else None)}
 
     # ---------- K8s surface ----------
 

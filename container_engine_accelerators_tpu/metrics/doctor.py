@@ -1,0 +1,1153 @@
+"""tpu-doctor: streaming SLO engine + automated incident diagnosis
+over the flight recorder (ISSUE 8 tentpole).
+
+PRs 2-6 built the raw signal — RequestRecorder/TrainRecorder
+histograms, the EventBus flight recorder, compile attribution, live
+HBM telemetry, OOM forensics — but nothing *interpreted* it: a wedged
+engine, a recompile storm or an HBM watermark climbing toward OOM was
+still found by a human reading a Perfetto trace. This module is the
+interpretation layer, the TPU-native analog of the reference stack's
+node-problem-detector verdict writers (PAPER.md §L3): detectors watch
+the signals and, when one fires, the system *names the fault* in a
+machine-readable incident bundle the fleet (and ROADMAP item 4's chaos
+harness) can assert against.
+
+Architecture — one diagnosis engine, two feeds:
+
+  **Live.** `Doctor` subscribes a bounded tap to the process-wide
+  EventBus (`events.subscribe()` — the tap counts its own drops, and
+  the ring's overwrite counter rides every evaluation, so the doctor
+  can flag its own blind spots instead of diagnosing from silently
+  truncated evidence). A daemon poll thread drains the tap into a
+  sliding event history, samples the attached recorders /
+  introspection / health-checker state, and runs the detector
+  registry. `serve --doctor` / `train --doctor` wire it up;
+  `/debugz?doctor=1` serves the live verdicts.
+
+  **Offline.** `replay(trace)` steps the SAME detector registry over a
+  merged flight-recorder timeline (`trace doctor MERGED.json`,
+  cli/trace.py) by advancing a synthetic clock through the trace — so
+  chaos runs, post-mortems and CI share one diagnosis engine and a
+  live run and its own dump produce identical verdicts.
+
+Detectors (each yields Findings; the registry is extensible):
+
+  engine_hang      no decode-tick progress while decode slots are
+                   occupied (the serve-side sibling of HangWatchdog)
+  recompile_storm  steady-state XLA recompiles above rate threshold,
+                   with the CompileTracker dimension diff as evidence
+  oom_precursor    HBM bytes_in_use trending toward bytes_limit, with
+                   a least-squares time-to-exhaustion estimate and the
+                   hbm_plan expectation attached
+  queue_collapse   queue depth growing with ZERO admissions in the
+                   window — requests arrive, nothing drains
+  straggler        heartbeat skew across hb-<id> files / HangWatchdog
+                   train/stalled instants naming the stuck rank
+  health_storm     healthcheck ErrorEvents (health/<class> instants)
+                   arriving in a burst
+  slo_burn         multi-window error-budget burn on TTFT/TPOT/goodput
+                   (Google-SRE-style fast+slow window alerting); the
+                   burn rates are ALWAYS exported as
+                   tpu_slo_burn_rate{slo,window}, firing or not
+
+Each firing emits exactly ONE deduplicated incident per (class,
+subject) episode: an atomic (tmp + os.replace, the PR 5 OOM-bundle
+idiom) JSON incident bundle with the verdict class, confidence,
+evidence events out of the ring, and metric snapshots; a
+`doctor/<class>` EventBus instant; and a
+`tpu_doctor_incidents_total{class}` count on the host exporter. A
+condition that persists keeps its incident active; one that stays
+quiet for `clear_after_s` re-arms (a later recurrence is a new
+episode, by design).
+
+`FaultListener` is the chaos-injection half (ROADMAP item 4's entry
+point): it tails a JSONL fault-command file (written by
+`cli/inject_fault.py --kind ...`) and trips REAL failure modes in the
+live process — an engine-worker hang, an actual watched-jit recompile
+storm, fabricated HBM-exhaustion / queue-collapse telemetry — so the
+e2e tests (and future chaos schedules) exercise the same detection
+path production would.
+
+Nothing here imports jax at module import time: `trace doctor` must
+run on jax-free images.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+
+from container_engine_accelerators_tpu.metrics import events
+
+log = logging.getLogger(__name__)
+
+DOCTOR_DIR_ENV = "TPU_DOCTOR_DIR"
+
+# Event names the engine-hang detector accepts as proof of forward
+# progress: decode steps land counters, admissions/first tokens land
+# async instants (metrics/request_metrics.py emits all of them).
+_PROGRESS_COUNTERS = ("serve/decode_step_ms",)
+_PROGRESS_INSTANTS = ("admit", "first_token", "preempt")
+
+
+# ---------- configuration ----------
+
+@dataclasses.dataclass
+class SloSpec:
+    """One service-level objective. For latency kinds ("ttft", "tpot")
+    `threshold_s` bounds a single observation and `objective` is the
+    fraction that must meet it (0.99 -> 1% error budget). For
+    "goodput", `objective` is the minimum acceptable productive
+    fraction of wall-clock. Burn rate 1.0 = consuming budget exactly
+    at the allowed rate; an incident needs the fast AND slow windows
+    burning (transients don't page, sustained burns do)."""
+
+    name: str
+    kind: str                      # ttft | tpot | goodput
+    threshold_s: float | None = None
+    objective: float = 0.99
+    min_samples: int = 20
+    fast_burn: float = 14.4        # SRE 1h/5m page-tier defaults,
+    slow_burn: float = 6.0         # scaled to our window pair
+
+
+def default_slos() -> list[SloSpec]:
+    return [
+        SloSpec("ttft_p99", "ttft", threshold_s=2.0, objective=0.99),
+        SloSpec("tpot_p99", "tpot", threshold_s=0.25, objective=0.99),
+        SloSpec("goodput", "goodput", objective=0.5),
+    ]
+
+
+@dataclasses.dataclass
+class DoctorConfig:
+    """Detector thresholds. Production defaults; tests shrink the
+    windows to drive synthetic timelines."""
+
+    poll_interval_s: float = 5.0
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    # engine_hang: seconds with occupied slots and no progress events.
+    hang_after_s: float = 30.0
+    # recompile_storm: steady-state recompiles within the fast window.
+    recompile_storm_n: int = 3
+    # oom_precursor: utilization watermark OR projected exhaustion.
+    hbm_watermark: float = 0.92
+    hbm_tte_s: float = 600.0
+    hbm_min_samples: int = 4
+    # queue_collapse: depth at/above this and growing, zero admits.
+    queue_min_depth: int = 4
+    # straggler: heartbeat age spread across processes.
+    straggler_skew_s: float = 60.0
+    health_storm_n: int = 3
+    # Incident episode hygiene: a quiet condition re-arms after this.
+    clear_after_s: float = 30.0
+    slos: list = dataclasses.field(default_factory=default_slos)
+    # Event history horizon (doctor-side, independent of ring size).
+    history_cap: int = 32768
+
+    def summary(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["slos"] = [s["name"] for s in d["slos"]]
+        return d
+
+
+@dataclasses.dataclass
+class Finding:
+    """One detector verdict for one evaluation pass; the Doctor dedups
+    these into incident episodes."""
+
+    cls: str
+    subject: str
+    summary: str
+    confidence: float
+    evidence: dict
+
+
+# ---------- signal snapshot (shared by live + offline paths) ----------
+
+class Signals:
+    """Uniform view the detectors read: a time-ordered event history
+    (dicts with `name`/`cat`/`ph`/`ts`-seconds/`args`/`id`), the
+    evaluation clock, and — live only — handles onto the recorders,
+    health checker and heartbeat dir. Offline replay constructs the
+    same object from a merged trace, which is what keeps the verdicts
+    identical across both feeds."""
+
+    def __init__(self, now: float, evs: list[dict], config: DoctorConfig,
+                 request_recorder=None, train_recorder=None,
+                 health_source=None, heartbeat_dir=None,
+                 ring_dropped_delta: int = 0, live: bool = True):
+        self.now = now
+        self.events = evs
+        self.config = config
+        self.request_recorder = request_recorder
+        self.train_recorder = train_recorder
+        self.health_source = health_source
+        self.heartbeat_dir = heartbeat_dir
+        self.ring_dropped_delta = ring_dropped_delta
+        self.live = live
+
+    # -- windows --
+
+    @property
+    def fast_since(self) -> float:
+        return self.now - self.config.fast_window_s
+
+    @property
+    def slow_since(self) -> float:
+        return self.now - self.config.slow_window_s
+
+    # -- queries --
+
+    def named(self, name: str, ph: str | None = None,
+              since: float | None = None) -> list[dict]:
+        return [e for e in self.events
+                if e["name"] == name
+                and (ph is None or e["ph"] == ph)
+                and (since is None or e["ts"] >= since)]
+
+    def prefixed(self, prefix: str, ph: str | None = None,
+                 since: float | None = None) -> list[dict]:
+        return [e for e in self.events
+                if e["name"].startswith(prefix)
+                and (ph is None or e["ph"] == ph)
+                and (since is None or e["ts"] >= since)]
+
+    def series(self, name: str, since: float | None = None
+               ) -> list[tuple[float, dict]]:
+        """Counter samples for one track: [(ts, values)] oldest first."""
+        return [(e["ts"], e["args"]) for e in self.named(name, "C", since)]
+
+    def counter_groups(self, prefix: str, since: float | None = None
+                       ) -> dict[str, list[tuple[float, dict]]]:
+        """Counter tracks sharing a name prefix, keyed by the suffix
+        (e.g. "hbm/" -> one series per device)."""
+        out: dict[str, list] = {}
+        for e in self.prefixed(prefix, "C", since):
+            out.setdefault(e["name"][len(prefix):], []).append(
+                (e["ts"], e["args"]))
+        return out
+
+    def ttft_samples(self, since: float) -> list[float]:
+        """Per-request TTFT seconds derived from the request async
+        span: `request` begin (ph b) to the `first_token` instant
+        (ph n), keyed by request id — the event-derived twin of the
+        recorder's ttft histogram, available offline."""
+        begins: dict[str, float] = {}
+        out: list[float] = []
+        for e in self.events:
+            if e["ph"] == "b" and e["name"] == "request":
+                if e.get("id") is not None:
+                    begins[str(e["id"])] = e["ts"]
+            elif (e["ph"] == "n" and e["name"] == "first_token"
+                  and e["ts"] >= since):
+                t0 = begins.get(str(e.get("id")))
+                if t0 is not None:
+                    out.append(e["ts"] - t0)
+        return out
+
+
+def _evidence_event(e: dict) -> dict:
+    """Evidence pointer into the event ring: enough of the event to
+    find it again in a dump (name + ph + µs timestamp) plus its args."""
+    d = {"name": e["name"], "ph": e["ph"], "ts_us": round(e["ts"] * 1e6, 3)}
+    if e.get("args"):
+        d["args"] = e["args"]
+    if e.get("id") is not None:
+        d["id"] = str(e["id"])
+    return d
+
+
+# ---------- detectors ----------
+
+class Detector:
+    """One diagnosis rule: inspect a Signals snapshot, return zero or
+    more Findings. Detectors must be pure over the snapshot (no side
+    effects) — the Doctor owns dedup, emission and metrics."""
+
+    cls = "?"
+
+    def check(self, sig: Signals) -> list[Finding]:
+        raise NotImplementedError
+
+
+class EngineHangDetector(Detector):
+    """Decode slots occupied with no forward progress: the last
+    serve/slots counter shows active > 0 and no decode step /
+    admission / first-token event has landed for hang_after_s. During
+    a true hang the wedged worker emits nothing, so absence of NEW
+    slot counters is itself corroborating silence (the failure mode
+    PR 2's SimpleQueue bug produced, now detected instead of bisected)."""
+
+    cls = "engine_hang"
+
+    def check(self, sig):
+        slots = sig.series("serve/slots")
+        if not slots:
+            return []
+        ts_last, vals = slots[-1]
+        if vals.get("active", 0) <= 0:
+            return []
+        # Occupied since: walk back over the trailing active>0 run.
+        occupied_since = ts_last
+        for ts, v in reversed(slots):
+            if v.get("active", 0) <= 0:
+                break
+            occupied_since = ts
+        progress = [e["ts"] for e in sig.events
+                    if (e["ph"] == "C" and e["name"] in _PROGRESS_COUNTERS)
+                    or (e["ph"] == "n"
+                        and e["name"] in _PROGRESS_INSTANTS)]
+        last_progress = max((t for t in progress if t >= occupied_since),
+                            default=None)
+        ref = last_progress if last_progress is not None else occupied_since
+        stalled_s = sig.now - ref
+        if stalled_s < sig.config.hang_after_s:
+            return []
+        ev = {"stalled_s": round(stalled_s, 3),
+              "occupied_since": round(occupied_since, 3),
+              "slots": vals,
+              "events": [_evidence_event(
+                  {"name": "serve/slots", "ph": "C", "ts": ts_last,
+                   "args": vals})]}
+        if last_progress is not None:
+            ev["last_progress_s_ago"] = round(sig.now - last_progress, 3)
+        return [Finding(
+            self.cls, "serve",
+            f"decode slots occupied ({vals.get('active')}/"
+            f"{vals.get('total')}) with no decode progress for "
+            f"{stalled_s:.1f}s", 0.9, ev)]
+
+
+class RecompileStormDetector(Detector):
+    """Steady-state XLA recompiles above rate: every one stalls the
+    engine for a full compile pipeline, and a storm means shapes are
+    escaping the bucketing. Evidence carries the CompileTracker's
+    exact dimension diff — the line that separates 'unbucketed prompt'
+    from 'cache eviction'."""
+
+    cls = "recompile_storm"
+
+    def check(self, sig):
+        recs = sig.named("xla/recompile", "i", sig.fast_since)
+        if len(recs) < sig.config.recompile_storm_n:
+            return []
+        fns = collections.Counter(
+            e["args"].get("fn", "?") for e in recs)
+        top_fn, top_n = fns.most_common(1)[0]
+        ev = {"count": len(recs),
+              "window_s": sig.config.fast_window_s,
+              "fns": dict(fns),
+              "last_diff": recs[-1]["args"].get("diff"),
+              "events": [_evidence_event(e) for e in recs[-5:]]}
+        return [Finding(
+            self.cls, top_fn,
+            f"{len(recs)} steady-state XLA recompiles in "
+            f"{sig.config.fast_window_s:.0f}s ({top_n} on {top_fn}); "
+            f"last diff: {ev['last_diff']}", 0.95, ev)]
+
+
+class OomPrecursorDetector(Detector):
+    """HBM bytes_in_use trending toward bytes_limit on any device:
+    fires at the utilization watermark, or earlier when a least-squares
+    fit over the window projects exhaustion within hbm_tte_s — the
+    'you will OOM in ~N seconds' verdict the post-hoc OOM forensics
+    bundle can only write after the fact."""
+
+    cls = "oom_precursor"
+
+    def check(self, sig):
+        out = []
+        for dev, series in sig.counter_groups("hbm/",
+                                              sig.slow_since).items():
+            pts = [(ts, v["bytes_in_use"], v.get("bytes_limit", 0))
+                   for ts, v in series if "bytes_in_use" in v]
+            if len(pts) < sig.config.hbm_min_samples:
+                continue
+            ts_l, used_l, limit_l = pts[-1]
+            if not limit_l:
+                continue
+            util = used_l / limit_l
+            slope = _lsq_slope([(t, u) for t, u, _ in pts])
+            tte = ((limit_l - used_l) / slope
+                   if slope and slope > 0 else None)
+            if not (util >= sig.config.hbm_watermark
+                    or (tte is not None and tte <= sig.config.hbm_tte_s)):
+                continue
+            ev = {"device": dev, "utilization": round(util, 4),
+                  "bytes_in_use": used_l, "bytes_limit": limit_l,
+                  "slope_bytes_per_s": round(slope, 1) if slope else 0.0,
+                  "tte_s": round(tte, 1) if tte is not None else None,
+                  "samples": len(pts),
+                  "events": [_evidence_event(
+                      {"name": f"hbm/{dev}", "ph": "C", "ts": t,
+                       "args": {"bytes_in_use": u, "bytes_limit": lim}})
+                      for t, u, lim in pts[-3:]]}
+            if sig.live:
+                ev["hbm_plan"] = _expected_hbm()
+            tte_txt = (f"exhaustion in ~{tte:.0f}s"
+                       if tte is not None else "at watermark")
+            out.append(Finding(
+                self.cls, dev,
+                f"HBM {dev} at {util * 100:.1f}% and climbing "
+                f"({ev['slope_bytes_per_s']:.0f} B/s): {tte_txt}",
+                0.85, ev))
+        return out
+
+
+class QueueCollapseDetector(Detector):
+    """Queue depth at/above threshold and GROWING across the fast
+    window with zero admissions: traffic arrives, nothing drains —
+    the admission path (not the decode path) is dead."""
+
+    cls = "queue_collapse"
+
+    def check(self, sig):
+        series = sig.series("serve/queue_depth", sig.fast_since)
+        if len(series) < 2:
+            return []
+        depth_first = series[0][1].get("queued", 0)
+        ts_last, last = series[-1]
+        depth_last = last.get("queued", 0)
+        if depth_last < sig.config.queue_min_depth:
+            return []
+        if depth_last <= depth_first:
+            return []
+        if sig.named("admit", "n", sig.fast_since):
+            return []
+        ev = {"depth": depth_last, "depth_window_start": depth_first,
+              "window_s": sig.config.fast_window_s,
+              "events": [_evidence_event(
+                  {"name": "serve/queue_depth", "ph": "C", "ts": ts,
+                   "args": v}) for ts, v in series[-3:]]}
+        return [Finding(
+            self.cls, "serve",
+            f"queue depth grew {depth_first} -> {depth_last} with "
+            f"zero admits in {sig.config.fast_window_s:.0f}s",
+            0.9, ev)]
+
+
+class StragglerDetector(Detector):
+    """Names the slow rank: a HangWatchdog train/stalled instant on
+    the timeline (works offline too), or — live, with a heartbeat dir
+    attached — hb-<id> mtime skew beyond straggler_skew_s while at
+    least one process stays fresh (the skew form catches a straggler
+    BEFORE the absolute-age watchdog threshold trips)."""
+
+    cls = "straggler"
+
+    def check(self, sig):
+        stalls = sig.named("train/stalled", "i", sig.fast_since)
+        if stalls:
+            last = stalls[-1]
+            proc = last["args"].get("process", "?")
+            ev = {"source": "hang_watchdog",
+                  "process": proc,
+                  "age_s": last["args"].get("age_s"),
+                  "events": [_evidence_event(e) for e in stalls[-3:]]}
+            return [Finding(
+                self.cls, f"process-{proc}",
+                f"hang watchdog reports process {proc} heartbeat "
+                f"{last['args'].get('age_s', '?')}s old", 0.9, ev)]
+        if not (sig.live and sig.heartbeat_dir):
+            return []
+        ages = _heartbeat_ages(sig.heartbeat_dir)
+        if len(ages) < 2:
+            return []
+        worst = max(ages, key=lambda p: ages[p])
+        skew = ages[worst] - min(ages.values())
+        if skew < sig.config.straggler_skew_s:
+            return []
+        ev = {"source": "heartbeat_skew",
+              "ages_s": {str(k): round(v, 1) for k, v in ages.items()},
+              "skew_s": round(skew, 1)}
+        return [Finding(
+            self.cls, f"process-{worst}",
+            f"process {worst} heartbeat lags the freshest peer by "
+            f"{skew:.0f}s", 0.75, ev)]
+
+
+class HealthStormDetector(Detector):
+    """A burst of healthcheck ErrorEvents (health/<class> instants from
+    healthcheck/health_checker.py) in the fast window: one flaky line
+    is noise, a storm is a node going bad under the workload."""
+
+    cls = "health_storm"
+
+    def check(self, sig):
+        errs = sig.prefixed("health/", "i", sig.fast_since)
+        if len(errs) < sig.config.health_storm_n:
+            return []
+        classes = collections.Counter(
+            e["name"].split("/", 1)[1] for e in errs)
+        top_cls, top_n = classes.most_common(1)[0]
+        critical = any(e["args"].get("critical") for e in errs)
+        ev = {"count": len(errs), "classes": dict(classes),
+              "critical": critical,
+              "window_s": sig.config.fast_window_s,
+              "events": [_evidence_event(e) for e in errs[-5:]]}
+        if sig.live and sig.health_source is not None:
+            try:
+                ev["checker"] = sig.health_source.error_summary()
+            except Exception:
+                log.exception("health source summary failed")
+        return [Finding(
+            self.cls, top_cls,
+            f"{len(errs)} TPU health errors in "
+            f"{sig.config.fast_window_s:.0f}s (top: {top_cls} x{top_n}"
+            f"{', critical' if critical else ''})",
+            0.9 if critical else 0.7, ev)]
+
+
+class SloBurnDetector(Detector):
+    """Multi-window error-budget burn: an SLO pages only when BOTH the
+    fast and slow windows burn above their thresholds (fast alone =
+    transient, slow alone = old news). The burn rates themselves are
+    exported continuously by the Doctor whether or not anything fires."""
+
+    cls = "slo_burn"
+
+    def check(self, sig):
+        out = []
+        for spec in sig.config.slos:
+            fast, n_fast = slo_burn(sig, spec, sig.config.fast_window_s)
+            slow, _ = slo_burn(sig, spec, sig.config.slow_window_s)
+            if n_fast < spec.min_samples and spec.kind != "goodput":
+                continue
+            if fast < spec.fast_burn or slow < spec.slow_burn:
+                continue
+            ev = {"slo": spec.name, "kind": spec.kind,
+                  "objective": spec.objective,
+                  "threshold_s": spec.threshold_s,
+                  "burn_fast": round(fast, 2), "burn_slow": round(slow, 2),
+                  "samples_fast": n_fast,
+                  "windows_s": [sig.config.fast_window_s,
+                                sig.config.slow_window_s]}
+            out.append(Finding(
+                self.cls, spec.name,
+                f"SLO {spec.name} burning error budget at "
+                f"{fast:.1f}x (fast) / {slow:.1f}x (slow) the "
+                f"sustainable rate", 0.8, ev))
+        return out
+
+
+def default_detectors() -> list[Detector]:
+    return [EngineHangDetector(), RecompileStormDetector(),
+            OomPrecursorDetector(), QueueCollapseDetector(),
+            StragglerDetector(), HealthStormDetector(),
+            SloBurnDetector()]
+
+
+# ---------- detector helpers ----------
+
+def _lsq_slope(pts: list[tuple[float, float]]) -> float | None:
+    """Least-squares slope of y over t; None for degenerate inputs."""
+    n = len(pts)
+    if n < 2:
+        return None
+    mt = sum(t for t, _ in pts) / n
+    my = sum(y for _, y in pts) / n
+    den = sum((t - mt) ** 2 for t, _ in pts)
+    if den <= 0:
+        return None
+    return sum((t - mt) * (y - my) for t, y in pts) / den
+
+
+def _heartbeat_ages(hb_dir: str) -> dict[int, float]:
+    """Heartbeat-file ages (seconds) by process id, the HangWatchdog
+    file contract (train_metrics.py hb-<id>)."""
+    # tpulint: allow=TPL004(wall-vs-wall, ages come from file mtimes)
+    now = time.time()
+    ages: dict[int, float] = {}
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return ages
+    for name in names:
+        if not (name.startswith("hb-") and name[3:].isdigit()):
+            continue
+        try:
+            mtime = os.stat(os.path.join(hb_dir, name)).st_mtime
+        except OSError:
+            continue  # racing a writer's replace
+        ages[int(name[3:])] = max(0.0, now - mtime)
+    return ages
+
+
+def _expected_hbm():
+    """hbm_plan expectation recorded at launch (introspection), for
+    oom_precursor evidence; None when no plan was set."""
+    try:
+        from container_engine_accelerators_tpu.metrics import (
+            introspection,
+        )
+        return introspection.expected_hbm()
+    except Exception:
+        return None
+
+
+def slo_burn(sig: Signals, spec: SloSpec, window_s: float
+             ) -> tuple[float, int]:
+    """(burn_rate, n_samples) for one SLO over one window. Latency
+    kinds prefer the recorder's timestamped samples (live) and fall
+    back to event-derived values (offline replay); goodput reads the
+    cumulative train/goodput_fraction counter either way, so live and
+    offline agree."""
+    since = sig.now - window_s
+    budget = max(1e-6, 1.0 - spec.objective)
+    if spec.kind == "goodput":
+        frac = None
+        rec = sig.train_recorder
+        if rec is not None:
+            try:
+                frac = rec.goodput(now=sig.now)["goodput_fraction"]
+            except Exception:
+                log.exception("goodput sample failed")
+        if frac is None:
+            series = sig.series("train/goodput_fraction", since)
+            if series:
+                frac = series[-1][1].get("fraction")
+        if frac is None:
+            return 0.0, 0
+        return max(0.0, 1.0 - frac) / budget, 1
+    if spec.kind in ("ttft", "tpot"):
+        rec = sig.request_recorder
+        if rec is not None:
+            n, bad = rec.window_counts(spec.kind, since,
+                                       spec.threshold_s)
+        elif spec.kind == "ttft":
+            xs = sig.ttft_samples(since)
+            n = len(xs)
+            bad = sum(1 for x in xs if x > spec.threshold_s)
+        else:
+            return 0.0, 0  # tpot has no event-derived form (yet)
+        if n == 0:
+            return 0.0, 0
+        return (bad / n) / budget, n
+    log.warning("unknown SLO kind %r", spec.kind)
+    return 0.0, 0
+
+
+# ---------- the doctor ----------
+
+def _raw_to_dict(ev: tuple) -> dict:
+    """EventBus ring tuple -> the detector event-dict form."""
+    ph, ts, _tid, name, cat, _dur, eid, args = ev
+    return {"name": name, "cat": cat or "", "ph": ph, "ts": ts,
+            "args": dict(args) if args else {}, "id": eid}
+
+
+def trace_to_events(trace: dict) -> list[dict]:
+    """Chrome-trace JSON (a raw EventBus dump or a `trace merge`
+    output) -> time-ordered detector event dicts (ts in seconds,
+    whatever epoch the trace used — detectors only need deltas)."""
+    out = []
+    for ev in trace.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        out.append({"name": ev.get("name", ""),
+                    "cat": ev.get("cat", ""), "ph": ph,
+                    "ts": float(ev.get("ts", 0.0)) / 1e6,
+                    "args": ev.get("args") or {}, "id": ev.get("id")})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+class Doctor:
+    """The diagnosis engine. Live: `start()` subscribes the EventBus
+    tap and polls on a daemon thread. Offline: `replay()` drives
+    `evaluate()` with a synthetic clock. Both paths share ingest ->
+    Signals -> detectors -> dedup -> incident emission."""
+
+    def __init__(self, config: DoctorConfig | None = None,
+                 registry=None, request_recorder=None,
+                 train_recorder=None, health_source=None,
+                 heartbeat_dir: str | None = None,
+                 out_dir: str | None = "auto",
+                 detectors: list[Detector] | None = None,
+                 bus: events.EventBus | None = None,
+                 live: bool = True):
+        self.config = config or DoctorConfig()
+        self.request_recorder = request_recorder
+        self.train_recorder = train_recorder
+        self.health_source = health_source
+        self.heartbeat_dir = heartbeat_dir
+        self.detectors = (detectors if detectors is not None
+                          else default_detectors())
+        self.live = live
+        self.bus = bus if bus is not None else (events.get_bus()
+                                                if live else None)
+        self.out_dir = self._resolve_out_dir(out_dir)
+        self.incidents: collections.deque = collections.deque(maxlen=256)
+        self._history: collections.deque = collections.deque(
+            maxlen=self.config.history_cap)
+        self._active: dict[tuple[str, str], dict] = {}
+        self._burns: dict[str, dict] = {}
+        self._seq = itertools.count(1)
+        self._tap: events.EventTap | None = None
+        self._ring_dropped_prev = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        from prometheus_client import CollectorRegistry, Counter, Gauge
+        self.registry = registry or CollectorRegistry()
+        reg = self.registry
+        self.incidents_total = Counter(
+            "tpu_doctor_incidents",
+            "Doctor incident bundles emitted, by verdict class",
+            ["class"], registry=reg)
+        self.burn_g = Gauge(
+            "tpu_slo_burn_rate",
+            "Error-budget burn rate per SLO and window (1.0 = budget "
+            "consumed exactly at the sustainable rate)",
+            ["slo", "window"], registry=reg)
+        self.active_g = Gauge(
+            "tpu_doctor_active_incidents",
+            "Incident episodes currently firing", registry=reg)
+        self.evals_total = Counter(
+            "tpu_doctor_evals",
+            "Doctor evaluation passes completed", registry=reg)
+        # Materialize the class labels the e2e asserts on, so the
+        # families scrape complete (all zeros) before anything fires.
+        for det in self.detectors:
+            self.incidents_total.labels(det.cls)
+
+    @staticmethod
+    def _resolve_out_dir(out_dir: str | None) -> str | None:
+        if out_dir != "auto":
+            return out_dir
+        env = os.environ.get(DOCTOR_DIR_ENV)
+        if env:
+            return env
+        dump = getattr(events, "_DUMP_PATH", None)
+        if dump:
+            return os.path.dirname(dump) or "."
+        return "."
+
+    # ---------- live loop ----------
+
+    def start(self) -> None:
+        """Subscribe the tap and start the poll thread (idempotent)."""
+        if self._thread is not None:
+            return
+        if self._tap is None and self.bus is not None:
+            self._tap = self.bus.subscribe("doctor")
+            self._ring_dropped_prev = self.bus.dropped
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpu-doctor")
+        self._thread.start()
+        log.info("tpu-doctor running: %d detectors, poll %.1fs, "
+                 "incident dir %s", len(self.detectors),
+                 self.config.poll_interval_s, self.out_dir or "(none)")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("doctor evaluation failed")
+            self._stop.wait(self.config.poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._tap is not None and self.bus is not None:
+            self.bus.unsubscribe(self._tap)
+            self._tap = None
+
+    # ---------- ingestion ----------
+
+    def ingest(self, evs: list[dict]) -> None:
+        """Append event dicts (already time-ordered) to the history.
+        The doctor's own doctor/* emissions are excluded so a verdict
+        never becomes its own evidence."""
+        with self._lock:
+            for e in evs:
+                if not e["name"].startswith("doctor/"):
+                    self._history.append(e)
+
+    def _drain_tap(self) -> int:
+        """Pull tap backlog into history; returns ring-drop delta since
+        the previous poll (the blind-spot signal)."""
+        dropped_delta = 0
+        if self.bus is not None:
+            d = self.bus.dropped
+            dropped_delta = max(0, d - self._ring_dropped_prev)
+            self._ring_dropped_prev = d
+        if self._tap is not None:
+            raw = self._tap.drain()
+            if raw:
+                self.ingest([_raw_to_dict(ev) for ev in raw])
+        return dropped_delta
+
+    # ---------- evaluation ----------
+
+    def _signals(self, now: float, ring_dropped_delta: int) -> Signals:
+        # Bounded both ways: below by the history horizon, above by
+        # `now` — the replay clock must never let a detector see the
+        # future (live events can't, monotonic ts <= monotonic now).
+        horizon = now - self.config.slow_window_s * 1.5
+        with self._lock:
+            evs = [e for e in self._history
+                   if horizon <= e["ts"] <= now]
+        return Signals(now, evs, self.config,
+                       request_recorder=self.request_recorder,
+                       train_recorder=self.train_recorder,
+                       health_source=self.health_source,
+                       heartbeat_dir=self.heartbeat_dir,
+                       ring_dropped_delta=ring_dropped_delta,
+                       live=self.live)
+
+    def poll_once(self, now: float | None = None) -> list[dict]:
+        """One live evaluation: drain the tap, snapshot, diagnose.
+        Returns incidents emitted by this pass."""
+        dropped_delta = self._drain_tap()
+        now = time.monotonic() if now is None else now
+        return self.evaluate(self._signals(now, dropped_delta))
+
+    def evaluate(self, sig: Signals) -> list[dict]:
+        """Run the registry over one snapshot; dedup into episodes and
+        emit incidents for new ones."""
+        findings: list[Finding] = []
+        for det in self.detectors:
+            try:
+                findings.extend(det.check(sig))
+            except Exception:
+                log.exception("detector %s failed", det.cls)
+        self._refresh_burn_gauges(sig)
+
+        emitted = []
+        seen_keys = set()
+        for f in findings:
+            key = (f.cls, f.subject)
+            seen_keys.add(key)
+            ep = self._active.get(key)
+            if ep is None:
+                inc = self._emit_incident(f, sig)
+                self._active[key] = {"since": sig.now,
+                                     "last_seen": sig.now,
+                                     "incident": inc}
+                emitted.append(inc)
+            else:
+                ep["last_seen"] = sig.now
+        for key in list(self._active):
+            if key in seen_keys:
+                continue
+            if sig.now - self._active[key]["last_seen"] \
+                    >= self.config.clear_after_s:
+                del self._active[key]
+                log.info("doctor: %s/%s cleared", *key)
+                if self.live and events.enabled():
+                    events.instant("doctor/clear", "doctor",
+                                   {"class": key[0], "subject": key[1]})
+        self.active_g.set(len(self._active))
+        self.evals_total.inc()
+        return emitted
+
+    def _refresh_burn_gauges(self, sig: Signals) -> None:
+        for spec in self.config.slos:
+            fast, n_fast = slo_burn(sig, spec,
+                                    self.config.fast_window_s)
+            slow, n_slow = slo_burn(sig, spec,
+                                    self.config.slow_window_s)
+            self.burn_g.labels(slo=spec.name, window="fast").set(fast)
+            self.burn_g.labels(slo=spec.name, window="slow").set(slow)
+            self._burns[spec.name] = {
+                "fast": round(fast, 3), "slow": round(slow, 3),
+                "samples_fast": n_fast, "samples_slow": n_slow}
+
+    # ---------- incident emission ----------
+
+    def _emit_incident(self, f: Finding, sig: Signals) -> dict:
+        confidence = f.confidence
+        evidence = dict(f.evidence)
+        if sig.ring_dropped_delta > 0:
+            # Blind spot: the ring overwrote events since the last
+            # evaluation, so the evidence may be incomplete — say so
+            # in the verdict instead of pretending omniscience.
+            evidence["ring_dropped_in_window"] = sig.ring_dropped_delta
+            confidence = round(confidence * 0.8, 3)
+        inc = {
+            "kind": "tpu_doctor_incident",
+            "version": 1,
+            "seq": next(self._seq),
+            "class": f.cls,
+            "subject": f.subject,
+            "summary": f.summary,
+            "confidence": confidence,
+            "t": round(time.time(), 3),
+            "ts_monotonic": round(sig.now, 6),
+            "pid": os.getpid(),
+            "evidence": evidence,
+            "slo_burn": dict(self._burns),
+            "windows": {"fast_s": self.config.fast_window_s,
+                        "slow_s": self.config.slow_window_s},
+        }
+        if self.bus is not None:
+            inc["ring"] = {"emitted": self.bus.emitted,
+                           "dropped": self.bus.dropped}
+        inc["metrics"] = self._metric_snapshots()
+        path = self._write_bundle(inc)
+        if path:
+            inc["bundle_path"] = path
+        self.incidents.append(inc)
+        self.incidents_total.labels(f.cls).inc()
+        if self.live and events.enabled():
+            events.instant(f"doctor/{f.cls}", "doctor",
+                           {"subject": f.subject,
+                            "summary": f.summary[:200],
+                            "confidence": confidence,
+                            "bundle": path or ""})
+        log.error("tpu-doctor incident [%s] %s: %s%s", f.cls, f.subject,
+                  f.summary,
+                  f" (bundle -> {path})" if path else "")
+        return inc
+
+    def _metric_snapshots(self) -> dict:
+        """Best-effort state-of-the-world attachments; each source is
+        independently guarded (a broken snapshot must not lose the
+        verdict)."""
+        out: dict = {}
+        rec = self.request_recorder
+        if rec is not None:
+            try:
+                out["serve"] = {k: rec.pct_ms(k)
+                                for k in ("ttft", "tpot", "queue_wait")}
+            except Exception:
+                log.exception("serve metric snapshot failed")
+        trec = self.train_recorder
+        if trec is not None:
+            try:
+                out["train"] = trec.summary()
+                age = trec.last_step_age()
+                if age is not None:
+                    out["train"]["last_step_age_s"] = round(age, 3)
+            except Exception:
+                log.exception("train metric snapshot failed")
+        if self.live:
+            try:
+                from container_engine_accelerators_tpu.metrics import (
+                    introspection,
+                )
+                out["compile_cache"] = introspection.get_tracker().summary()
+            except Exception:
+                log.exception("compile snapshot failed")
+        return out
+
+    def _write_bundle(self, inc: dict) -> str | None:
+        """Atomic (tmp + os.replace) incident bundle write; never
+        raises — diagnosis must not take down the patient."""
+        if not self.out_dir:
+            return None
+        try:
+            path = os.path.join(
+                self.out_dir,
+                f"incident-{inc['class']}-{os.getpid()}"
+                f"-{inc['seq']}.json")
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(inc, fh)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            log.exception("incident bundle write failed")
+            return None
+
+    # ---------- introspection ----------
+
+    def debugz(self) -> dict:
+        with self._lock:
+            history_len = len(self._history)
+        tap = self._tap
+        return {
+            "active": True,
+            "config": self.config.summary(),
+            "detectors": [d.cls for d in self.detectors],
+            "active_incidents": [
+                {"class": k[0], "subject": k[1],
+                 "since": round(v["since"], 3),
+                 "last_seen": round(v["last_seen"], 3)}
+                for k, v in self._active.items()],
+            "incidents": list(self.incidents)[-32:],
+            "slo_burn": dict(self._burns),
+            "history_events": history_len,
+            "tap": ({"received": tap.received, "dropped": tap.dropped}
+                    if tap is not None else None),
+        }
+
+
+# ---------- offline replay ----------
+
+def replay(trace: dict, config: DoctorConfig | None = None,
+           step_s: float | None = None, out_dir: str | None = None,
+           request_recorder=None, train_recorder=None) -> list[dict]:
+    """Run the detector registry over a merged timeline (or a raw
+    dump): the clock is stepped from the first event to the last in
+    `step_s` increments (default: the config poll interval), each step
+    evaluating exactly like a live poll. One deduplicated incident per
+    fault episode comes out, same as live — the property the chaos
+    harness's 'the system names the fault' assertions rest on."""
+    config = config or DoctorConfig()
+    evs = trace_to_events(trace)
+    doc = Doctor(config=config, out_dir=out_dir, bus=None, live=False,
+                 request_recorder=request_recorder,
+                 train_recorder=train_recorder)
+    if not evs:
+        return []
+    doc.ingest(evs)
+    step = step_s or config.poll_interval_s
+    t0, t1 = evs[0]["ts"], evs[-1]["ts"]
+    t = t0 + step
+    while t <= t1 + step:
+        doc.evaluate(doc._signals(min(t, t1), 0))
+        t += step
+    return list(doc.incidents)
+
+
+# ---------- process-wide active doctor (for /debugz) ----------
+
+_ACTIVE: Doctor | None = None
+
+
+def set_active(doc: Doctor | None) -> None:
+    global _ACTIVE
+    _ACTIVE = doc
+
+
+def get_active() -> Doctor | None:
+    return _ACTIVE
+
+
+# ---------- chaos fault listener (cli/inject_fault.py --kind ...) ----------
+
+class FaultListener:
+    """Tails a JSONL fault-command file and trips real failure modes
+    in this process — the injection half the detectors are tested
+    against. Records ({"kind": ..., params}) are appended by
+    `inject_fault --kind hang|recompile-storm|hbm-climb|queue-collapse
+    --fault-log PATH`; the serve CLI arms the listener with
+    `--fault-listen PATH` (chaos/test builds only — injection is a
+    deliberately sharp tool).
+
+      hang             {"seconds": S}: the engine worker sleeps S at
+                       its next loop top (slots stay occupied, no
+                       ticks — a REAL hang, not a simulated one)
+      recompile_storm  {"n": N}: N steady-state recompiles of a
+                       watched jit with escalating shapes (real
+                       CompileTracker events with dimension diffs)
+      hbm_climb        {"device", "seconds", "start_frac", "end_frac",
+                       "limit"}: fabricated hbm/<device> counter climb
+                       (the ROADMAP 4 'fabricated HBM exhaustion')
+      queue_collapse   {"depth", "seconds"}: fabricated queue-depth
+                       growth with zero admits
+    """
+
+    def __init__(self, path: str, engine=None, interval_s: float = 0.25):
+        from container_engine_accelerators_tpu.healthcheck.health_checker import (  # noqa: E501
+            _TailReader,
+        )
+        self.path = path
+        self.engine = engine
+        self.interval_s = interval_s
+        self._tail = _TailReader(path)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fault-listener")
+        self._thread.start()
+        log.warning("FAULT INJECTION armed: listening on %s", self.path)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            for line in self._tail.read_lines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("malformed fault record: %r", line)
+                    continue
+                try:
+                    self._apply(rec)
+                except Exception:
+                    log.exception("fault injection %r failed", rec)
+            self._stop.wait(self.interval_s)
+
+    def _apply(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        log.warning("injecting fault: %r", rec)
+        if events.enabled():
+            events.instant("fault/injected", "chaos", {"kind": kind})
+        if kind == "hang":
+            if self.engine is None:
+                log.warning("hang fault with no engine attached")
+                return
+            self.engine.fault_hang_s = float(rec.get("seconds", 5.0))
+        elif kind == "recompile_storm":
+            self._recompile_storm(int(rec.get("n", 4)))
+        elif kind == "hbm_climb":
+            self._hbm_climb(rec)
+        elif kind == "queue_collapse":
+            self._queue_collapse(rec)
+        else:
+            log.warning("unknown fault kind %r", kind)
+
+    def _recompile_storm(self, n: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.metrics import (
+            introspection,
+        )
+        introspection.install()
+        fn = introspection.watch(jax.jit(lambda x: x * 2 + 1),
+                                 "injected_storm")
+        # n+1 distinct shapes -> n steady-state recompiles (the first
+        # compile of a fresh watch site is charged as compile #1).
+        for i in range(n + 1):
+            fn(jnp.zeros((1, 8 * (i + 1)), jnp.float32))
+
+    def _hbm_climb(self, rec: dict) -> None:
+        device = rec.get("device", "injected:0")
+        seconds = float(rec.get("seconds", 3.0))
+        limit = int(rec.get("limit", 16 * 2 ** 30))
+        start = float(rec.get("start_frac", 0.5))
+        end = float(rec.get("end_frac", 0.97))
+        samples = max(4, int(rec.get("samples", 8)))
+        for i in range(samples):
+            frac = start + (end - start) * i / (samples - 1)
+            events.counter(f"hbm/{device}",
+                           {"bytes_in_use": int(limit * frac),
+                            "bytes_limit": limit}, "hbm")
+            if self._stop.wait(seconds / samples):
+                return
+
+    def _queue_collapse(self, rec: dict) -> None:
+        depth = int(rec.get("depth", 8))
+        seconds = float(rec.get("seconds", 3.0))
+        samples = max(2, depth)
+        for i in range(samples):
+            events.counter("serve/queue_depth",
+                           {"queued": 1 + i * depth // samples}, "serve")
+            if self._stop.wait(seconds / samples):
+                return

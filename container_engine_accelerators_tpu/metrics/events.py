@@ -31,7 +31,22 @@ lock-protected ring store, single-digit µs.
 
 The ring is bounded (default 65536 events) and overwrites oldest —
 after a crash the LAST N events are exactly what a flight recorder
-should hold. Dumps are triggered on demand (`dump_now`), on SIGUSR2,
+should hold. Overwrites are COUNTED (`dropped`), surfaced on /debugz
+and as `tpu_trace_events_dropped_total` on every exporter port
+(metrics/serving.py), so a consumer diagnosing from the ring can tell
+"nothing happened" from "the evidence was overwritten" (ISSUE 8: the
+doctor flags its own blind spots instead of diagnosing from a silently
+truncated ring).
+
+Live consumers that must not miss events to wraparound subscribe a
+bounded tap (`subscribe()` -> EventTap): every enabled emit is also
+appended to each tap's own deque under the same lock, the tap counts
+its OWN overflow drops, and `drain()` hands the backlog to the
+consumer (the streaming doctor, metrics/doctor.py, is the first).
+Taps cost one list iteration + deque append per emit and only exist
+while subscribed — the no-tap hot path is unchanged.
+
+Dumps are triggered on demand (`dump_now`), on SIGUSR2,
 and from atexit / sys.excepthook when a dump path is configured
 (`enable(dump_path=...)` or the TPU_TRACE_DUMP env var; a directory
 path gets a per-pid `trace-<pid>.json`). The dump is valid Chrome
@@ -43,6 +58,7 @@ chrome://tracing; `otherData.anchor` carries the epoch anchor that
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
 import json
 import logging
@@ -94,6 +110,39 @@ class _Span:
         return False
 
 
+class EventTap:
+    """Bounded subscription onto an EventBus: every enabled emit is
+    appended here too (raw event tuples, oldest first). The deque is
+    bounded and the tap counts its own overflow, so a slow consumer
+    degrades to *known* data loss, never to unbounded memory — and the
+    consumer can report the gap instead of trusting a silent hole."""
+
+    __slots__ = ("name", "capacity", "_dq", "received", "dropped")
+
+    def __init__(self, name: str = "tap", capacity: int = 16384):
+        self.name = name
+        self.capacity = capacity
+        self._dq: collections.deque = collections.deque(maxlen=capacity)
+        self.received = 0
+        self.dropped = 0
+
+    def _push(self, ev) -> None:
+        # Called under the bus lock.
+        if len(self._dq) == self.capacity:
+            self.dropped += 1
+        self._dq.append(ev)
+        self.received += 1
+
+    def drain(self) -> list:
+        """All queued event tuples, oldest first; clears the backlog."""
+        out = []
+        while True:
+            try:
+                out.append(self._dq.popleft())
+            except IndexError:
+                return out
+
+
 class EventBus:
     """Bounded ring of trace events; see the module docstring for the
     event taxonomy and cost discipline."""
@@ -108,6 +157,7 @@ class EventBus:
         self._n = 0  # total emitted; ring slot = _n % capacity
         self._lock = threading.Lock()
         self._threads: dict[int, str] = {}
+        self._taps: list[EventTap] = []
         self.anchor = _now_anchor(self.process_name)
 
     # ---------- emission (hot path) ----------
@@ -119,11 +169,13 @@ class EventBus:
             ts = time.monotonic()
         tid = threading.get_ident()
         with self._lock:
-            self._buf[self._n % self.capacity] = (
-                ph, ts, tid, name, cat, dur, eid, args)
+            ev = (ph, ts, tid, name, cat, dur, eid, args)
+            self._buf[self._n % self.capacity] = ev
             self._n += 1
             if tid not in self._threads:
                 self._threads[tid] = threading.current_thread().name
+            for tap in self._taps:
+                tap._push(ev)
 
     def begin(self, name, cat="", args=None):
         self._emit("B", name, cat, args)
@@ -158,6 +210,24 @@ class EventBus:
 
     def async_end(self, name, eid, cat="", args=None, ts=None):
         self._emit("e", name, cat, args, ts=ts, eid=eid)
+
+    # ---------- subscriptions ----------
+
+    def subscribe(self, name: str = "tap",
+                  capacity: int = 16384) -> EventTap:
+        """Attach a bounded tap fed by every subsequent enabled emit;
+        the caller owns draining it (and unsubscribing when done)."""
+        tap = EventTap(name, capacity)
+        with self._lock:
+            self._taps.append(tap)
+        return tap
+
+    def unsubscribe(self, tap: EventTap) -> None:
+        with self._lock:
+            try:
+                self._taps.remove(tap)
+            except ValueError:
+                log.debug("unsubscribe of unknown tap %r", tap.name)
 
     # ---------- inspection / export ----------
 
@@ -240,9 +310,13 @@ class EventBus:
     def debugz(self, limit: int = 256) -> dict:
         """Last-N-events JSON payload for the /debugz endpoint."""
         evs = [self._event_dict(ev) for ev in self.snapshot()[-limit:]]
+        with self._lock:
+            taps = [{"name": t.name, "capacity": t.capacity,
+                     "received": t.received, "dropped": t.dropped}
+                    for t in self._taps]
         return {"enabled": self.enabled, "capacity": self.capacity,
                 "emitted": self._n, "dropped": self.dropped,
-                "anchor": dict(self.anchor), "events": evs}
+                "taps": taps, "anchor": dict(self.anchor), "events": evs}
 
 
 # ---------- process-wide bus + module-level fast-path helpers ----------
@@ -414,6 +488,8 @@ def _reset_for_tests() -> None:
     global _DUMP_PATH
     _BUS.enabled = False
     _BUS.clear()
+    with _BUS._lock:
+        _BUS._taps.clear()
     _DUMP_PATH = None
 
 

@@ -630,6 +630,14 @@ def set_expected_hbm(plan: dict | None) -> None:
                  "fits" if plan.get("fits") else "DOES NOT FIT")
 
 
+def expected_hbm() -> dict | None:
+    """The hbm_plan budget recorded via set_expected_hbm, read-only —
+    the doctor's oom_precursor verdicts attach it so 'the plan said it
+    fits' is checkable while the process is still alive, not just in
+    the post-mortem bundle."""
+    return _EXPECTED_HBM
+
+
 def is_resource_exhausted(exc: BaseException) -> bool:
     """RESOURCE_EXHAUSTED in any of its spellings: the XLA status code
     in the message (XlaRuntimeError carries it), an exception class

@@ -86,6 +86,12 @@ class RequestRecorder:
         self._queued = 0
         self.samples = {k: collections.deque(maxlen=max_samples)
                         for k in SAMPLE_KINDS}
+        # Timestamped twin of `samples` ((monotonic ts, value)), so
+        # windowed consumers — the doctor's multi-window SLO burn
+        # engine (metrics/doctor.py) — can count threshold violations
+        # over "the last N seconds" instead of "the last N samples".
+        self.timed = {k: collections.deque(maxlen=max_samples)
+                      for k in SAMPLE_KINDS}
 
         reg = self.registry
         self.ttft = Histogram(
@@ -149,10 +155,13 @@ class RequestRecorder:
 
     # ---------- lifecycle edges ----------
 
-    def _observe(self, kind: str, value: float) -> None:
+    def _observe(self, kind: str, value: float,
+                 now: float | None = None) -> None:
         value = max(value, 0.0)
         getattr(self, kind).observe(value)  # histogram attrs match kinds
         self.samples[kind].append(value)
+        self.timed[kind].append(
+            (time.monotonic() if now is None else now, value))
 
     def enqueue(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -181,7 +190,7 @@ class RequestRecorder:
                 self.queue_depth.set(self._queued)
             st["stage"] = "active"
             st["admit_ts"] = now
-            self._observe("queue_wait", now - st["enqueue_ts"])
+            self._observe("queue_wait", now - st["enqueue_ts"], now)
             if events.enabled():
                 events.async_instant("admit", rid, "serve")
                 events.counter("serve/queue_depth",
@@ -193,9 +202,9 @@ class RequestRecorder:
             st = self._state.get(rid)
             if st is None:
                 return
-            self._observe("ttft", now - st["enqueue_ts"])
+            self._observe("ttft", now - st["enqueue_ts"], now)
             if "admit_ts" in st:
-                self._observe("prefill", now - st["admit_ts"])
+                self._observe("prefill", now - st["admit_ts"], now)
             st["last_tok_ts"] = now
             if events.enabled():
                 events.async_instant("first_token", rid, "serve")
@@ -206,7 +215,7 @@ class RequestRecorder:
             st = self._state.get(rid)
             if st is None or "last_tok_ts" not in st:
                 return
-            self._observe("tpot", now - st["last_tok_ts"])
+            self._observe("tpot", now - st["last_tok_ts"], now)
             st["last_tok_ts"] = now
 
     def observe_tpot(self, seconds: float) -> None:
@@ -288,6 +297,18 @@ class RequestRecorder:
         """Same, in rounded milliseconds (None entries dropped)."""
         return {k: round(v * 1e3, 3)
                 for k, v in self.pct(kind, ps).items() if v is not None}
+
+    def window_counts(self, kind: str, since: float,
+                      threshold: float | None = None
+                      ) -> tuple[int, int]:
+        """(observations, observations over `threshold`) among samples
+        with monotonic ts >= `since` — the windowed error-rate input
+        the doctor's SLO burn engine consumes (metrics/doctor.py)."""
+        with self._lock:
+            pts = [v for ts, v in self.timed[kind] if ts >= since]
+        if threshold is None:
+            return len(pts), 0
+        return len(pts), sum(1 for v in pts if v > threshold)
 
 
 class ServeMetricsExporter(ExporterBase):

@@ -22,6 +22,34 @@ DEBUGZ_DEFAULT_LIMIT = 256
 DEBUGZ_DEFAULT_CENSUS = 32
 
 
+def attach_ring_gauges(registry) -> None:
+    """Expose the process-wide EventBus ring accounting on a scrape
+    registry: `tpu_trace_events_emitted_total` and
+    `tpu_trace_events_dropped_total` (ring overwrites — the flight
+    recorder's blind spot counter, ISSUE 8 satellite). Values are read
+    live at scrape time via set_function, so no poll loop is involved.
+    Idempotent per registry: a second attach (shared/co-served
+    registries) is a no-op."""
+    from prometheus_client import Gauge
+
+    from container_engine_accelerators_tpu.metrics import events
+
+    try:
+        emitted = Gauge(
+            "tpu_trace_events_emitted_total",
+            "Events emitted onto the flight-recorder ring since start",
+            registry=registry)
+        dropped = Gauge(
+            "tpu_trace_events_dropped_total",
+            "Ring events overwritten before any dump/tap could read "
+            "them — nonzero means the flight recorder has blind spots",
+            registry=registry)
+    except ValueError:
+        return  # this registry already carries the ring gauges
+    emitted.set_function(lambda: float(events.get_bus().emitted))
+    dropped.set_function(lambda: float(events.get_bus().dropped))
+
+
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
     def log_message(self, *args):
         pass
@@ -52,7 +80,10 @@ class ExporterBase:
         the live-array census (top-N `jax.live_arrays()` by nbytes;
         `census=<k>` with k>1 sets N), per-device memory stats, and the
         compile-cache summary (metrics/introspection.py) — the "what
-        is resident right now" view, no debugger required."""
+        is resident right now" view, no debugger required. `?doctor=1`
+        embeds the streaming doctor's live verdicts (active incidents,
+        recent incident history, SLO burn rates — metrics/doctor.py)
+        when a doctor runs in this process."""
         prom = make_wsgi_app(self.registry)
 
         def app(environ, start_response):
@@ -85,6 +116,13 @@ class ExporterBase:
                             introspection.get_tracker().summary()
                     except Exception:
                         log.exception("/debugz census failed")
+                if qs.get("doctor", ["0"])[0] not in ("", "0"):
+                    from container_engine_accelerators_tpu.metrics import (  # noqa: E501
+                        doctor,
+                    )
+                    d = doctor.get_active()
+                    payload["doctor"] = (d.debugz() if d is not None
+                                         else {"active": False})
                 body = json.dumps(payload).encode()
                 start_response("200 OK", [
                     ("Content-Type", "application/json"),
@@ -95,6 +133,12 @@ class ExporterBase:
         return app
 
     def start_background(self) -> None:
+        # Every exporter port carries the flight-recorder ring
+        # accounting; shared registries attach once (no-op repeat).
+        try:
+            attach_ring_gauges(self.registry)
+        except Exception:
+            log.exception("ring gauge attach failed")
         app = self._make_app()
         self._httpd = wsgiref.simple_server.make_server(
             self.host, self.port, app, handler_class=_QuietHandler)

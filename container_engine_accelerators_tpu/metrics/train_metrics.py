@@ -145,6 +145,7 @@ class TrainRecorder:
         self._tokens = 0
         self._tokens_productive = 0  # excludes first-step (compile) tokens
         self._last_step = 0
+        self._last_step_ts: float | None = None  # monotonic, step edges
         # Steady-state recompile seconds reported by the compile
         # tracker (metrics/introspection.py) but not yet deducted from
         # a step's productive charge — the recompile happens INSIDE
@@ -306,6 +307,7 @@ class TrainRecorder:
             if not first:
                 self._tokens_productive += tokens
             self._last_step = step
+            self._last_step_ts = now
             self.steps_total.inc()
             self.tokens_total.inc(tokens)
             self.last_step_g.set(step)
@@ -356,6 +358,7 @@ class TrainRecorder:
             self._tokens += tokens
             self._tokens_productive += tokens
             self._last_step += n
+            self._last_step_ts = now
             self.steps_total.inc(n)
             self.tokens_total.inc(tokens)
             self.last_step_g.set(self._last_step)
@@ -510,6 +513,17 @@ class TrainRecorder:
         now = time.monotonic() if now is None else now
         with self._lock:
             return self._goodput_locked(now)
+
+    def last_step_age(self, now: float | None = None) -> float | None:
+        """Seconds since the last completed step edge (None before the
+        first) — the liveness scalar the doctor attaches to train-side
+        verdicts; the heartbeat files carry the same signal across
+        processes."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._last_step_ts is None:
+                return None
+            return max(0.0, now - self._last_step_ts)
 
     # ---------- offline summaries ----------
 

@@ -1,0 +1,651 @@
+"""tpu-doctor (ISSUE 8): per-detector verdicts on synthetic event
+streams (fires on bad, quiet on good), episode dedup, the SLO burn
+engine and its exporter gauges, blind-spot flagging from ring drops,
+the EventBus subscription tap, offline replay (`trace doctor`) — and
+the live e2e: cli/inject_fault.py fault commands tripping real
+hang / recompile-storm / hbm-climb / queue-collapse failure modes in a
+running engine, one correctly-classed incident bundle each, with the
+replay over the same run's dump reproducing identical verdicts."""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.cli import inject_fault
+from container_engine_accelerators_tpu.cli import loadgen
+from container_engine_accelerators_tpu.cli import trace as trace_cli
+from container_engine_accelerators_tpu.cli.serve import (
+    ContinuousEngine,
+    make_server,
+)
+from container_engine_accelerators_tpu.metrics import (
+    doctor,
+    events,
+    introspection,
+)
+from container_engine_accelerators_tpu.metrics.doctor import (
+    Doctor,
+    DoctorConfig,
+    FaultListener,
+    Signals,
+    SloSpec,
+)
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+)
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Every test starts/ends with a disabled, empty bus, no active
+    doctor, and the compile tracker off."""
+    def reset():
+        events._reset_for_tests()
+        introspection._reset_for_tests()
+        doctor.set_active(None)
+    reset()
+    yield
+    reset()
+
+
+# ---------- synthetic event helpers ----------
+
+def C(name, ts, **vals):
+    return {"name": name, "cat": "", "ph": "C", "ts": ts,
+            "args": vals, "id": None}
+
+
+def I(name, ts, **args):
+    return {"name": name, "cat": "", "ph": "i", "ts": ts,
+            "args": args, "id": None}
+
+
+def N(name, ts, eid, **args):
+    return {"name": name, "cat": "", "ph": "n", "ts": ts,
+            "args": args, "id": eid}
+
+
+def B(name, ts, eid, **args):
+    return {"name": name, "cat": "", "ph": "b", "ts": ts,
+            "args": args, "id": eid}
+
+
+def small_cfg(**kw):
+    defaults = dict(
+        poll_interval_s=1.0, fast_window_s=10.0, slow_window_s=50.0,
+        hang_after_s=5.0, recompile_storm_n=3, hbm_min_samples=3,
+        queue_min_depth=3, health_storm_n=3, straggler_skew_s=5.0,
+        clear_after_s=5.0,
+        slos=[SloSpec("ttft_p99", "ttft", threshold_s=0.5,
+                      objective=0.9, min_samples=4,
+                      fast_burn=2.0, slow_burn=1.0)])
+    defaults.update(kw)
+    return DoctorConfig(**defaults)
+
+
+def sig(evs, now, cfg=None, **kw):
+    return Signals(now, sorted(evs, key=lambda e: e["ts"]),
+                   cfg or small_cfg(), live=False, **kw)
+
+
+def classes(findings):
+    return [f.cls for f in findings]
+
+
+def run_all(s):
+    out = []
+    for det in doctor.default_detectors():
+        out.extend(det.check(s))
+    return out
+
+
+# ---------- per-detector verdicts ----------
+
+def test_engine_hang_fires_on_occupied_silence():
+    evs = [C("serve/slots", 1.0, active=2, total=8),
+           C("serve/decode_step_ms", 1.5, ms=1.0)]
+    found = doctor.EngineHangDetector().check(sig(evs, now=10.0))
+    assert classes(found) == ["engine_hang"]
+    ev = found[0].evidence
+    assert ev["stalled_s"] == pytest.approx(8.5)
+    assert ev["events"], "evidence must point at ring events"
+
+
+def test_engine_hang_quiet_with_progress_or_idle():
+    det = doctor.EngineHangDetector()
+    busy = [C("serve/slots", t, active=2, total=8)
+            for t in (1.0, 5.0, 9.0)] + \
+           [C("serve/decode_step_ms", t, ms=1.0)
+            for t in (1.0, 5.0, 9.5)]
+    assert det.check(sig(busy, now=10.0)) == []
+    idle = [C("serve/slots", 1.0, active=2, total=8),
+            C("serve/slots", 2.0, active=0, total=8)]
+    assert det.check(sig(idle, now=60.0)) == []
+
+
+def test_recompile_storm_threshold_and_evidence():
+    det = doctor.RecompileStormDetector()
+    mk = lambda n: [I("xla/recompile", 5.0 + i * 0.1, fn="step",
+                      diff=f"dim 1: {i} -> {i+1}") for i in range(n)]
+    assert det.check(sig(mk(2), now=10.0)) == []
+    found = det.check(sig(mk(4), now=10.0))
+    assert classes(found) == ["recompile_storm"]
+    assert found[0].subject == "step"
+    assert "dim 1: 3 -> 4" in found[0].evidence["last_diff"]
+
+
+def test_oom_precursor_climb_and_watermark():
+    det = doctor.OomPrecursorDetector()
+    lim = 1000
+    climb = [C("hbm/tpu:0", t, bytes_in_use=100 + 40 * int(t),
+               bytes_limit=lim) for t in (1.0, 2.0, 3.0, 4.0)]
+    found = det.check(sig(climb, now=5.0))
+    assert classes(found) == ["oom_precursor"]
+    ev = found[0].evidence
+    assert ev["tte_s"] == pytest.approx((lim - 260) / 40.0, rel=0.01)
+    assert found[0].subject == "tpu:0"
+    flat = [C("hbm/tpu:0", t, bytes_in_use=300, bytes_limit=lim)
+            for t in (1.0, 2.0, 3.0, 4.0)]
+    assert det.check(sig(flat, now=5.0)) == []
+    # At the watermark even a flat line is an incident.
+    high = [C("hbm/tpu:0", t, bytes_in_use=960, bytes_limit=lim)
+            for t in (1.0, 2.0, 3.0, 4.0)]
+    assert classes(det.check(sig(high, now=5.0))) == ["oom_precursor"]
+
+
+def test_queue_collapse_growth_with_zero_admits():
+    det = doctor.QueueCollapseDetector()
+    growth = [C("serve/queue_depth", 1.0 + i, queued=1 + i)
+              for i in range(6)]
+    found = det.check(sig(growth, now=8.0))
+    assert classes(found) == ["queue_collapse"]
+    with_admits = growth + [N("admit", 5.5, "7")]
+    assert det.check(sig(with_admits, now=8.0)) == []
+    shallow = [C("serve/queue_depth", 1.0, queued=1),
+               C("serve/queue_depth", 2.0, queued=2)]
+    assert det.check(sig(shallow, now=8.0)) == []
+
+
+def test_straggler_from_watchdog_instant_and_heartbeat_skew(tmp_path):
+    det = doctor.StragglerDetector()
+    stall = [I("train/stalled", 5.0, process=3, age_s=42.0)]
+    found = det.check(sig(stall, now=8.0))
+    assert classes(found) == ["straggler"]
+    assert found[0].subject == "process-3"
+    # Live path: hb files with skewed mtimes.
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    now = time.time()
+    for pid, age in ((0, 1.0), (1, 30.0)):
+        p = hb / f"hb-{pid}"
+        p.write_text(f"{pid} 7\n")
+        os.utime(p, (now - age, now - age))
+    s = Signals(10.0, [], small_cfg(), heartbeat_dir=str(hb), live=True)
+    found = det.check(s)
+    assert classes(found) == ["straggler"]
+    assert found[0].subject == "process-1"
+    assert found[0].evidence["skew_s"] == pytest.approx(29.0, abs=2.0)
+
+
+def test_health_storm_counts_and_summary_source():
+    det = doctor.HealthStormDetector()
+    errs = [I(f"health/ICI_LINK_DOWN", 2.0 + i, chip=0, critical=True)
+            for i in range(4)]
+    found = det.check(sig(errs, now=8.0))
+    assert classes(found) == ["health_storm"]
+    assert found[0].subject == "ICI_LINK_DOWN"
+    assert found[0].evidence["critical"] is True
+    assert det.check(sig(errs[:2], now=8.0)) == []
+
+
+def test_slo_burn_from_event_derived_ttfts():
+    cfg = small_cfg()
+    spec = cfg.slos[0]
+    slow = []
+    for i in range(6):
+        rid = str(i)
+        slow.append(B("request", 1.0 + i, rid))
+        slow.append(N("first_token", 2.0 + i, rid))  # ttft = 1.0 > 0.5
+    s = sig(slow, now=8.0, cfg=cfg)
+    burn, n = doctor.slo_burn(s, spec, cfg.fast_window_s)
+    assert n == 6
+    assert burn == pytest.approx(1.0 / 0.1)  # all bad / 10% budget
+    found = doctor.SloBurnDetector().check(s)
+    assert classes(found) == ["slo_burn"]
+    fast = [B("request", 1.0 + i, str(i)) for i in range(6)] + \
+           [N("first_token", 1.01 + i, str(i)) for i in range(6)]
+    assert doctor.SloBurnDetector().check(sig(fast, now=8.0, cfg=cfg)) \
+        == []
+
+
+def test_slo_burn_goodput_from_counter_track():
+    cfg = small_cfg(slos=[SloSpec("goodput", "goodput", objective=0.5,
+                                  fast_burn=1.5, slow_burn=1.5)])
+    bad = [C("train/goodput_fraction", 5.0, fraction=0.1)]
+    s = sig(bad, now=8.0, cfg=cfg)
+    burn, n = doctor.slo_burn(s, cfg.slos[0], cfg.fast_window_s)
+    assert n == 1 and burn == pytest.approx(0.9 / 0.5)
+    assert classes(doctor.SloBurnDetector().check(s)) == ["slo_burn"]
+    good = [C("train/goodput_fraction", 5.0, fraction=0.9)]
+    assert doctor.SloBurnDetector().check(sig(good, now=8.0, cfg=cfg)) \
+        == []
+
+
+def test_slo_burn_prefers_recorder_windows():
+    rec = RequestRecorder()
+    t0 = 100.0
+    for i in range(10):
+        rid = f"r{i}"
+        rec.enqueue(rid, now=t0 + i)
+        rec.admit(rid, now=t0 + i + 0.1)
+        rec.first_token(rid, now=t0 + i + 0.9)  # ttft 0.9 > 0.5
+        rec.finish(rid)
+    n, bad = rec.window_counts("ttft", since=t0, threshold=0.5)
+    assert (n, bad) == (10, 10)
+    n, bad = rec.window_counts("ttft", since=t0 + 20, threshold=0.5)
+    assert (n, bad) == (0, 0)
+    cfg = small_cfg()
+    s = Signals(t0 + 11, [], cfg, request_recorder=rec, live=True)
+    burn, n = doctor.slo_burn(s, cfg.slos[0], cfg.fast_window_s)
+    # window [now-10, now] covers 9 of the 10 observations
+    assert n == 9 and burn == pytest.approx(10.0)
+
+
+# ---------- doctor engine: dedup, episodes, bundles, blind spots ----------
+
+def test_dedup_one_incident_per_episode_and_rearm(tmp_path):
+    cfg = small_cfg()
+    doc = Doctor(config=cfg, out_dir=str(tmp_path), bus=None, live=False)
+    evs = [I("xla/recompile", 100.0 + i * 0.1, fn="step", diff="d")
+           for i in range(4)]
+    doc.ingest(evs)
+    first = doc.evaluate(doc._signals(101.0, 0))
+    assert [i["class"] for i in first] == ["recompile_storm"]
+    # Same condition still firing -> same episode, no second bundle.
+    assert doc.evaluate(doc._signals(102.0, 0)) == []
+    # Condition gone + clear window -> re-armed; a NEW storm is a new
+    # episode.
+    assert doc.evaluate(doc._signals(130.0, 0)) == []
+    doc.ingest([I("xla/recompile", 140.0 + i * 0.1, fn="step", diff="d")
+                for i in range(4)])
+    second = doc.evaluate(doc._signals(141.0, 0))
+    assert [i["class"] for i in second] == ["recompile_storm"]
+    assert len(list(tmp_path.glob("incident-recompile_storm-*.json"))) \
+        == 2
+
+
+def test_incident_bundle_schema_and_atomicity(tmp_path):
+    cfg = small_cfg()
+    doc = Doctor(config=cfg, out_dir=str(tmp_path), bus=None, live=False)
+    doc.ingest([C("serve/slots", 100.0, active=1, total=2)])
+    incs = doc.evaluate(doc._signals(110.0, 0))
+    assert len(incs) == 1
+    path = incs[0]["bundle_path"]
+    b = json.loads(open(path).read())
+    assert b["kind"] == "tpu_doctor_incident"
+    assert b["class"] == "engine_hang"
+    assert b["subject"] == "serve"
+    assert 0 < b["confidence"] <= 1
+    assert b["evidence"]["events"][0]["name"] == "serve/slots"
+    assert not list(tmp_path.glob("*.tmp.*")), "torn tmp file left"
+
+
+def test_ring_drops_flag_blind_spot(tmp_path):
+    doc = Doctor(config=small_cfg(), out_dir=str(tmp_path), bus=None,
+                 live=False)
+    doc.ingest([C("serve/slots", 100.0, active=1, total=2)])
+    incs = doc.evaluate(doc._signals(110.0, 42))
+    assert incs[0]["evidence"]["ring_dropped_in_window"] == 42
+    assert incs[0]["confidence"] == pytest.approx(0.9 * 0.8)
+
+
+def test_doctor_metrics_families_materialized():
+    from prometheus_client import generate_latest
+    doc = Doctor(config=small_cfg(), out_dir=None, bus=None, live=False)
+    text = generate_latest(doc.registry).decode()
+    for cls in ("engine_hang", "recompile_storm", "oom_precursor",
+                "queue_collapse", "straggler", "health_storm",
+                "slo_burn"):
+        assert f'tpu_doctor_incidents_total{{class="{cls}"}} 0.0' in text
+    doc.evaluate(doc._signals(100.0, 0))
+    text = generate_latest(doc.registry).decode()
+    assert 'tpu_slo_burn_rate{slo="ttft_p99",window="fast"}' in text
+
+
+# ---------- EventBus tap (satellite: blind-spot accounting) ----------
+
+def test_tap_receives_drains_and_counts_drops():
+    bus = events.enable(process_name="tap-test")
+    tap = bus.subscribe("t", capacity=8)
+    for i in range(5):
+        events.instant("x", "t", {"i": i})
+    got = tap.drain()
+    assert len(got) == 5 and tap.dropped == 0
+    for i in range(20):
+        events.instant("y", "t")
+    assert tap.dropped == 12  # 20 into capacity 8
+    assert len(tap.drain()) == 8
+    info = bus.debugz(limit=1)["taps"]
+    assert info[0]["name"] == "t" and info[0]["dropped"] == 12
+    bus.unsubscribe(tap)
+    events.instant("z", "t")
+    assert tap.drain() == []
+
+
+def test_ring_gauges_on_every_exporter_port():
+    events.enable(process_name="gauge-test")
+    for i in range(3):
+        events.instant("warm", "t")
+    rec = RequestRecorder()
+    exp = ServeMetricsExporter(rec, port=0, host="127.0.0.1")
+    exp.start_background()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.bound_port}/metrics",
+            timeout=10).read().decode()
+        assert "tpu_trace_events_emitted_total" in body
+        assert "tpu_trace_events_dropped_total 0.0" in body
+    finally:
+        exp.stop()
+
+
+def test_debugz_doctor_param_serves_live_verdicts():
+    events.enable(process_name="debugz-doctor")
+    rec = RequestRecorder()
+    doc = Doctor(config=small_cfg(), registry=rec.registry,
+                 request_recorder=rec, out_dir=None)
+    doctor.set_active(doc)
+    exp = ServeMetricsExporter(rec, port=0, host="127.0.0.1")
+    exp.start_background()
+    try:
+        url = f"http://127.0.0.1:{exp.bound_port}/debugz"
+        plain = json.loads(urllib.request.urlopen(
+            url, timeout=10).read())
+        assert "doctor" not in plain
+        with_doc = json.loads(urllib.request.urlopen(
+            url + "?doctor=1", timeout=10).read())
+        assert with_doc["doctor"]["active"] is True
+        assert "engine_hang" in with_doc["doctor"]["detectors"]
+    finally:
+        exp.stop()
+        doc.stop()
+
+
+# ---------- offline replay + trace doctor CLI ----------
+
+def _hang_trace():
+    """Chrome-trace dict with one mid-timeline hang episode."""
+    evs = [{"name": "serve/slots", "cat": "serve", "ph": "C",
+            "ts": 1e6, "pid": 1, "tid": 1,
+            "args": {"active": 2, "total": 8}},
+           {"name": "serve/decode_step_ms", "cat": "serve", "ph": "C",
+            "ts": 1.5e6, "pid": 1, "tid": 1, "args": {"ms": 1.0}},
+           # 20 s of silence (the hang), then recovery + drain
+           {"name": "serve/decode_step_ms", "cat": "serve", "ph": "C",
+            "ts": 21e6, "pid": 1, "tid": 1, "args": {"ms": 1.0}},
+           {"name": "serve/slots", "cat": "serve", "ph": "C",
+            "ts": 22e6, "pid": 1, "tid": 1,
+            "args": {"active": 0, "total": 8}},
+           {"name": "end", "cat": "t", "ph": "i", "s": "t",
+            "ts": 40e6, "pid": 1, "tid": 1}]
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def test_replay_names_the_fault_exactly_once():
+    incs = doctor.replay(_hang_trace(), config=small_cfg(), step_s=1.0)
+    assert [i["class"] for i in incs] == ["engine_hang"]
+
+
+def test_replay_clean_trace_is_quiet():
+    evs = [{"name": "serve/slots", "cat": "serve", "ph": "C",
+            "ts": float(t) * 1e6, "pid": 1, "tid": 1,
+            "args": {"active": 1, "total": 8}} for t in range(1, 30)]
+    evs += [{"name": "serve/decode_step_ms", "cat": "serve", "ph": "C",
+             "ts": (float(t) + 0.5) * 1e6, "pid": 1, "tid": 1,
+             "args": {"ms": 1.0}} for t in range(1, 30)]
+    assert doctor.replay({"traceEvents": evs}, config=small_cfg(),
+                         step_s=1.0) == []
+
+
+def test_trace_doctor_cli(tmp_path, capsys):
+    path = tmp_path / "merged.json"
+    path.write_text(json.dumps(_hang_trace()))
+    rc = trace_cli.main(["doctor", str(path), "--window", "10",
+                         "--interval", "1", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    incs = [json.loads(line) for line in out]
+    assert [i["class"] for i in incs] == ["engine_hang"]
+    rc = trace_cli.main(["doctor", str(path), "--window", "10",
+                         "--interval", "1", "--fail-on-incident"])
+    assert rc == 1
+
+
+def test_inject_fault_kinds_write_commands(tmp_path, capsys):
+    flog = tmp_path / "faults.jsonl"
+    rc = inject_fault.main(["--kind", "hang", "--seconds", "2.5",
+                            "--fault-log", str(flog)])
+    assert rc == 0
+    rc = inject_fault.main(["--kind", "queue-collapse", "--depth", "9",
+                            "--fault-log", str(flog)])
+    assert rc == 0
+    recs = [json.loads(line) for line in flog.read_text().splitlines()]
+    assert recs[0] == {"kind": "hang", "seconds": 2.5}
+    assert recs[1]["kind"] == "queue_collapse" and recs[1]["depth"] == 9
+    with pytest.raises(SystemExit):
+        inject_fault.main(["--kind", "hang"])  # fault-log required
+    # health kind keeps the legacy contract
+    elog = tmp_path / "errors.jsonl"
+    rc = inject_fault.main(["--error-log", str(elog), "--chip", "1"])
+    assert rc == 0
+    rec = json.loads(elog.read_text())
+    assert rec["chip"] == 1 and rec["class"] == "HBM_ECC_UNCORRECTABLE"
+
+
+# ---------- live e2e: injected faults -> classed incident bundles ----------
+
+@pytest.fixture(scope="module")
+def model():
+    # Same tiny config as the other serve suites so the process-wide
+    # jit caches stay hot across test modules.
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def _submit_stream(engine, prompt_len=8, max_new=1000):
+    stream: queue.Queue = queue.Queue()
+    fut = engine.submit(list(range(1, prompt_len + 1)), max_new, 0.0,
+                        stream=stream)
+    # Wait for the first token so slots are provably occupied.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ev = stream.get(timeout=60)
+        if "token" in ev or "error" in ev:
+            return fut, stream, ev
+    raise AssertionError("no first token")
+
+
+def _wait_for(pred, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_e2e_injected_faults_one_classed_bundle_each(model, tmp_path):
+    """Acceptance: four injected fault classes -> exactly one
+    correctly-classed incident bundle each, with valid evidence
+    pointers into the event ring; the replay over the same run's dump
+    reproduces identical verdicts; zero incidents during the clean
+    phase."""
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=1024,
+                              prefill_chunk=0)
+    rec = engine.recorder
+    try:
+        # Warm every jit BEFORE arming the bus: compile stalls are not
+        # part of the scenario under test (production uses 30 s hang
+        # thresholds; this test runs at 1.5 s).
+        fut = engine.submit(list(range(1, 9)), 4, 0.0)
+        fut.result(timeout=120)
+
+        dump_path = str(tmp_path / "trace.json")
+        events.enable(dump_path=dump_path, process_name="doctor-e2e")
+        dcfg = small_cfg(
+            poll_interval_s=0.2, fast_window_s=8.0, slow_window_s=40.0,
+            hang_after_s=1.5, clear_after_s=5.0,
+            slos=[SloSpec("ttft_p99", "ttft", threshold_s=30.0,
+                          objective=0.9, min_samples=5)])
+        doc = Doctor(config=dcfg, registry=rec.registry,
+                     request_recorder=rec,
+                     out_dir=str(tmp_path / "incidents"))
+        doc.start()
+        flog = str(tmp_path / "faults.jsonl")
+        listener = FaultListener(flog, engine=engine, interval_s=0.05)
+        listener.start()
+
+        def incident_classes():
+            return [i["class"] for i in doc.incidents]
+
+        # Clean phase: real traffic, no verdicts.
+        fut = engine.submit(list(range(1, 9)), 8, 0.0)
+        fut.result(timeout=120)
+        time.sleep(1.0)
+        assert incident_classes() == []
+
+        # Fault 1: engine hang, injected via the inject_fault CLI.
+        fut, stream, _ = _submit_stream(engine, max_new=1000)
+        assert inject_fault.main(["--kind", "hang", "--seconds", "5",
+                                  "--fault-log", flog]) == 0
+        assert _wait_for(lambda: "engine_hang" in incident_classes(),
+                         timeout=25), incident_classes()
+        fut.result(timeout=120)  # hang ends, request drains
+
+        # Fault 2: recompile storm (real watched-jit recompiles).
+        assert inject_fault.main(["--kind", "recompile-storm",
+                                  "--count", "4",
+                                  "--fault-log", flog]) == 0
+        assert _wait_for(
+            lambda: "recompile_storm" in incident_classes(),
+            timeout=25), incident_classes()
+
+        # Fault 3: fabricated HBM watermark climb.
+        assert inject_fault.main(["--kind", "hbm-climb",
+                                  "--seconds", "1.5",
+                                  "--fault-log", flog]) == 0
+        assert _wait_for(
+            lambda: "oom_precursor" in incident_classes(),
+            timeout=25), incident_classes()
+
+        # Fault 4: fabricated queue collapse (growth, zero admits).
+        assert inject_fault.main(["--kind", "queue-collapse",
+                                  "--depth", "8", "--seconds", "1.5",
+                                  "--fault-log", flog]) == 0
+        assert _wait_for(
+            lambda: "queue_collapse" in incident_classes(),
+            timeout=25), incident_classes()
+
+        listener.stop()
+        doc.poll_once()
+        # Exactly one bundle per fault class, none unexplained.
+        assert sorted(incident_classes()) == [
+            "engine_hang", "oom_precursor", "queue_collapse",
+            "recompile_storm"], incident_classes()
+        ring_names = {ev[3] for ev in events.get_bus().snapshot()
+                      if ev is not None}
+        for inc in doc.incidents:
+            path = inc["bundle_path"]
+            b = json.loads(open(path).read())
+            assert b["class"] == inc["class"]
+            for e in b["evidence"]["events"]:
+                assert e["name"] in ring_names, (inc["class"], e)
+        # Burn-rate + incident count families scrape on the port the
+        # recorder registry backs.
+        from prometheus_client import generate_latest
+        text = generate_latest(rec.registry).decode()
+        assert 'tpu_doctor_incidents_total{class="engine_hang"} 1.0' \
+            in text
+        assert 'tpu_slo_burn_rate{slo="ttft_p99",window="fast"}' in text
+        doc.stop()
+
+        # Offline replay over the same run's dump: identical verdicts
+        # (one per class), the chaos-harness assertion target.
+        events.dump_now()
+        trace = json.loads(open(dump_path).read())
+        replayed = doctor.replay(trace, config=dcfg, step_s=0.5)
+        assert sorted(i["class"] for i in replayed) == [
+            "engine_hang", "oom_precursor", "queue_collapse",
+            "recompile_storm"], [i["class"] for i in replayed]
+    finally:
+        engine.stop()
+
+
+def test_train_doctor_clean_run_quiet(tmp_path):
+    """`train --doctor` over a short clean fit: zero incidents, doctor
+    summary field present."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "container_engine_accelerators_tpu.cli.train",
+         "--steps", "6", "--batch-size", "8", "--seq-len", "16",
+         "--doctor", "--doctor-dir", str(tmp_path / "inc")],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["doctor_incidents"] == 0
+    assert not list((tmp_path / "inc").glob("incident-*.json"))
+
+
+# ---------- loadgen as the SLO driver (satellite) ----------
+
+def test_loadgen_slo_gate_pass_and_fail(model, capsys):
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=512,
+                              prefill_chunk=0)
+    server = make_server(engine, 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        base = ["--url", url, "--requests", "3", "--concurrency", "2",
+                "--max-new-tokens", "8", "--prompt-len", "4",
+                "--stream"]
+        rc = loadgen.main(base + ["--slo-ttft-p99-ms", "120000",
+                                  "--slo-tpot-p99-ms", "120000"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "SLO PASS" in out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["slo"]["ttft_p99_ms"]["ok"] is True
+        assert summary["slo"]["tpot_p99_ms"]["ok"] is True
+
+        rc = loadgen.main(base + ["--slo-ttft-p99-ms", "0.000001"])
+        out = capsys.readouterr().out
+        assert rc == 3
+        assert "SLO FAIL" in out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["slo"]["ttft_p99_ms"]["ok"] is False
+    finally:
+        server.shutdown()
+        server.server_close()
+        engine.stop()
+
+
+def test_loadgen_slo_requires_stream():
+    with pytest.raises(SystemExit):
+        loadgen.main(["--slo-ttft-p99-ms", "100", "--requests", "1"])
