@@ -129,9 +129,33 @@ perf-baseline:
 perf-gate-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf_gate.py -q
 
+# Chaos scenario matrix (ISSUE 9): scripted fault schedules against
+# REAL serve/train subprocesses (worker kill mid-decode + supervised
+# restart, engine hang, fabricated HBM exhaustion, stalled data
+# loader, slow straggler, health-error storm, kill-during-checkpoint-
+# save) with recovery-SLO assertions — the doctor names each fault
+# exactly once, failed requests surface structured errors with zero
+# leaked slots/pages, train resumes within the step budget charging
+# the gap to badput — and a merged flight-recorder timeline artifact
+# per scenario under chaos_out/. CPU-hermetic; the full matrix is the
+# slow tier (~10 min).
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/chaos.py run --all --out-dir chaos_out
+
+# The 2-3 fastest scenarios (tagged "smoke": fabricated HBM
+# exhaustion, health storm, data stall), bounded wall-clock — the CI
+# tier, folded into `make smoke`.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/chaos.py run --smoke --out-dir chaos_out
+
+# Assertion-engine units + scenario schema validation + the two
+# headline e2es (worker-kill mid-decode, kill-during-checkpoint-save).
+chaos-tests:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
-    introspect-smoke doctor-smoke perf-gate-smoke perf-gate
+    introspect-smoke doctor-smoke perf-gate-smoke perf-gate chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -144,4 +168,5 @@ clean:
 .PHONY: all native test test-quick device-injector-test presubmit \
     lint lint-baseline lint-smoke bench perf hbm-plan obs-smoke \
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
-    perf-gate perf-baseline perf-gate-smoke smoke dryrun clean
+    perf-gate perf-baseline perf-gate-smoke chaos chaos-smoke \
+    chaos-tests smoke dryrun clean

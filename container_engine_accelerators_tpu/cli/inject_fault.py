@@ -20,12 +20,26 @@ ROADMAP item 4 chaos-harness primitive:
 
   --kind hang            worker-thread sleep with slots occupied
                          (--seconds)
+  --kind worker-kill     the engine worker thread DIES at its next
+                         loop top with in-flight work abandoned (the
+                         `serve --supervise` recovery path's trigger)
   --kind recompile-storm N real steady-state recompiles of a watched
                          jit (--count)
   --kind hbm-climb       fabricated hbm/<device> exhaustion climb
                          (--seconds, --device)
   --kind queue-collapse  fabricated queue-depth growth, zero admits
                          (--seconds, --depth)
+  --kind data-stall      the target's NEXT data-loader batch fetch
+                         sleeps --seconds (training/dataset.py stall
+                         hook; `train --fault-listen`)
+  --kind straggler       EVERY batch fetch sleeps --delay for the
+                         next --seconds: the target becomes the slow
+                         rank the watchdog/doctor must name
+  --kind health-tail     the target runs a real TPUHealthChecker
+                         tailing --path for --seconds, so `--kind
+                         health --error-log <path>` records flow
+                         through the production health pipeline in
+                         the target process (chaos health-storm)
 
   python -m container_engine_accelerators_tpu.cli.inject_fault \
       --kind hang --seconds 5 --fault-log /tmp/faults.jsonl
@@ -46,8 +60,9 @@ from container_engine_accelerators_tpu.healthcheck.health_checker import (
     DEFAULT_ERROR_LOG,
 )
 
-FAULT_KINDS = ("health", "hang", "recompile-storm", "hbm-climb",
-               "queue-collapse")
+FAULT_KINDS = ("health", "hang", "worker-kill", "recompile-storm",
+               "hbm-climb", "queue-collapse", "data-stall", "straggler",
+               "health-tail")
 
 
 def _append_jsonl(path: str, record: dict) -> None:
@@ -62,7 +77,7 @@ def _append_jsonl(path: str, record: dict) -> None:
 def _doctor_record(args) -> dict:
     kind = args.kind.replace("-", "_")
     rec: dict = {"kind": kind}
-    if kind == "hang":
+    if kind in ("hang", "data_stall"):
         rec["seconds"] = args.seconds
     elif kind == "recompile_storm":
         rec["n"] = args.count
@@ -71,6 +86,10 @@ def _doctor_record(args) -> dict:
                    start_frac=args.start_frac, end_frac=args.end_frac)
     elif kind == "queue_collapse":
         rec.update(depth=args.depth, seconds=args.seconds)
+    elif kind == "straggler":
+        rec.update(delay_s=args.delay, seconds=args.seconds)
+    elif kind == "health_tail":
+        rec.update(path=args.path, seconds=args.seconds)
     return rec
 
 
@@ -106,12 +125,23 @@ def main(argv=None) -> int:
     p.add_argument("--end-frac", type=float, default=0.97)
     p.add_argument("--depth", type=int, default=8,
                    help="queue-collapse: fabricated final queue depth")
+    p.add_argument("--delay", type=float, default=1.0,
+                   help="straggler: per-batch-fetch sleep seconds "
+                        "(applied for --seconds)")
+    p.add_argument("--path", default=None,
+                   help="health-tail: the error JSONL the target "
+                        "should tail with a real TPUHealthChecker "
+                        "(append records to it with --kind health "
+                        "--error-log <path>)")
     args = p.parse_args(argv)
 
     if args.kind != "health":
         if not args.fault_log:
             p.error(f"--kind {args.kind} requires --fault-log (the "
-                    "target's serve --fault-listen path)")
+                    "target's serve/train --fault-listen path)")
+        if args.kind == "health-tail" and not args.path:
+            p.error("--kind health-tail requires --path (the error "
+                    "JSONL the target should tail)")
         rec = _doctor_record(args)
         _append_jsonl(args.fault_log, rec)
         print(f"injected {args.kind} fault command -> {args.fault_log}: "

@@ -13,14 +13,30 @@ Modes:
 SLO gating (ISSUE 8: loadgen is the SLO driver for chaos runs and CI):
   --slo-ttft-p99-ms M   fail unless client-observed TTFT p99 <= M
   --slo-tpot-p99-ms M   fail unless pooled inter-token-gap p99 <= M
-Both require --stream (the latencies are client-clocked). On any
-violation the run prints a structured `SLO FAIL` line and exits 3
-(errors still exit 1; the codes are distinguishable on purpose — a
-chaos schedule treats "server broke" and "server slow" differently).
+Both require --stream (the latencies are client-clocked).
+
+Failure accounting (ISSUE 9: chaos assertions must distinguish "failed
+cleanly" from "wedged"): every request resolves to one outcome —
+
+  ok                completed
+  structured_error  the server SAID it failed: an `{"error": ...}`
+                    SSE event or error-JSON body (clean failure — the
+                    contract `serve --supervise` recovery keeps)
+  hung              a stream produced NO event for --stall-timeout-s
+                    (wedged: the failure mode structured errors exist
+                    to prevent)
+  transport_error   connection refused/reset, bad HTTP, timeouts
+
+The summary JSON reports all four; `errors` stays the total failed
+count. Exit codes: transport errors exit 1 ("server unreachable/
+broke"); SLO violations, structured errors and hung streams exit 3
+("server answered but broke its promises") — a chaos schedule treats
+the two differently, and exit 3 covers both of the new counts.
 
 Prints ONE human line per percentile block, an `SLO PASS|FAIL` line
-when gating, plus a final JSON summary line (machine-consumable,
-mirrors bench.py's one-line discipline).
+when gating, an outcome line when anything failed, plus a final JSON
+summary line (machine-consumable, mirrors bench.py's one-line
+discipline).
 """
 
 from __future__ import annotations
@@ -43,96 +59,117 @@ def percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
     return out
 
 
+class StreamStalled(Exception):
+    """No SSE event for the stall timeout: the stream is wedged, not
+    failing cleanly — the outcome chaos assertions must tell apart."""
+
+
 def one_request(url: str, tokens: list[int], max_new: int,
-                stream: bool, timeout: float) -> dict:
-    """Returns {"latency": s, "ttft": s|None, "tokens": n_generated,
-    "gaps": [inter-token seconds]} (gaps only in stream mode)."""
+                stream: bool, timeout: float,
+                stall_timeout: float | None = None) -> dict:
+    """Returns {"outcome": "ok"|"structured_error", "error": str|None,
+    "latency": s, "ttft": s|None, "tokens": n_generated,
+    "gaps": [inter-token seconds]} (gaps only in stream mode).
+    Raises StreamStalled when a stream goes silent past
+    `stall_timeout`; transport failures raise their own exceptions."""
     body = {"tokens": tokens, "max_new_tokens": max_new}
     if stream:
         body["stream"] = True
     req = urllib.request.Request(url + "/generate",
                                  data=json.dumps(body).encode())
+    # The socket timeout bounds each blocking read: in stream mode
+    # that IS the event gap, so --stall-timeout-s rides it directly.
+    read_timeout = (stall_timeout if stream and stall_timeout
+                    else timeout)
     t0 = time.perf_counter()
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        if not stream:
-            out = json.loads(resp.read())
-            if "error" in out:
-                raise RuntimeError(out["error"])
-            return {"latency": time.perf_counter() - t0, "ttft": None,
-                    "tokens": len(out["tokens"]) - len(tokens),
-                    "gaps": []}
-        ttft = None
-        last_tok_t = None
-        gaps: list[float] = []
-        n_tok = 0
-        for line in resp:
-            line = line.decode().strip()
-            if not line.startswith("data: "):
-                continue
-            ev = json.loads(line[len("data: "):])
-            if "error" in ev:
-                raise RuntimeError(ev["error"])
-            if "token" in ev:
-                now = time.perf_counter()
-                if ttft is None:
-                    ttft = now - t0
-                else:
-                    gaps.append(now - last_tok_t)
-                last_tok_t = now
-                n_tok += 1
-            if ev.get("done"):
-                break
-        return {"latency": time.perf_counter() - t0, "ttft": ttft,
-                "tokens": n_tok, "gaps": gaps}
+    out = {"outcome": "ok", "error": None, "ttft": None, "tokens": 0,
+           "gaps": []}
+    try:
+        with urllib.request.urlopen(req, timeout=read_timeout) as resp:
+            if not stream:
+                payload = json.loads(resp.read())
+                out["latency"] = time.perf_counter() - t0
+                if "error" in payload:
+                    out["outcome"] = "structured_error"
+                    out["error"] = str(payload["error"])
+                    return out
+                out["tokens"] = len(payload["tokens"]) - len(tokens)
+                return out
+            last_tok_t = None
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                ev = json.loads(line[len("data: "):])
+                if "error" in ev:
+                    out["outcome"] = "structured_error"
+                    out["error"] = str(ev["error"])
+                    break
+                if "token" in ev:
+                    now = time.perf_counter()
+                    if out["ttft"] is None:
+                        out["ttft"] = now - t0
+                    else:
+                        out["gaps"].append(now - last_tok_t)
+                    last_tok_t = now
+                    out["tokens"] += 1
+                if ev.get("done"):
+                    break
+            out["latency"] = time.perf_counter() - t0
+            return out
+    except TimeoutError as e:
+        if stream and stall_timeout:
+            raise StreamStalled(
+                f"no stream event for {stall_timeout:.1f}s") from e
+        raise
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--url", default="http://127.0.0.1:8000")
-    p.add_argument("--requests", type=int, default=50)
-    p.add_argument("--concurrency", type=int, default=4,
-                   help="in-flight requests (exercises the continuous "
-                        "engine's slot pool)")
-    p.add_argument("--max-new-tokens", type=int, default=16)
-    p.add_argument("--prompt-len", type=int, default=8)
-    p.add_argument("--stream", action="store_true",
-                   help="SSE mode: measure time-to-first-token and "
-                        "inter-token gaps")
-    p.add_argument("--timeout", type=float, default=120.0)
-    p.add_argument("--slo-ttft-p99-ms", type=float, default=None,
-                   help="fail (exit 3) unless client-observed TTFT "
-                        "p99 <= this; requires --stream")
-    p.add_argument("--slo-tpot-p99-ms", type=float, default=None,
-                   help="fail (exit 3) unless pooled inter-token-gap "
-                        "p99 <= this; requires --stream")
-    args = p.parse_args(argv)
-    if ((args.slo_ttft_p99_ms is not None
-         or args.slo_tpot_p99_ms is not None) and not args.stream):
-        p.error("--slo-ttft-p99-ms/--slo-tpot-p99-ms require --stream "
-                "(the latencies are client-clocked off the SSE feed)")
-
+def run(args) -> tuple[dict, int]:
+    """Drive the load and return (summary, exit_code) — the in-process
+    entry the chaos harness (tools/chaos.py) consumes; main() wraps it
+    for the CLI."""
     def req_i(i: int) -> dict:
         tokens = [(i * 7 + j) % 100 + 1 for j in range(args.prompt_len)]
         return one_request(args.url, tokens, args.max_new_tokens,
-                           args.stream, args.timeout)
+                           args.stream, args.timeout,
+                           stall_timeout=args.stall_timeout_s)
 
     t0 = time.perf_counter()
-    results, errors = [], 0
+    results = []
+    structured_errors = hung_streams = transport_errors = 0
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
         for fut in [ex.submit(req_i, i) for i in range(args.requests)]:
             try:
-                results.append(fut.result())
+                r = fut.result()
+            except StreamStalled as e:
+                hung_streams += 1
+                print(f"request HUNG: {e}")
+                continue
             except Exception as e:
-                errors += 1
-                print(f"request failed: {e}")
+                transport_errors += 1
+                print(f"request failed (transport): {e}")
+                continue
+            if r["outcome"] == "structured_error":
+                structured_errors += 1
+                print(f"request failed (structured): {r['error']}")
+            else:
+                results.append(r)
     wall = time.perf_counter() - t0
+    errors = structured_errors + hung_streams + transport_errors
 
     lat = percentiles([r["latency"] for r in results])
     print(f"{len(results)}/{args.requests} ok in {wall:.1f}s "
           f"({len(results) / wall:.1f} req/s); latency "
           + " ".join(f"{k}={v * 1e3:.0f}ms" for k, v in lat.items()))
+    if errors:
+        print(f"outcomes: ok={len(results)} "
+              f"structured_error={structured_errors} "
+              f"hung={hung_streams} transport={transport_errors}")
     summary = {
         "requests_ok": len(results), "errors": errors,
+        "structured_errors": structured_errors,
+        "hung_streams": hung_streams,
+        "transport_errors": transport_errors,
         "req_per_sec": round(len(results) / wall, 2),
         "latency_ms": {k: round(v * 1e3, 1) for k, v in lat.items()},
         "tokens_per_sec": round(
@@ -183,9 +220,56 @@ def main(argv=None) -> int:
                 f"[{'ok' if v['ok'] else 'VIOLATED'}]"
                 for n, v in slo.items()))
     print(json.dumps(summary))
-    if errors:
-        return 1
-    return 3 if slo_violated else 0
+    # Transport errors mean the server broke mid-conversation (exit 1);
+    # SLO violations, structured errors and hung streams mean it
+    # answered but broke its promises (exit 3 covers all three).
+    if transport_errors:
+        return summary, 1
+    if slo_violated or structured_errors or hung_streams:
+        return summary, 3
+    return summary, 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--requests", type=int, default=50)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="in-flight requests (exercises the continuous "
+                        "engine's slot pool)")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--stream", action="store_true",
+                   help="SSE mode: measure time-to-first-token and "
+                        "inter-token gaps")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--stall-timeout-s", type=float, default=None,
+                   help="stream mode: a request whose SSE stream "
+                        "produces NO event for this many seconds "
+                        "counts as a HUNG stream (wedged server) "
+                        "instead of waiting out --timeout; hung "
+                        "streams exit 3")
+    p.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                   help="fail (exit 3) unless client-observed TTFT "
+                        "p99 <= this; requires --stream")
+    p.add_argument("--slo-tpot-p99-ms", type=float, default=None,
+                   help="fail (exit 3) unless pooled inter-token-gap "
+                        "p99 <= this; requires --stream")
+    return p
+
+
+def main(argv=None) -> int:
+    p = make_parser()
+    args = p.parse_args(argv)
+    if ((args.slo_ttft_p99_ms is not None
+         or args.slo_tpot_p99_ms is not None) and not args.stream):
+        p.error("--slo-ttft-p99-ms/--slo-tpot-p99-ms require --stream "
+                "(the latencies are client-clocked off the SSE feed)")
+    if args.stall_timeout_s is not None and not args.stream:
+        p.error("--stall-timeout-s requires --stream (hung-stream "
+                "detection reads the SSE event gaps)")
+    _, rc = run(args)
+    return rc
 
 
 if __name__ == "__main__":

@@ -47,7 +47,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from container_engine_accelerators_tpu.metrics import introspection
+from container_engine_accelerators_tpu.metrics import events, introspection
 from container_engine_accelerators_tpu.metrics.request_metrics import (
     RequestRecorder,
     ServeMetricsExporter,
@@ -129,11 +129,25 @@ def _use_mesh(mesh):
         else None
 
 
+class WorkerKilled(RuntimeError):
+    """Raised inside an engine worker by the worker-kill chaos fault:
+    the uncaught exception unwinds the worker loop and the thread DIES
+    with slots occupied and futures unresolved — the exact wreckage a
+    segfaulting device runtime or a stray SystemExit leaves behind.
+    Only the EngineSupervisor (serve --supervise) recovers from it."""
+
+
 def _maybe_injected_hang(engine):
-    """Consume a FaultListener hang (engine.fault_hang_s): the worker
-    thread itself sleeps, so the stall is indistinguishable from a
-    real wedge — which is the point: the doctor must detect a hang,
-    not be told about one."""
+    """Consume a FaultListener hang or kill (engine.fault_hang_s /
+    engine.fault_kill): the worker thread itself sleeps or dies, so
+    the failure is indistinguishable from a real wedge — which is the
+    point: the doctor/supervisor must detect it, not be told about it."""
+    if engine.fault_kill:
+        engine.fault_kill = False
+        log.warning("injected worker kill: worker thread dying with "
+                    "in-flight work abandoned")
+        raise WorkerKilled("injected worker kill (inject_fault "
+                           "--kind worker-kill)")
     s, engine.fault_hang_s = engine.fault_hang_s, 0.0
     if s > 0:
         log.warning("injected hang: worker sleeping %.1fs", s)
@@ -164,11 +178,26 @@ class BatchingEngine:
         self._work = threading.Event()
         self.batches_run = 0
         self.requests_served = 0
-        # Chaos hook (metrics/doctor.py FaultListener): a nonzero value
-        # makes the worker sleep that long at its next loop top — a
-        # real hang (slots occupied, no ticks) for the doctor e2e.
+        # Chaos hooks (metrics/doctor.py FaultListener): a nonzero
+        # fault_hang_s makes the worker sleep that long at its next
+        # loop top — a real hang (slots occupied, no ticks) for the
+        # doctor e2e; fault_kill makes it raise WorkerKilled there,
+        # dying with in-flight work abandoned (serve --supervise is
+        # the recovery path under test).
         self.fault_hang_s = 0.0
+        self.fault_kill = False
+        # In-flight state lives on the ENGINE, not in worker locals:
+        # after a worker death the supervisor must be able to find and
+        # fail every request the dead thread was holding.
+        self._pending: list = []
+        self._batch: list = []
+        self.worker_restarts = 0
         self._stop = threading.Event()
+        self._start_worker()
+
+    def _start_worker(self):
+        """(Re)create the worker thread — __init__ and the
+        EngineSupervisor's restart path share this."""
         self.thread = threading.Thread(target=self._worker, daemon=True,
                                        name="serve-batcher")
         self.thread.start()
@@ -193,6 +222,25 @@ class BatchingEngine:
         self._stop.set()
         self._work.set()  # wake an idle worker so it can exit promptly
 
+    def recover_after_worker_death(self, err: Exception) -> None:
+        """Fail every request the dead worker abandoned — the current
+        batch, parked bucket-mismatched requests, and everything still
+        queued — with structured errors, and zero the occupancy gauges.
+        Called by the EngineSupervisor BEFORE it restarts the worker;
+        clients see `{"error": ...}` instead of a silent stream hang."""
+        for item in self._batch + self._pending:
+            _fail(item[3], item[4], err, item[5], self.recorder)
+        self._batch = []
+        self._pending = []
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            _fail(item[3], item[4], err, item[5], self.recorder)
+        self._work.clear()
+        self.recorder.set_slots(active=0, total=self.max_batch)
+
     # ---------- worker ----------
 
     @staticmethod
@@ -213,7 +261,10 @@ class BatchingEngine:
             self.params = decode_tp.shard_decode_params(
                 self.params, self.mesh, self.cfg)
 
-        pending: list = []
+        # Parked/in-flight items live on the engine (self._pending /
+        # self._batch) so the supervisor can fail them after a worker
+        # death instead of leaking their futures.
+        pending = self._pending
         while not self._stop.is_set():
             _maybe_injected_hang(self)
             # Only block for new traffic when nothing is deferred —
@@ -232,7 +283,7 @@ class BatchingEngine:
             # Gather same-bucket requests for one window.
             deadline = time.monotonic() + self.window
             key = self._bucket_key(pending[0])
-            batch = [pending.pop(0)]
+            batch = self._batch = [pending.pop(0)]
             # Drain previously-parked same-bucket requests first: mixed
             # traffic parks items here, and without this sweep each one
             # would get its own single-request generate() call.
@@ -303,6 +354,7 @@ class BatchingEngine:
                 log.exception("batch failed")
                 for item in batch:
                     _fail(item[3], item[4], e, item[5], rec)
+            self._batch = []
             rec.set_slots(active=0, total=self.max_batch)
 
 
@@ -371,10 +423,13 @@ class ContinuousEngine:
         # the worker; _pump_queue never issues a timed queue-get).
         self.queue: queue.Queue = queue.Queue()
         self._work = threading.Event()
-        # Chaos hook (metrics/doctor.py FaultListener), same contract
+        # Chaos hooks (metrics/doctor.py FaultListener), same contract
         # as BatchingEngine: worker sleeps this long at its next loop
-        # top, producing a real slots-occupied/no-ticks hang.
+        # top (real slots-occupied/no-ticks hang) / dies abruptly with
+        # in-flight work abandoned (WorkerKilled).
         self.fault_hang_s = 0.0
+        self.fault_kill = False
+        self.worker_restarts = 0
         self.steps_run = 0          # decode iterations (all slots at once)
         self.prefills_run = 0       # completed request prefills
         self.prefill_chunks_run = 0
@@ -384,6 +439,13 @@ class ContinuousEngine:
         self.requests_served = 0
         self.batches_run = 0        # alias: /healthz parity with window
         self._stop = threading.Event()
+        self._start_worker()
+
+    def _start_worker(self):
+        """(Re)create the worker thread — __init__ and the
+        EngineSupervisor's restart path share this. The worker rebuilds
+        its slot table and cache from scratch at thread start, so a
+        restarted worker begins with a clean pool."""
         self.thread = threading.Thread(target=self._worker, daemon=True,
                                        name="serve-continuous")
         self.thread.start()
@@ -417,6 +479,30 @@ class ContinuousEngine:
     def stop(self):
         self._stop.set()
         self._work.set()  # wake an idle worker so it can exit promptly
+
+    def recover_after_worker_death(self, err: Exception) -> None:
+        """Fail every request the dead worker abandoned — occupied
+        slots, the backlog, and everything still queued — with
+        structured errors, and zero the occupancy gauges so the
+        recorder reflects reality (no leaked slots). Called by the
+        EngineSupervisor BEFORE restarting the worker; the fresh
+        worker rebuilds the cache/pool itself at thread start."""
+        for sl in getattr(self, "_slots", []):
+            if sl is not None:
+                _fail(sl["fut"], sl["stream"], err, sl["rid"],
+                      self.recorder)
+        self._slots = [None] * self.max_slots
+        for item in getattr(self, "_backlog", []):
+            _fail(item[3], item[4], err, item[5], self.recorder)
+        self._backlog = []
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            _fail(item[3], item[4], err, item[5], self.recorder)
+        self._work.clear()
+        self.recorder.set_slots(active=0, total=self.max_slots)
 
     # ---------- engine hooks (overridden by the paged engine) ----------
 
@@ -796,6 +882,24 @@ class PagedContinuousEngine(ContinuousEngine):
         return super().submit(tokens, max_new_tokens, temperature,
                               stream=stream)
 
+    def recover_after_worker_death(self, err: Exception) -> None:
+        # Reclaim the dead worker's pages BEFORE failing the slots:
+        # the restarted worker builds a fresh allocator anyway, but
+        # the allocator accounting and kv-page gauges must return to
+        # baseline now — leaked pages are exactly what the chaos
+        # harness's worker-kill scenario asserts against.
+        for i in range(len(getattr(self, "_slots", []))):
+            self._free_slot_pages(i)
+        index = getattr(self, "_index", None)
+        if index is not None:
+            while index.evict_lru():
+                pass
+        super().recover_after_worker_death(err)
+        alloc = getattr(self, "_alloc", None)
+        total = (alloc.n_pages - 1) if alloc is not None \
+            else max(self.pool_pages - 1, 0)
+        self.recorder.set_kv_pages(used=0, total=total)
+
     # ---------- hooks ----------
 
     def _make_fns(self):
@@ -1020,6 +1124,111 @@ class PagedContinuousEngine(ContinuousEngine):
                 return False
         return True
 
+class EngineSupervisor:
+    """Worker-restart loop (serve --supervise): watches the engine's
+    worker thread and, when it dies unexpectedly — an uncaught device
+    error, a chaos worker-kill, anything that escapes the guarded
+    regions — runs the engine's recovery path (fail every in-flight
+    request with a structured error, reclaim slots/KV pages, zero the
+    occupancy gauges) and restarts a fresh worker under BOUNDED
+    exponential backoff: consecutive rapid deaths double the delay up
+    to `backoff_cap_s`, a worker that stays alive `stable_after_s`
+    resets the ladder, and `max_restarts` consecutive deaths makes the
+    supervisor give up loudly instead of flapping forever (the engine
+    stays recovered-but-stopped; /healthz shows worker_alive false).
+
+    Without a supervisor a dead worker is the worst serving failure
+    mode: /healthz stays green, slots stay occupied, every queued
+    future hangs until client timeout — the process-level analog of
+    the PR 2 SimpleQueue wedge, now recovered instead of diagnosed."""
+
+    def __init__(self, engine, backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 10.0, max_restarts: int = 16,
+                 poll_interval_s: float = 0.2,
+                 stable_after_s: float = 30.0):
+        self.engine = engine
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.max_restarts = max_restarts
+        self.poll_interval_s = poll_interval_s
+        self.stable_after_s = stable_after_s
+        self.restarts = 0           # lifetime restarts (monotonic)
+        self.gave_up = False
+        self._consecutive = 0
+        self._last_restart: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="engine-supervisor")
+        self._thread.start()
+        log.info("engine supervisor armed: backoff %.2fs..%.1fs, "
+                 "max %d consecutive restarts", self.backoff_base_s,
+                 self.backoff_cap_s, self.max_restarts)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self):
+        eng = self.engine
+        while not self._stop.is_set():
+            if eng._stop.is_set():
+                return  # deliberate engine.stop(): nothing to revive
+            if eng.thread.is_alive():
+                self._stop.wait(self.poll_interval_s)
+                continue
+            now = time.monotonic()
+            if (self._last_restart is not None
+                    and now - self._last_restart >= self.stable_after_s):
+                self._consecutive = 0  # worker had stabilized: new ladder
+            self._consecutive += 1
+            err = RuntimeError(
+                "engine worker died unexpectedly; request failed during "
+                f"supervised recovery (restart {self.restarts + 1})")
+            log.error("engine worker died; recovering "
+                      "(consecutive death %d)", self._consecutive)
+            if events.enabled():
+                events.instant("supervisor/worker_death", "chaos",
+                               {"consecutive": self._consecutive})
+            try:
+                eng.recover_after_worker_death(err)
+            except Exception:
+                log.exception("engine recovery failed; restarting anyway")
+            if self._consecutive > self.max_restarts:
+                self.gave_up = True
+                log.error("engine worker died %d consecutive times; "
+                          "supervisor giving up (engine recovered but "
+                          "stopped — restart the server)",
+                          self._consecutive)
+                if events.enabled():
+                    events.instant("supervisor/gave_up", "chaos",
+                                   {"restarts": self.restarts})
+                return
+            delay = min(self.backoff_cap_s,
+                        self.backoff_base_s * 2 ** (self._consecutive - 1))
+            if self._stop.wait(delay):
+                return
+            if eng._stop.is_set():
+                return
+            eng._start_worker()
+            self.restarts += 1
+            eng.worker_restarts = self.restarts
+            self._last_restart = time.monotonic()
+            eng.recorder.worker_restarts.inc()
+            log.warning("engine worker restarted (restart %d, after "
+                        "%.2fs backoff)", self.restarts, delay)
+            if events.enabled():
+                events.instant("supervisor/worker_restart", "chaos",
+                               {"restart": self.restarts,
+                                "backoff_s": round(delay, 3)})
+
+
 def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -1038,7 +1247,12 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
                 return self._send({
                     "ok": True,
                     "batches": engine.batches_run,
-                    "requests": engine.requests_served})
+                    "requests": engine.requests_served,
+                    # Worker liveness: a dead worker with a green
+                    # /healthz was exactly the wedge the supervisor
+                    # exists for — surface it either way.
+                    "worker_alive": engine.thread.is_alive(),
+                    "worker_restarts": engine.worker_restarts})
             return self._send({"error": "not found"}, 404)
 
         def _stream_response(self, stream_q):
@@ -1179,6 +1393,22 @@ def main(argv=None) -> int:
                    help="directory for doctor incident bundles "
                         "(default: TPU_DOCTOR_DIR env, else next to "
                         "the trace dump, else the cwd)")
+    p.add_argument("--supervise", action="store_true",
+                   help="arm the EngineSupervisor: an unexpectedly "
+                        "dead engine worker thread is recovered "
+                        "(in-flight requests fail with structured "
+                        "errors, slots/KV pages reclaimed, occupancy "
+                        "gauges zeroed) and restarted under bounded "
+                        "exponential backoff instead of wedging the "
+                        "server forever")
+    p.add_argument("--supervise-backoff", type=float, default=0.5,
+                   help="supervisor restart backoff base seconds "
+                        "(doubles per consecutive death, capped at "
+                        "10s; a 30s-stable worker resets the ladder)")
+    p.add_argument("--supervise-max-restarts", type=int, default=16,
+                   help="consecutive worker deaths after which the "
+                        "supervisor gives up loudly (engine stays "
+                        "recovered but stopped)")
     p.add_argument("--fault-listen", default=None,
                    help="CHAOS/TEST ONLY: tail this JSONL fault-"
                         "command file (written by `inject_fault "
@@ -1294,6 +1524,11 @@ def main(argv=None) -> int:
             out_dir=args.doctor_dir if args.doctor_dir else "auto")
         doc.start()
         doctor.set_active(doc)
+    if args.supervise:
+        sup = EngineSupervisor(
+            engine, backoff_base_s=args.supervise_backoff,
+            max_restarts=args.supervise_max_restarts)
+        sup.start()
     if args.fault_listen:
         from container_engine_accelerators_tpu.metrics.doctor import (
             FaultListener,

@@ -93,6 +93,14 @@ def main(argv=None) -> int:
                    help="directory for doctor incident bundles "
                         "(default: TPU_DOCTOR_DIR env, else next to "
                         "the trace dump, else the cwd)")
+    p.add_argument("--fault-listen", default=None,
+                   help="CHAOS/TEST ONLY: tail this JSONL fault-"
+                        "command file (written by `inject_fault "
+                        "--kind data-stall|straggler|... "
+                        "--fault-log`) and inject the faults into "
+                        "this process — data-loader stalls, "
+                        "slow-straggler delays, health-pipeline "
+                        "storms")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -172,6 +180,11 @@ def main(argv=None) -> int:
             out_dir=args.doctor_dir if args.doctor_dir else "auto")
         doc.start()
         doctor_mod.set_active(doc)
+    if args.fault_listen:
+        from container_engine_accelerators_tpu.metrics.doctor import (
+            FaultListener,
+        )
+        FaultListener(args.fault_listen).start()
     opt = make_optimizer()
     state, _ = fit(cfg, mesh, opt, batches,
                    ckpt_dir=args.ckpt_dir, save_every=args.save_every,

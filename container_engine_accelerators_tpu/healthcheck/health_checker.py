@@ -423,4 +423,9 @@ class TPUHealthChecker:
                 return
             except Exception:
                 log.exception("reset attempt %d failed", attempt)
-                time.sleep(2 ** attempt)
+                if attempt + 1 < max_attempts:
+                    # Exponential backoff between attempts; nothing to
+                    # wait for after the last one — the cap bounds how
+                    # long a dead API server can stall checker startup
+                    # (~1+2=3s at the default cap of 3 attempts).
+                    time.sleep(2 ** attempt)

@@ -1044,6 +1044,11 @@ class FaultListener:
       hang             {"seconds": S}: the engine worker sleeps S at
                        its next loop top (slots stay occupied, no
                        ticks — a REAL hang, not a simulated one)
+      worker_kill      {}: the engine worker thread raises
+                       WorkerKilled at its next loop top and DIES with
+                       in-flight work abandoned — the failure mode
+                       `serve --supervise` recovers from (structured
+                       errors, slot/page reclaim, backoff restart)
       recompile_storm  {"n": N}: N steady-state recompiles of a
                        watched jit with escalating shapes (real
                        CompileTracker events with dimension diffs)
@@ -1052,6 +1057,22 @@ class FaultListener:
                        (the ROADMAP 4 'fabricated HBM exhaustion')
       queue_collapse   {"depth", "seconds"}: fabricated queue-depth
                        growth with zero admits
+      data_stall       {"seconds": S}: the NEXT data-loader batch
+                       fetch sleeps S inside the iterator
+                       (training/dataset.py stall hook) — real
+                       data-wait, charged to the stalled goodput
+                       bucket
+      straggler        {"delay_s": D, "seconds": S}: EVERY batch fetch
+                       sleeps D for the next S seconds — this process
+                       becomes the slow rank the watchdog/doctor must
+                       name
+      health_tail      {"path": P, "seconds": S}: run a REAL
+                       TPUHealthChecker over a LogFileErrorSource
+                       tailing P for S seconds, so records appended by
+                       `inject_fault --kind health --error-log P`
+                       flow through the production health pipeline
+                       (health/<class> instants, scrape counters) in
+                       THIS process
     """
 
     def __init__(self, path: str, engine=None, interval_s: float = 0.25):
@@ -1103,12 +1124,30 @@ class FaultListener:
                 log.warning("hang fault with no engine attached")
                 return
             self.engine.fault_hang_s = float(rec.get("seconds", 5.0))
+        elif kind == "worker_kill":
+            if self.engine is None:
+                log.warning("worker-kill fault with no engine attached")
+                return
+            self.engine.fault_kill = True
         elif kind == "recompile_storm":
             self._recompile_storm(int(rec.get("n", 4)))
         elif kind == "hbm_climb":
             self._hbm_climb(rec)
         elif kind == "queue_collapse":
             self._queue_collapse(rec)
+        elif kind == "data_stall":
+            from container_engine_accelerators_tpu.training.dataset import (
+                inject_stall,
+            )
+            inject_stall(once_s=float(rec.get("seconds", 3.0)))
+        elif kind == "straggler":
+            from container_engine_accelerators_tpu.training.dataset import (
+                inject_stall,
+            )
+            inject_stall(per_batch_s=float(rec.get("delay_s", 1.0)),
+                         duration_s=float(rec.get("seconds", 10.0)))
+        elif kind == "health_tail":
+            self._health_tail(rec)
         else:
             log.warning("unknown fault kind %r", kind)
 
@@ -1151,3 +1190,48 @@ class FaultListener:
                            {"queued": 1 + i * depth // samples}, "serve")
             if self._stop.wait(seconds / samples):
                 return
+
+    def _health_tail(self, rec: dict) -> None:
+        """Run the REAL health pipeline over an injected error feed:
+        a TPUHealthChecker with a LogFileErrorSource tails `path` for
+        `seconds`, so `inject_fault --kind health --error-log <path>`
+        records produce genuine ErrorEvents — health/<class> bus
+        instants, scrape counters, error_summary() — in this process
+        (the chaos health-storm scenario's detection surface). No K8s,
+        no device manager: chip-health flips are no-ops here, the
+        observability side is what the storm exercises."""
+        from container_engine_accelerators_tpu.deviceplugin.config import (
+            TPUConfig,
+        )
+        from container_engine_accelerators_tpu.healthcheck.health_checker import (  # noqa: E501
+            LogFileErrorSource,
+            TPUHealthChecker,
+        )
+
+        class _NullManager:
+            devices: dict = {}
+
+            def set_device_health(self, *a, **k):
+                pass
+
+            def set_chip_health(self, *a, **k):
+                pass
+
+        path = rec.get("path")
+        if not path:
+            log.warning("health_tail fault without a path")
+            return
+        seconds = float(rec.get("seconds", 5.0))
+        interval = float(rec.get("interval", 0.2))
+        checker = TPUHealthChecker(
+            _NullManager(), TPUConfig(),
+            sources=[LogFileErrorSource(path)], k8s=None)
+        # The reboot-reset path runs first like the real poll loop
+        # (a no-op without k8s; the unit tests pin its attempt cap).
+        checker.maybe_reset_condition()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            checker.poll_once()
+            if self._stop.wait(interval):
+                return
+        log.warning("health_tail done: %s", checker.error_summary())

@@ -152,6 +152,10 @@ class RequestRecorder:
             "serve_prefix_pages_reused",
             "Full prompt pages served from the prefix cache instead of "
             "recomputed (paged engine)", registry=reg)
+        self.worker_restarts = Counter(
+            "serve_worker_restarts",
+            "Engine worker threads restarted by the supervisor after an "
+            "unexpected death (serve --supervise)", registry=reg)
 
     # ---------- lifecycle edges ----------
 

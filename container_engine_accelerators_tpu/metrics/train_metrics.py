@@ -556,6 +556,18 @@ class TrainRecorder:
                     self._log_file.close()
                 finally:
                     self._log_file = None
+            if self._hb_path is not None:
+                # Deregister the heartbeat on CLEAN shutdown: a process
+                # that finished its run is not a straggler, but its
+                # frozen hb file would age past any threshold and make
+                # the watchdog (and the doctor's skew detector) name it
+                # forever — the chaos straggler scenario flushed this
+                # out.
+                try:
+                    os.remove(self._hb_path)
+                except OSError:
+                    pass
+                self._hb_path = None
 
 
 class HangWatchdog:

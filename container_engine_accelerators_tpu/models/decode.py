@@ -786,6 +786,18 @@ class PageAllocator:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def pages_in_use(self) -> int:
+        """Allocated rows (any refcount), excluding the trash row —
+        the leak-accounting surface the chaos harness asserts returns
+        to baseline after every recovery (tools/chaos.py)."""
+        return self.n_pages - 1 - len(self._free)
+
+    def outstanding_rows(self) -> dict[int, int]:
+        """row -> refcount for every allocated row; empty means every
+        page is back on the free list (no leaks)."""
+        return dict(self._refs)
+
     def refcount(self, row: int) -> int:
         return self._refs.get(row, 0)
 

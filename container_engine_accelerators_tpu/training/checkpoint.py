@@ -19,6 +19,7 @@ to weight layouts: converge to the requested state, don't error.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any
 
@@ -26,11 +27,14 @@ import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from container_engine_accelerators_tpu.metrics import events
 from container_engine_accelerators_tpu.parallel.pipeline import (
     normalize_layout,
     relayout_layers,
 )
 from container_engine_accelerators_tpu.training.train import TrainState
+
+log = logging.getLogger(__name__)
 
 _DEPTH_ORDER = {"interleaved": False}
 
@@ -119,48 +123,115 @@ class CheckpointManager:
         CALLER needs (state_layer_layout of the current cfg/mesh); when
         it differs from the checkpoint's recorded layout, the stacked
         layer arrays and their optimizer moments are re-permuted
-        automatically."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
+        automatically.
+
+        Torn-checkpoint resilience: with `step=None` (restore latest),
+        a newest checkpoint that fails to deserialize — truncated array
+        file from a crash mid-write, partial copy, bit rot — is SKIPPED
+        with a logged reason and a `ckpt/restore_fallback` timeline
+        instant, and the previous step is tried instead. Before this, a
+        single torn newest checkpoint wedged every future auto-resume:
+        the one failure checkpointing exists to survive. An explicit
+        `step` still fails loudly (the caller asked for THAT step)."""
 
         def to_abstract(x):
             sharding = getattr(x, "sharding", None)
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
         abstract = jax.tree.map(to_abstract, state_like._asdict())
-        step_dir = os.path.join(self._dir, str(step))
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(self._mngr.all_steps(), reverse=True)
+        if not candidates:
+            return None
+        for i, s in enumerate(candidates):
+            try:
+                tree, saved_layout = self._restore_step(s, abstract)
+            except Exception as e:
+                if step is not None or i == len(candidates) - 1:
+                    raise self._translate_restore_error(e, s)
+                log.warning(
+                    "checkpoint step %d in %s is unreadable "
+                    "(%s: %s); falling back to step %d",
+                    s, self._dir, type(e).__name__, str(e)[:200],
+                    candidates[i + 1])
+                if events.enabled():
+                    events.instant("ckpt/restore_fallback", "train",
+                                   {"bad_step": s,
+                                    "fallback_step": candidates[i + 1],
+                                    "error": str(e)[:200]})
+                self._quarantine_step(s)
+                continue
+            if normalize_layout(saved_layout) != normalize_layout(layout):
+                tree = _relayout_state_tree(tree, saved_layout, layout)
+            return TrainState(**tree)
+        raise AssertionError("unreachable: every candidate raised")
+
+    def _quarantine_step(self, step: int) -> None:
+        """Rename a torn step dir out of the numeric namespace: the
+        resumed run will save at this step again, and orbax refuses to
+        overwrite an existing step — the wreckage must move aside (it
+        stays on disk as evidence, `<step>.corrupt*`). Best-effort:
+        a failed rename only costs the later save, not the restore."""
+        src = os.path.join(self._dir, str(step))
+        if not os.path.isdir(src):
+            return
+        dst = os.path.join(self._dir, f"{step}.corrupt")
+        i = 0
+        while os.path.exists(dst):
+            i += 1
+            dst = os.path.join(self._dir, f"{step}.corrupt.{i}")
         try:
-            if os.path.isdir(os.path.join(step_dir, "state")):
-                restored = self._mngr.restore(
-                    step, args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(abstract),
-                        layout=ocp.args.JsonRestore(),
-                    ))
-                tree, saved_layout = restored["state"], restored["layout"]
-            else:
-                # Pre-tag checkpoint (bare StandardSave): depth order.
-                tree = self._mngr.restore(
-                    step, args=ocp.args.StandardRestore(abstract))
-                saved_layout = dict(_DEPTH_ORDER)
-        except (KeyError, ValueError, TypeError) as e:
+            os.rename(src, dst)
+            log.warning("quarantined torn checkpoint step %d -> %s",
+                        step, dst)
+        except OSError:
+            log.exception("could not quarantine torn checkpoint %s", src)
+            return
+        # The orbax manager snapshots the step list at init on some
+        # versions; refresh so a later save at this step starts clean.
+        try:
+            if hasattr(self._mngr, "reload"):
+                self._mngr.reload()
+        except Exception:
+            log.debug("orbax manager reload failed", exc_info=True)
+
+    def _restore_step(self, step: int, abstract) -> tuple[dict, dict]:
+        """(state tree, saved layout) for one step; raises on any
+        deserialization failure (restore() owns fallback policy)."""
+        step_dir = os.path.join(self._dir, str(step))
+        if os.path.isdir(os.path.join(step_dir, "state")):
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    layout=ocp.args.JsonRestore(),
+                ))
+            return restored["state"], restored["layout"]
+        # Pre-tag checkpoint (bare StandardSave): depth order.
+        tree = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return tree, dict(_DEPTH_ORDER)
+
+    def _translate_restore_error(self, e: Exception,
+                                 step: int) -> Exception:
+        if isinstance(e, (KeyError, ValueError, TypeError)):
             # The dominant cause of a tree-structure mismatch here is
             # the round-5 optimizer swap: fused_adamw's state is one
             # FusedAdamWState namedtuple, the legacy optax chain's is a
             # nested (clip, adamw, ...) tuple. Orbax's raw error names
             # neither — point at the actual knob.
-            raise ValueError(
+            err = ValueError(
                 f"checkpoint step {step} in {self._dir} does not match "
                 "the target TrainState structure. If this checkpoint "
                 "was written by the legacy optax chain (pre-fused "
                 "optimizer), rebuild the train state with "
                 "make_optimizer(fused=False) so the optimizer state "
                 "layouts agree (training/train.py make_optimizer "
-                "docstring), then restore again.") from e
-
-        if normalize_layout(saved_layout) != normalize_layout(layout):
-            tree = _relayout_state_tree(tree, saved_layout, layout)
-        return TrainState(**tree)
+                "docstring), then restore again.")
+            err.__cause__ = e
+            return err
+        return e
 
     def close(self):
         self._mngr.close()

@@ -22,9 +22,17 @@ def synthetic_batches(vocab_size: int, batch_size: int, seq_len: int,
     Sequences follow x[t+1] = (a * x[t] + b) % vocab with per-sequence
     (a, b) and 10% uniform noise — learnable structure, nonzero floor.
     """
+    from container_engine_accelerators_tpu.training.dataset import (
+        maybe_stall,
+    )
+
     rng = np.random.default_rng(seed)
     i = 0
     while num_batches is None or i < num_batches:
+        # Chaos stall hook: an armed data-stall/straggler fault sleeps
+        # HERE, inside the iterator, so the loop's data-wait clock sees
+        # a real loader stall (training/dataset.py inject_stall).
+        maybe_stall()
         a = rng.integers(1, min(vocab_size, 7), size=(batch_size, 1))
         b = rng.integers(0, vocab_size, size=(batch_size, 1))
         x0 = rng.integers(0, vocab_size, size=(batch_size, 1))

@@ -317,7 +317,10 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     )
 
     rec = recorder
-    own_log = rec is None and metrics_log is not None
+    # A recorder fit builds, fit closes: close() flushes the JSONL log
+    # AND deregisters the heartbeat file — a cleanly finished process
+    # must not age into a phantom straggler for the watchdog/doctor.
+    own_rec = rec is None
     if rec is None and (metrics_port is not None or metrics_log
                         or heartbeat_dir):
         from container_engine_accelerators_tpu.metrics.train_metrics import (
@@ -462,7 +465,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
             exporter.stop()
         if watchdog is not None:
             watchdog.stop()
-        if own_log and rec is not None:
+        if own_rec and rec is not None:
             rec.close()
     return state, metrics
 

@@ -1,0 +1,456 @@
+"""Chaos harness (ISSUE 9): scenario schema validation, the assertion
+engine in isolation, loadgen's failed-cleanly-vs-wedged accounting,
+and the two headline e2es — worker-kill mid-decode (supervised
+restart, structured errors, zero leaked slots/pages) and
+kill-during-checkpoint-save (resume within the step budget off the
+previous checkpoint, past a torn newest)."""
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.cli import inject_fault, loadgen
+from container_engine_accelerators_tpu.cli.serve import (
+    ContinuousEngine,
+    EngineSupervisor,
+    PagedContinuousEngine,
+    make_server,
+)
+from container_engine_accelerators_tpu.metrics import doctor, events
+from container_engine_accelerators_tpu.metrics.doctor import FaultListener
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from tools import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    def reset():
+        events._reset_for_tests()
+        doctor.set_active(None)
+        from container_engine_accelerators_tpu.training.dataset import (
+            clear_stall,
+        )
+        clear_stall()
+    reset()
+    yield
+    reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    # Same tiny config as the other serve suites: process-wide jit
+    # caches stay hot across test modules.
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def _wait_for(pred, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------- scenario schema ----------
+
+def test_all_shipped_scenarios_validate():
+    names = set()
+    for fn in sorted(os.listdir(chaos.SCENARIO_DIR)):
+        if fn.endswith(".json"):
+            sc = chaos.load_scenario(os.path.join(chaos.SCENARIO_DIR, fn))
+            names.add(sc["name"])
+    # The acceptance floor: a full matrix of at least six scenarios,
+    # including the two headline ones.
+    assert len(names) >= 6
+    assert {"worker-kill", "engine-hang", "hbm-exhaustion",
+            "data-stall", "straggler", "health-storm",
+            "ckpt-kill"} <= names
+
+
+def test_smoke_subset_is_bounded():
+    smoke = chaos.discover_scenarios(smoke=True)
+    assert 2 <= len(smoke) <= 3, [s["name"] for s in smoke]
+
+
+def test_scenario_schema_rejections(tmp_path):
+    def write(sc):
+        p = tmp_path / "sc.json"
+        p.write_text(json.dumps(sc))
+        return str(p)
+
+    base = {"name": "x", "workloads": [{"kind": "serve",
+                                        "engine": "window"}],
+            "phases": [], "asserts": {}}
+    chaos.load_scenario(write(base))  # valid
+    with pytest.raises(chaos.ScenarioError, match="missing required"):
+        chaos.load_scenario(write({"name": "x"}))
+    with pytest.raises(chaos.ScenarioError, match="workload kind"):
+        chaos.load_scenario(write(
+            dict(base, workloads=[{"kind": "nope"}])))
+    with pytest.raises(chaos.ScenarioError, match="unknown action"):
+        chaos.load_scenario(write(
+            dict(base, phases=[{"action": "explode"}])))
+    with pytest.raises(chaos.ScenarioError, match="unknown workload"):
+        chaos.load_scenario(write(
+            dict(base, phases=[{"action": "sleep", "target": "ghost"}])))
+    with pytest.raises(chaos.ScenarioError, match="unknown assert"):
+        chaos.load_scenario(write(dict(base, asserts={"vibes": True})))
+    with pytest.raises(chaos.ScenarioError, match="loadgen_wait"):
+        chaos.load_scenario(write(
+            dict(base, phases=[{"action": "loadgen_wait", "id": "bg"}])))
+
+
+# ---------- assertion engine ----------
+
+def _inc(cls, subject="s", ts=100.0):
+    return {"class": cls, "subject": subject, "ts_monotonic": ts}
+
+
+def test_check_doctor_exact_counts_and_subject():
+    incs = [_inc("engine_hang", "serve", 10.0)]
+    res = chaos.check_doctor(incs, {"expect": {"engine_hang": 1}}, 5.0)
+    assert all(r["ok"] for r in res), res
+    # Wrong count fails.
+    res = chaos.check_doctor(incs + [_inc("engine_hang", "serve2", 11.0)],
+                             {"expect": {"engine_hang": 1}}, 5.0)
+    assert not [r for r in res if r["name"] == "doctor.engine_hang"][0]["ok"]
+    # Subject pinning.
+    res = chaos.check_doctor(
+        incs, {"expect": {"engine_hang": {"count": 1,
+                                          "subject": "serve"}}}, 5.0)
+    assert all(r["ok"] for r in res), res
+    res = chaos.check_doctor(
+        incs, {"expect": {"engine_hang": {"count": 1,
+                                          "subject": "other"}}}, 5.0)
+    assert not [r for r in res
+                if r["name"] == "doctor.engine_hang.subject"][0]["ok"]
+
+
+def test_check_doctor_unexpected_and_clean_phase():
+    incs = [_inc("engine_hang", ts=10.0), _inc("slo_burn", ts=12.0)]
+    res = chaos.check_doctor(incs, {"expect": {"engine_hang": 1}}, 5.0)
+    bad = [r for r in res if r["name"] == "doctor.no_unexpected"][0]
+    assert not bad["ok"] and "slo_burn" in bad["detail"]
+    # Allowed classes are ignored by both checks.
+    res = chaos.check_doctor(incs, {"expect": {"engine_hang": 1},
+                                    "allow": ["slo_burn"]}, 11.0)
+    assert [r for r in res if r["name"] == "doctor.no_unexpected"][0]["ok"]
+    # An expected-class incident BEFORE the fault fails the clean phase.
+    res = chaos.check_doctor([_inc("engine_hang", ts=3.0)],
+                             {"expect": {"engine_hang": 1}}, 5.0)
+    assert not [r for r in res
+                if r["name"] == "doctor.clean_phase_quiet"][0]["ok"]
+
+
+def test_check_loadgen_counts_and_ranges():
+    summary = {"requests_ok": 3, "structured_errors": 2,
+               "hung_streams": 0, "transport_errors": 0, "errors": 2,
+               "slo": {"ttft_p99_ms": {"ok": True}}}
+    res = chaos.check_loadgen(summary, 3, {
+        "requests_ok": 3, "structured_errors": {"min": 1},
+        "hung_streams": 0, "slo_pass": True, "exit_in": [3]})
+    assert all(r["ok"] for r in res), res
+    res = chaos.check_loadgen(summary, 3, {"hung_streams": {"max": 0},
+                                           "structured_errors": 0})
+    assert not [r for r in res
+                if "structured_errors" in r["name"]][0]["ok"]
+
+
+def test_check_gauges_baseline_parses_prometheus_text():
+    text = ("# HELP serve_active_slots x\n"
+            "serve_active_slots 0.0\n"
+            "serve_kv_pages_in_use 3.0\n")
+    res = chaos.check_gauges_baseline(text)
+    by = {r["name"]: r for r in res}
+    assert by["gauges.serve_active_slots"]["ok"]
+    assert not by["gauges.serve_kv_pages_in_use"]["ok"]
+    # Absent family (window engine) counts as baseline.
+    res = chaos.check_gauges_baseline("serve_active_slots 0.0\n")
+    assert all(r["ok"] for r in res)
+
+
+def test_check_train_step_target_and_badput():
+    summary = {"final_step": 10,
+               "goodput": {"restore": 0.4, "stalled": 3.5}}
+    res = chaos.check_train(summary, {"final_step_at_least": 10,
+                                      "resumed": True,
+                                      "badput_min_s": {"stalled": 3.0}})
+    assert all(r["ok"] for r in res), res
+    res = chaos.check_train(summary, {"final_step_at_least": 11})
+    assert not res[0]["ok"]
+    res = chaos.check_train(None, {"final_step_at_least": 1})
+    assert not res[0]["ok"]
+    res = chaos.check_train({"final_step": 5, "goodput": {}},
+                            {"resumed": True})
+    assert not [r for r in res if r["name"].endswith("resumed")][0]["ok"]
+
+
+def test_check_timeline_requires_names():
+    trace = {"traceEvents": [{"name": "fault/injected", "ph": "i"},
+                             {"name": "x", "ph": "C"}]}
+    res = chaos.check_timeline(trace, ["fault/injected", "missing"])
+    assert res[0]["ok"] and not res[1]["ok"]
+
+
+def test_corrupt_newest_checkpoint_truncates(tmp_path):
+    d = tmp_path / "ckpt"
+    for step in (2, 4):
+        sd = d / str(step) / "state"
+        sd.mkdir(parents=True)
+        (sd / "data.bin").write_bytes(b"x" * 300)
+    assert chaos.corrupt_newest_checkpoint(str(d)) == 4
+    assert (d / "4" / "state" / "data.bin").stat().st_size == 100
+    assert (d / "2" / "state" / "data.bin").stat().st_size == 300
+
+
+# ---------- loadgen: failed-cleanly vs wedged (satellite) ----------
+
+def _serve(engine):
+    server = make_server(engine, 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, f"http://127.0.0.1:{port}"
+
+
+def test_loadgen_structured_error_count_and_exit(model, capsys):
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=256,
+                              prefill_chunk=0)
+    server, url = _serve(engine)
+    try:
+        # Oversized prompts fail validation -> structured errors on
+        # the stream, which is "failed cleanly", exit 3 not 1.
+        rc = loadgen.main(["--url", url, "--requests", "2",
+                           "--concurrency", "1", "--prompt-len", "2000",
+                           "--stream"])
+        out = capsys.readouterr().out
+        assert rc == 3
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["structured_errors"] == 2
+        assert summary["hung_streams"] == 0
+        assert summary["transport_errors"] == 0
+        assert summary["errors"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        engine.stop()
+
+
+def test_loadgen_hung_stream_detection(model, capsys):
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=256,
+                              prefill_chunk=0)
+    server, url = _serve(engine)
+    try:
+        # Warm the jits so the hang is the only stall in the run.
+        engine.submit(list(range(1, 5)), 2, 0.0).result(timeout=120)
+        engine.fault_hang_s = 6.0
+        rc = loadgen.main(["--url", url, "--requests", "1",
+                           "--concurrency", "1", "--prompt-len", "4",
+                           "--max-new-tokens", "4", "--stream",
+                           "--stall-timeout-s", "1.5"])
+        out = capsys.readouterr().out
+        assert rc == 3
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["hung_streams"] == 1
+        assert summary["structured_errors"] == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        engine.stop()
+
+
+def test_loadgen_stall_timeout_requires_stream():
+    with pytest.raises(SystemExit):
+        loadgen.main(["--stall-timeout-s", "5", "--requests", "1"])
+
+
+# ---------- headline e2e 1: worker kill mid-decode ----------
+
+def _submit_stream(engine, prompt_len=8, max_new=400):
+    stream: queue.Queue = queue.Queue()
+    fut = engine.submit(list(range(1, prompt_len + 1)), max_new, 0.0,
+                        stream=stream)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        ev = stream.get(timeout=120)
+        if "token" in ev or "error" in ev:
+            return fut, stream, ev
+    raise AssertionError("no first token")
+
+
+def test_e2e_worker_kill_supervised_restart(model, tmp_path):
+    """Acceptance: worker killed mid-decode with slots occupied ->
+    in-flight requests fail with structured errors (no silent hang),
+    slots AND KV pages fully reclaimed (allocator + gauges at
+    baseline), and the supervised restart serves new requests."""
+    params, cfg = model
+    engine = PagedContinuousEngine(
+        params, cfg, max_slots=2, max_len=512, page=64, pool_pages=9,
+        prefix_cap=0, prefill_chunk=0)
+    rec = engine.recorder
+    sup = EngineSupervisor(engine, backoff_base_s=0.05,
+                           poll_interval_s=0.05)
+    listener = None
+    try:
+        # Warm the jits, then occupy both slots with long decodes.
+        engine.submit(list(range(1, 9)), 4, 0.0).result(timeout=120)
+        fut1, stream1, _ = _submit_stream(engine)
+        fut2, stream2, _ = _submit_stream(engine)
+        assert engine._alloc.pages_in_use > 0
+        sup.start()
+
+        # The kill arrives through the REAL injection path.
+        flog = str(tmp_path / "faults.jsonl")
+        listener = FaultListener(flog, engine=engine, interval_s=0.05)
+        listener.start()
+        assert inject_fault.main(["--kind", "worker-kill",
+                                  "--fault-log", flog]) == 0
+
+        # Supervised recovery: both futures fail with structured
+        # errors...
+        with pytest.raises(Exception, match="supervised recovery"):
+            fut1.result(timeout=60)
+        with pytest.raises(Exception):
+            fut2.result(timeout=60)
+
+        def last_event(stream):
+            ev = None
+            while True:
+                try:
+                    ev = stream.get_nowait()
+                except queue.Empty:
+                    return ev
+
+        for stream in (stream1, stream2):
+            ev = last_event(stream)
+            assert ev is not None and "error" in ev, ev
+        # ...the worker restarts...
+        assert _wait_for(lambda: engine.worker_restarts >= 1
+                         and engine.thread.is_alive(), timeout=60)
+        assert sup.restarts >= 1
+        # ...pages and slots are reclaimed, not leaked...
+        assert _wait_for(lambda: engine._alloc.pages_in_use == 0,
+                         timeout=60)
+        assert engine._alloc.outstanding_rows() == {}
+        assert rec.active_slots._value.get() == 0
+        assert rec.kv_pages_in_use._value.get() == 0
+        assert rec.worker_restarts._value.get() >= 1
+        # ...and the restarted worker serves new requests.
+        out = engine.submit(list(range(1, 9)), 4, 0.0).result(timeout=120)
+        assert len(out) == 12
+        assert _wait_for(lambda: engine._alloc.pages_in_use == 0,
+                         timeout=60)
+    finally:
+        if listener is not None:
+            listener.stop()
+        sup.stop()
+        engine.stop()
+
+
+def test_supervisor_ignores_deliberate_stop(model):
+    """engine.stop() is not a death: the supervisor must not fail the
+    recorder state or restart a deliberately stopped worker."""
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=256,
+                              prefill_chunk=0)
+    sup = EngineSupervisor(engine, backoff_base_s=0.05,
+                           poll_interval_s=0.05)
+    sup.start()
+    engine.stop()
+    assert _wait_for(lambda: not engine.thread.is_alive(), timeout=30)
+    time.sleep(0.3)
+    assert sup.restarts == 0
+    assert engine.worker_restarts == 0
+    sup.stop()
+
+
+def test_supervisor_gives_up_after_max_restarts(model):
+    """Bounded backoff: a worker that dies on arrival exhausts the
+    restart budget and the supervisor stops flapping, loudly."""
+    params, cfg = model
+    engine = ContinuousEngine(params, cfg, max_slots=2, max_len=256,
+                              prefill_chunk=0)
+    sup = EngineSupervisor(engine, backoff_base_s=0.01,
+                           backoff_cap_s=0.02, max_restarts=2,
+                           poll_interval_s=0.02)
+    try:
+        # Every restarted worker is killed again on its next loop top.
+        def rekill():
+            while not engine._stop.is_set() and not sup.gave_up:
+                engine.fault_kill = True
+                time.sleep(0.01)
+        t = threading.Thread(target=rekill, daemon=True)
+        engine.fault_kill = True
+        sup.start()
+        t.start()
+        assert _wait_for(lambda: sup.gave_up, timeout=60)
+        assert sup.restarts <= 2
+    finally:
+        sup.stop()
+        engine.stop()
+
+
+# ---------- headline e2e 2: kill during checkpoint save ----------
+
+def test_e2e_kill_during_checkpoint_save_resumes(tmp_path):
+    """Acceptance: SIGKILL mid-run + a torn newest checkpoint; the
+    restarted run must fall back to the previous checkpoint, resume,
+    and reach the full step target — charging the gap to the restore
+    badput bucket (the wreckage is quarantined, not fatal)."""
+    ckpt = str(tmp_path / "ckpt")
+    # XLA_FLAGS pinned empty: the conftest's 8-virtual-device flag
+    # would otherwise leak in and break batch/fsdp divisibility.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    argv = [sys.executable, "-m",
+            "container_engine_accelerators_tpu.cli.train",
+            "--steps", "30", "--batch-size", "4", "--seq-len", "16",
+            "--ckpt-dir", ckpt, "--save-every", "2", "--log-every", "5"]
+
+    def steps():
+        if not os.path.isdir(ckpt):
+            return []
+        return sorted(int(n) for n in os.listdir(ckpt) if n.isdigit())
+
+    proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        assert _wait_for(lambda: len(steps()) >= 2, timeout=240), \
+            "checkpoints never appeared"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    corrupted = chaos.corrupt_newest_checkpoint(ckpt)
+    good = [s for s in steps() if s < corrupted]
+    assert good, "need an older checkpoint to fall back to"
+
+    out = subprocess.run(argv, cwd=REPO, env=env, capture_output=True,
+                         text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["final_step"] == 30
+    assert summary["goodput"]["restore"] > 0, \
+        "resume must be charged to the restore badput bucket"
+    # The run resumed from the previous (good) checkpoint, the torn
+    # one was quarantined out of the numeric namespace.
+    assert f"resumed from step {max(good)}" in out.stderr, \
+        out.stderr[-2000:]
+    assert any(".corrupt" in n for n in os.listdir(ckpt))

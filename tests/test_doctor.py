@@ -649,3 +649,142 @@ def test_loadgen_slo_gate_pass_and_fail(model, capsys):
 def test_loadgen_slo_requires_stream():
     with pytest.raises(SystemExit):
         loadgen.main(["--slo-ttft-p99-ms", "100", "--requests", "1"])
+
+
+# ---------- FaultListener tail robustness + new kinds (ISSUE 9) ----------
+
+def _fl_wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_fault_listener_torn_tail_and_rotation(tmp_path):
+    """A torn final JSONL line (partial O_APPEND write) must be
+    skipped and re-read once completed; a truncated/rotated fault log
+    must reset the tail — the listener thread never crashes."""
+    import types
+
+    flog = tmp_path / "faults.jsonl"
+    eng = types.SimpleNamespace(fault_hang_s=0.0, fault_kill=False)
+    listener = FaultListener(str(flog), engine=eng, interval_s=0.02)
+    listener.start()
+    try:
+        with open(flog, "a") as f:
+            f.write(json.dumps({"kind": "hang", "seconds": 1.5}) + "\n")
+            f.write('{"kind": "hang", "seconds": 9')  # torn tail
+        assert _fl_wait(lambda: eng.fault_hang_s == 1.5)
+        time.sleep(0.2)
+        # The torn record must NOT have been parsed or applied.
+        assert eng.fault_hang_s == 1.5
+        with open(flog, "a") as f:
+            f.write(".5}\n")  # the append completes the record
+        assert _fl_wait(lambda: eng.fault_hang_s == 9.5)
+        # Rotation: the file shrinks; the tail resets and reads the
+        # fresh content instead of wedging on a stale offset.
+        flog.write_text(json.dumps({"kind": "worker_kill"}) + "\n")
+        assert _fl_wait(lambda: eng.fault_kill)
+        assert listener._thread.is_alive()
+    finally:
+        listener.stop()
+
+
+def test_fault_listener_survives_malformed_and_unknown(tmp_path):
+    import types
+
+    flog = tmp_path / "faults.jsonl"
+    eng = types.SimpleNamespace(fault_hang_s=0.0, fault_kill=False)
+    listener = FaultListener(str(flog), engine=eng, interval_s=0.02)
+    listener.start()
+    try:
+        with open(flog, "a") as f:
+            f.write("not json at all\n")
+            f.write(json.dumps({"kind": "warp-core-breach"}) + "\n")
+            f.write(json.dumps({"no_kind": True}) + "\n")
+            f.write(json.dumps({"kind": "hang", "seconds": 2.5}) + "\n")
+        assert _fl_wait(lambda: eng.fault_hang_s == 2.5)
+        assert listener._thread.is_alive()
+    finally:
+        listener.stop()
+
+
+def test_fault_listener_data_stall_and_straggler_arm_dataset_hook(
+        tmp_path):
+    from container_engine_accelerators_tpu.training import dataset
+
+    flog = tmp_path / "faults.jsonl"
+    listener = FaultListener(str(flog), interval_s=0.02)
+    listener.start()
+    try:
+        with open(flog, "a") as f:
+            f.write(json.dumps({"kind": "data_stall",
+                                "seconds": 0.05}) + "\n")
+        assert _fl_wait(lambda: dataset._STALL["once_s"] > 0)
+        assert dataset.maybe_stall() >= 0.05
+        assert dataset.maybe_stall() == 0.0  # one-shot consumed
+        with open(flog, "a") as f:
+            f.write(json.dumps({"kind": "straggler", "delay_s": 0.02,
+                                "seconds": 30}) + "\n")
+        assert _fl_wait(lambda: dataset._STALL["per_batch_s"] > 0)
+        assert dataset.maybe_stall() >= 0.02
+        assert dataset.maybe_stall() >= 0.02  # persistent until expiry
+    finally:
+        listener.stop()
+        from container_engine_accelerators_tpu.training.dataset import (
+            clear_stall,
+        )
+        clear_stall()
+
+
+def test_inject_fault_new_kinds_write_commands(tmp_path):
+    flog = tmp_path / "faults.jsonl"
+    assert inject_fault.main(["--kind", "worker-kill",
+                              "--fault-log", str(flog)]) == 0
+    assert inject_fault.main(["--kind", "data-stall", "--seconds", "2",
+                              "--fault-log", str(flog)]) == 0
+    assert inject_fault.main(["--kind", "straggler", "--delay", "0.5",
+                              "--seconds", "7",
+                              "--fault-log", str(flog)]) == 0
+    assert inject_fault.main(["--kind", "health-tail", "--path",
+                              str(tmp_path / "errors.jsonl"),
+                              "--seconds", "3",
+                              "--fault-log", str(flog)]) == 0
+    recs = [json.loads(line) for line in flog.read_text().splitlines()]
+    assert recs[0] == {"kind": "worker_kill"}
+    assert recs[1] == {"kind": "data_stall", "seconds": 2.0}
+    assert recs[2] == {"kind": "straggler", "delay_s": 0.5,
+                       "seconds": 7.0}
+    assert recs[3]["kind"] == "health_tail" and recs[3]["seconds"] == 3.0
+    with pytest.raises(SystemExit):
+        inject_fault.main(["--kind", "health-tail",
+                           "--fault-log", str(flog)])  # --path required
+
+
+def test_fault_listener_health_tail_runs_real_pipeline(tmp_path):
+    """health_tail: a real TPUHealthChecker tails the injected error
+    feed inside the listener — health/<class> instants land on the
+    bus, the chaos health-storm scenario's detection surface."""
+    events.enable(process_name="health-tail-test")
+    elog = tmp_path / "errors.jsonl"
+    flog = tmp_path / "faults.jsonl"
+    listener = FaultListener(str(flog), interval_s=0.02)
+    listener.start()
+    try:
+        with open(flog, "a") as f:
+            f.write(json.dumps({"kind": "health_tail",
+                                "path": str(elog),
+                                "seconds": 5.0,
+                                "interval": 0.05}) + "\n")
+        for _ in range(3):
+            assert inject_fault.main(
+                ["--error-log", str(elog), "--chip", "0",
+                 "--error-class", "ICI_LINK_DOWN"]) == 0
+        def health_events():
+            return [ev for ev in events.get_bus().snapshot()
+                    if ev is not None and ev[3] == "health/ICI_LINK_DOWN"]
+        assert _fl_wait(lambda: len(health_events()) >= 3)
+    finally:
+        listener.stop()

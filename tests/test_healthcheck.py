@@ -435,3 +435,40 @@ def test_health_events_on_flight_recorder(tmp_path, fake_k8s, client):
         assert evs[0][7]["critical"] is True
     finally:
         events._reset_for_tests()
+
+
+def test_maybe_reset_condition_backoff_and_attempt_cap(tmp_path,
+                                                       monkeypatch):
+    """ISSUE 9 satellite: under a sustained API-server error storm the
+    reboot-reset path retries with exponential backoff and a hard
+    attempt cap — it must bound checker startup, not spin or sleep
+    past the final attempt."""
+    from container_engine_accelerators_tpu.healthcheck import (
+        health_checker as hc_mod,
+    )
+
+    m, dev = make_manager(tmp_path)
+
+    class ExplodingK8s:
+        def __init__(self):
+            self.calls = 0
+
+        def get_node(self, name):
+            self.calls += 1
+            raise RuntimeError("api server down")
+
+    k8s = ExplodingK8s()
+    checker, _, _ = make_checker(tmp_path, m, k8s)
+    sleeps = []
+    monkeypatch.setattr(hc_mod.time, "sleep",
+                        lambda s: sleeps.append(s))
+
+    checker.maybe_reset_condition()
+    assert k8s.calls == 3, "attempt cap must bound the retries"
+    # 2**attempt between attempts; NO sleep after the final one.
+    assert sleeps == [1, 2]
+
+    k8s.calls, sleeps[:] = 0, []
+    checker.maybe_reset_condition(max_attempts=5)
+    assert k8s.calls == 5
+    assert sleeps == [1, 2, 4, 8]

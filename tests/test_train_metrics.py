@@ -256,6 +256,8 @@ def test_fit_exposes_metrics_and_crash_safe_log(tmp_path, mesh8):
                 # The exporter line went through log_fn before step 0.
                 port = int(re.search(r":(\d+)/metrics", logs[0]).group(1))
                 seen["body"] = scrape(port)
+                seen["hb_live"] = os.path.exists(
+                    os.path.join(hb, "hb-0"))
             yield b
 
     state, _ = fit(cfg, mesh8, opt, batches(), metrics_port=0,
@@ -281,7 +283,11 @@ def test_fit_exposes_metrics_and_crash_safe_log(tmp_path, mesh8):
     assert all(r["tokens"] == 8 * 32 for r in steps)
     # Loss is recorded at log boundaries (log_every=2: steps 1, 3, 5).
     assert "loss" in steps[0] and "loss" in steps[2]
-    assert os.path.exists(os.path.join(hb, "hb-0"))
+    # Heartbeat: alive mid-run, DEREGISTERED on clean shutdown (a
+    # finished process must not age into a phantom straggler —
+    # TrainRecorder.close removes its hb file; ISSUE 9).
+    assert seen["hb_live"] is True
+    assert not os.path.exists(os.path.join(hb, "hb-0"))
 
 
 def test_train_cli_tiny_smoke(tmp_path, capsys):

@@ -1,0 +1,960 @@
+"""chaos — scripted fault schedules against real serve/train
+workloads, with recovery-SLO assertions (ISSUE 9 tentpole; ROADMAP
+open item 4).
+
+Every defense mechanism this repo grew — auto-resume `fit`, the
+HangWatchdog, TPUHealthChecker, OOM forensics, the tpu-doctor and its
+`FaultListener`/`inject_fault` injection half, `serve --supervise` —
+exists to make a fault survivable. This harness is the thing that
+systematically ATTACKS them: each scenario under `chaos/scenarios/`
+declares a workload (a real `serve` or `train` subprocess on the CPU
+backend), a scripted fault schedule (fault-log injections, SIGKILLs,
+checkpoint corruption, health-error storms), and a set of recovery
+SLOs that are ASSERTED, not observed:
+
+  (a) diagnosis  — the merged flight-recorder timeline replayed
+      through the tpu-doctor registry (metrics/doctor.py, the same
+      detectors a live `--doctor` runs) yields EXACTLY the expected
+      incident classes, one bundle each, and nothing before the first
+      fault landed (clean phases stay quiet);
+  (b) serving    — loadgen outcome accounting: failed requests
+      surface structured `{"error": ...}` events (never silent
+      stream hangs), and the recorder's slot/KV-page occupancy
+      gauges return to baseline afterward (zero leaks);
+  (c) training   — the run reaches its step target across the fault,
+      charging the gap to the goodput badput buckets (restore /
+      stalled), i.e. resume-within-N-steps is machine-checked;
+  (d) artifact   — every scenario writes a merged flight-recorder
+      timeline (the `trace merge` output) plus the doctor incident
+      bundles and a report.json, so a red run is a post-mortem kit,
+      not a log grep.
+
+This is the reference repo's nccl-test / node-problem-detector
+verdict role (PAPER.md §L2/L3) done TPU-native: prove the node
+recovers, don't just watch it fail.
+
+Usage:
+  python tools/chaos.py list
+  python tools/chaos.py run --all            # full matrix (slow tier)
+  python tools/chaos.py run --smoke          # the fast CI subset
+  python tools/chaos.py run engine-hang worker-kill
+Exit 0 = every scenario passed its assertions; 2 = any failed.
+
+Everything is CPU-hermetic (JAX_PLATFORMS=cpu, tiny model, no
+network beyond loopback) and bounded by per-scenario timeouts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from container_engine_accelerators_tpu.cli import loadgen  # noqa: E402
+from container_engine_accelerators_tpu.metrics import (  # noqa: E402
+    doctor,
+    events,
+)
+
+log = logging.getLogger("tpu-chaos")
+
+SCENARIO_DIR = os.path.join(_REPO, "chaos", "scenarios")
+
+_WORKLOAD_KINDS = ("serve", "train")
+_ACTIONS = ("sleep", "warmup", "loadgen", "loadgen_start", "loadgen_wait",
+            "inject", "health_errors", "kill", "start", "wait_exit",
+            "wait_ckpt_steps", "corrupt_newest_ckpt")
+_ASSERT_KEYS = ("doctor", "serve_gauges_baseline", "healthz",
+                "timeline_require", "train")
+# Actions that mark the end of the clean phase: the first one to run
+# stamps fault_start, and the doctor assertion rejects any incident
+# diagnosed before it.
+_FAULT_ACTIONS = ("inject", "health_errors", "kill",
+                  "corrupt_newest_ckpt")
+
+
+class ScenarioError(ValueError):
+    """A scenario file that doesn't match the schema."""
+
+
+# ---------- scenario schema ----------
+
+def load_scenario(path: str) -> dict:
+    """Parse + validate one scenario file; raises ScenarioError with
+    the offending key on any schema violation (tests validate every
+    shipped scenario through this)."""
+    with open(path) as f:
+        try:
+            sc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ScenarioError(f"{path}: not valid JSON: {e}") from e
+    for key in ("name", "workloads", "phases", "asserts"):
+        if key not in sc:
+            raise ScenarioError(f"{path}: missing required key {key!r}")
+    ids = set()
+    for w in sc["workloads"]:
+        if w.get("kind") not in _WORKLOAD_KINDS:
+            raise ScenarioError(
+                f"{sc['name']}: workload kind must be one of "
+                f"{_WORKLOAD_KINDS}, got {w.get('kind')!r}")
+        wid = w.get("id", w["kind"])
+        if wid in ids:
+            raise ScenarioError(f"{sc['name']}: duplicate workload id "
+                                f"{wid!r}")
+        ids.add(wid)
+        if w["kind"] == "serve" and w.get("engine") not in (
+                "window", "continuous", "paged"):
+            raise ScenarioError(
+                f"{sc['name']}: serve workload needs engine "
+                "window|continuous|paged")
+    lg_ids = set()
+    for ph in sc["phases"]:
+        act = ph.get("action")
+        if act not in _ACTIONS:
+            raise ScenarioError(
+                f"{sc['name']}: unknown action {act!r} (known: "
+                f"{_ACTIONS})")
+        tgt = ph.get("target")
+        if tgt is not None and tgt not in ids:
+            raise ScenarioError(
+                f"{sc['name']}: action {act} targets unknown workload "
+                f"{tgt!r}")
+        if act == "loadgen_start":
+            lg_ids.add(ph.get("id", "bg"))
+        if act == "loadgen_wait" and ph.get("id", "bg") not in lg_ids:
+            raise ScenarioError(
+                f"{sc['name']}: loadgen_wait for unknown id "
+                f"{ph.get('id', 'bg')!r}")
+    for key in sc["asserts"]:
+        if key not in _ASSERT_KEYS:
+            raise ScenarioError(
+                f"{sc['name']}: unknown assert {key!r} (known: "
+                f"{_ASSERT_KEYS})")
+    doc = sc["asserts"].get("doctor")
+    if doc is not None:
+        for cls, spec in doc.get("expect", {}).items():
+            if not isinstance(spec, (int, dict)):
+                raise ScenarioError(
+                    f"{sc['name']}: doctor expect[{cls}] must be a "
+                    "count or {count, subject}")
+    return sc
+
+
+def discover_scenarios(names=None, smoke=False) -> list[dict]:
+    out = []
+    for fn in sorted(os.listdir(SCENARIO_DIR)):
+        if not fn.endswith(".json"):
+            continue
+        sc = load_scenario(os.path.join(SCENARIO_DIR, fn))
+        if names and sc["name"] not in names:
+            continue
+        if smoke and "smoke" not in sc.get("tags", []):
+            continue
+        out.append(sc)
+    if names:
+        missing = set(names) - {sc["name"] for sc in out}
+        if missing:
+            raise ScenarioError(f"unknown scenario(s): {sorted(missing)}")
+    return out
+
+
+# ---------- assertion engine (pure: unit-tested in isolation) ----------
+
+def _result(name: str, ok: bool, detail: str) -> dict:
+    return {"name": name, "ok": bool(ok), "detail": detail}
+
+
+def check_doctor(incidents: list[dict], spec: dict,
+                 fault_start: float | None) -> list[dict]:
+    """(a) diagnosis: exactly the expected incident classes fired —
+    one bundle per (class, subject) episode — nothing unexpected, and
+    nothing during the clean phase (before `fault_start`, in TRACE
+    time: replay incidents carry the origin-shifted timeline clock in
+    `ts_monotonic`, so the caller converts the epoch fault stamp via
+    the timeline's `epoch_origin_us` first)."""
+    out = []
+    expect = spec.get("expect", {})
+    allow = set(spec.get("allow", []))
+    by_cls: dict[str, list[dict]] = {}
+    for inc in incidents:
+        by_cls.setdefault(inc["class"], []).append(inc)
+    for cls, want in expect.items():
+        want_n = want if isinstance(want, int) else want.get("count", 1)
+        got = by_cls.get(cls, [])
+        out.append(_result(
+            f"doctor.{cls}", len(got) == want_n,
+            f"expected exactly {want_n} {cls} incident(s), got "
+            f"{len(got)}"))
+        if isinstance(want, dict) and want.get("subject") is not None:
+            subjects = sorted({i["subject"] for i in got})
+            out.append(_result(
+                f"doctor.{cls}.subject",
+                bool(got) and all(i["subject"] == want["subject"]
+                                  for i in got),
+                f"expected subject {want['subject']!r}, got {subjects}"))
+    unexpected = [c for c in by_cls
+                  if c not in expect and c not in allow]
+    out.append(_result(
+        "doctor.no_unexpected", not unexpected,
+        f"unexpected incident classes: {unexpected}" if unexpected
+        else "no unexpected incident classes"))
+    if fault_start is not None:
+        early = [(i["class"], i["ts_monotonic"]) for i in incidents
+                 if i["class"] not in allow
+                 and i["ts_monotonic"] < fault_start - 0.5]
+        out.append(_result(
+            "doctor.clean_phase_quiet", not early,
+            f"incidents before the first fault (t={fault_start:.1f}): "
+            f"{early}" if early else
+            "zero incidents before the first fault"))
+    return out
+
+
+def _check_count(name: str, got: int, want) -> dict:
+    """`want` is an exact int or {"min": x, "max": y}."""
+    if isinstance(want, int):
+        return _result(name, got == want, f"expected {want}, got {got}")
+    lo = want.get("min", 0)
+    hi = want.get("max")
+    ok = got >= lo and (hi is None or got <= hi)
+    return _result(name, ok,
+                   f"expected [{lo}, {hi if hi is not None else 'inf'}]"
+                   f", got {got}")
+
+
+def check_loadgen(summary: dict, rc: int, expect: dict,
+                  label: str = "loadgen") -> list[dict]:
+    """(b) serving: outcome accounting — structured errors vs hung
+    streams vs transport, plus ok counts and the SLO verdict."""
+    out = []
+    for key in ("requests_ok", "structured_errors", "hung_streams",
+                "transport_errors", "errors"):
+        if key in expect:
+            out.append(_check_count(f"{label}.{key}",
+                                    int(summary.get(key, 0)),
+                                    expect[key]))
+    if "slo_pass" in expect:
+        got = all(v["ok"] for v in summary.get("slo", {}).values())
+        out.append(_result(f"{label}.slo_pass",
+                           got == bool(expect["slo_pass"]),
+                           f"slo block: {summary.get('slo')}"))
+    if "exit_in" in expect:
+        out.append(_result(f"{label}.exit", rc in expect["exit_in"],
+                           f"exit {rc}, expected one of "
+                           f"{expect['exit_in']}"))
+    return out
+
+
+def parse_gauge(metrics_text: str, name: str) -> float | None:
+    """Last sample of an unlabelled gauge in Prometheus text format."""
+    val = None
+    for line in metrics_text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                val = float(line.split()[1])
+            except (IndexError, ValueError):
+                continue
+    return val
+
+
+def check_gauges_baseline(metrics_text: str) -> list[dict]:
+    """(b) leak check: after recovery + drain, slot and KV-page
+    occupancy must be back to zero — reclaimed, not abandoned."""
+    out = []
+    for g in ("serve_active_slots", "serve_kv_pages_in_use"):
+        v = parse_gauge(metrics_text, g)
+        if v is None:
+            # A scrape without the family at all (window engine has no
+            # kv pages) counts as baseline.
+            out.append(_result(f"gauges.{g}", True, "family absent"))
+            continue
+        out.append(_result(f"gauges.{g}", v == 0.0,
+                           f"{g}={v} after recovery (leak)"))
+    return out
+
+
+def check_healthz(body: dict, expect: dict) -> list[dict]:
+    out = []
+    if "worker_restarts_min" in expect:
+        got = int(body.get("worker_restarts", 0))
+        out.append(_result(
+            "healthz.worker_restarts", got >= expect["worker_restarts_min"],
+            f"worker_restarts={got}, need >= "
+            f"{expect['worker_restarts_min']}"))
+    if "worker_alive" in expect:
+        out.append(_result(
+            "healthz.worker_alive",
+            bool(body.get("worker_alive")) == bool(expect["worker_alive"]),
+            f"worker_alive={body.get('worker_alive')}"))
+    return out
+
+
+def check_train(summary: dict | None, spec: dict,
+                label: str = "train") -> list[dict]:
+    """(c) training: step target reached across the fault, with the
+    gap charged to the named badput buckets."""
+    out = []
+    if summary is None:
+        return [_result(f"{label}.summary", False,
+                        "no final summary line from the train run")]
+    if "final_step_at_least" in spec:
+        got = int(summary.get("final_step", -1))
+        out.append(_result(
+            f"{label}.final_step", got >= spec["final_step_at_least"],
+            f"final_step={got}, need >= {spec['final_step_at_least']}"))
+    g = summary.get("goodput", {})
+    for bucket, min_s in spec.get("badput_min_s", {}).items():
+        got = float(g.get(bucket, 0.0))
+        out.append(_result(
+            f"{label}.badput.{bucket}", got >= min_s,
+            f"goodput[{bucket}]={got:.3f}s, need >= {min_s}s "
+            "(the fault's cost must be attributed, not hidden)"))
+    if spec.get("resumed"):
+        got = float(g.get("restore", 0.0))
+        out.append(_result(
+            f"{label}.resumed", got > 0.0,
+            f"goodput[restore]={got:.3f}s (0 means the run never "
+            "restored a checkpoint)"))
+    return out
+
+
+def check_timeline(trace: dict, require: list[str]) -> list[dict]:
+    names = {e.get("name") for e in trace.get("traceEvents", [])}
+    out = []
+    for req in require:
+        out.append(_result(
+            f"timeline.{req}", req in names,
+            f"event {req!r} {'present' if req in names else 'MISSING'} "
+            "on the merged timeline"))
+    return out
+
+
+# ---------- workload drivers ----------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _sub(value, subs: dict):
+    """Recursive $TOKEN substitution through scenario params."""
+    if isinstance(value, str):
+        for k, v in subs.items():
+            value = value.replace(k, v)
+        return value
+    if isinstance(value, list):
+        return [_sub(v, subs) for v in value]
+    if isinstance(value, dict):
+        return {k: _sub(v, subs) for k, v in value.items()}
+    return value
+
+
+class Workload:
+    """One serve/train subprocess plus its per-scenario file plumbing
+    (fault log, trace dumps, stdout/err captures, metrics log)."""
+
+    def __init__(self, spec: dict, out_dir: str, subs: dict):
+        self.spec = spec
+        self.kind = spec["kind"]
+        self.id = spec.get("id", self.kind)
+        self.out_dir = out_dir
+        self.subs = subs
+        self.fault_log = os.path.join(out_dir, f"faults-{self.id}.jsonl")
+        self.trace_dir = os.path.join(out_dir, "traces")
+        os.makedirs(self.trace_dir, exist_ok=True)
+        self.port = _free_port() if self.kind == "serve" else None
+        self.metrics_port = _free_port() if self.kind == "serve" else None
+        self.metrics_log = (os.path.join(out_dir, f"steps-{self.id}.jsonl")
+                            if self.kind == "train" else None)
+        self.proc: subprocess.Popen | None = None
+        self.runs = 0
+        self.pids: list[int] = []
+        self.stdout_paths: list[str] = []
+
+    # -- command construction --
+
+    def _argv(self) -> list[str]:
+        extra = [str(a) for a in _sub(self.spec.get("args", []), self.subs)]
+        if self.kind == "serve":
+            argv = [sys.executable, "-m",
+                    "container_engine_accelerators_tpu.cli.serve",
+                    "--tiny", "--port", str(self.port),
+                    "--engine", self.spec["engine"],
+                    "--metrics-port", str(self.metrics_port),
+                    "--trace-dump", self.trace_dir,
+                    "--fault-listen", self.fault_log]
+            if self.spec.get("supervise"):
+                argv += ["--supervise", "--supervise-backoff",
+                         str(self.spec.get("supervise_backoff", 0.5))]
+            return argv + extra
+        argv = [sys.executable, "-m",
+                "container_engine_accelerators_tpu.cli.train",
+                "--trace-dump", self.trace_dir,
+                "--fault-listen", self.fault_log,
+                "--metrics-log", self.metrics_log,
+                "--log-every", "2"]
+        if self.spec.get("heartbeat"):
+            argv += ["--heartbeat-dir",
+                     os.path.join(self.out_dir, "hb"),
+                     "--watchdog-threshold",
+                     str(self.spec.get("watchdog_threshold_s", 2.0))]
+        return argv + extra
+
+    def start(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise RuntimeError(f"workload {self.id} already running")
+        self.runs += 1
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # Hermetic device topology: a caller environment that forces a
+        # virtual multi-device CPU (the pytest conftest exports
+        # XLA_FLAGS=--xla_force_host_platform_device_count=8) would
+        # change the workload's mesh and break batch divisibility —
+        # scenarios must behave identically from any shell.
+        env["XLA_FLAGS"] = str(self.spec.get("xla_flags", ""))
+        env.update({k: str(v) for k, v in
+                    _sub(self.spec.get("env", {}), self.subs).items()})
+        stdout_path = os.path.join(self.out_dir,
+                                   f"{self.id}-run{self.runs}.stdout")
+        stderr_path = os.path.join(self.out_dir,
+                                   f"{self.id}-run{self.runs}.stderr")
+        self.stdout_paths.append(stdout_path)
+        self._stdout_f = open(stdout_path, "wb")
+        self._stderr_f = open(stderr_path, "wb")
+        self.proc = subprocess.Popen(
+            self._argv(), cwd=_REPO, env=env,
+            stdout=self._stdout_f, stderr=self._stderr_f)
+        self.pids.append(self.proc.pid)
+        log.info("[%s] started run %d (pid %d)", self.id, self.runs,
+                 self.proc.pid)
+
+    def wait_ready(self, timeout_s: float = 180.0) -> None:
+        """Serve: poll /healthz until the server answers. Train is
+        'ready' once started (its loop begins immediately)."""
+        if self.kind != "serve":
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"workload {self.id} exited rc={self.proc.returncode}"
+                    " before becoming ready")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/healthz",
+                        timeout=2) as r:
+                    if json.loads(r.read()).get("ok"):
+                        return
+            except Exception:
+                time.sleep(0.3)
+        raise RuntimeError(f"workload {self.id} never became ready")
+
+    # -- live queries --
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def scrape_metrics(self) -> str:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.metrics_port}/metrics",
+                timeout=10) as r:
+            return r.read().decode()
+
+    def healthz(self) -> dict:
+        with urllib.request.urlopen(self.url() + "/healthz",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    # -- teardown / artifacts --
+
+    def request_dump(self) -> None:
+        """SIGUSR2 -> the process writes its ring to the trace dir
+        (serve never exits cleanly, so this is its only dump path)."""
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                os.kill(self.proc.pid, signal.SIGUSR2)
+            except OSError:
+                pass
+
+    def dump_paths(self) -> list[str]:
+        return [os.path.join(self.trace_dir, f)
+                for f in sorted(os.listdir(self.trace_dir))
+                if f.endswith(".json")]
+
+    def kill(self, sig=signal.SIGKILL) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=30)
+        log.info("[%s] killed with %s", self.id, sig)
+
+    def wait_exit(self, timeout_s: float) -> int:
+        rc = self.proc.wait(timeout=timeout_s)
+        self._stdout_f.flush()
+        self._stderr_f.flush()
+        return rc
+
+    def shutdown(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        # SIGTERM skips atexit, so ask for a SIGUSR2 ring dump first
+        # and give the handler a beat to write it (both CLIs arm the
+        # handler when --trace-dump is set).
+        self.request_dump()
+        deadline = time.monotonic() + 10
+        pid = self.proc.pid
+        want = os.path.join(self.trace_dir, f"trace-{pid}.json")
+        while time.monotonic() < deadline and \
+                not os.path.exists(want):
+            time.sleep(0.2)
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    def last_summary(self) -> dict | None:
+        """Last JSON line of the most recent run's stdout (the train
+        CLI's machine-readable summary)."""
+        if not self.stdout_paths:
+            return None
+        try:
+            with open(self.stdout_paths[-1]) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+        except OSError:
+            return None
+        for ln in reversed(lines):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    # -- checkpoint helpers (train) --
+
+    def ckpt_dir(self) -> str | None:
+        args = [str(a) for a in _sub(self.spec.get("args", []), self.subs)]
+        if "--ckpt-dir" in args:
+            return args[args.index("--ckpt-dir") + 1]
+        return None
+
+    def ckpt_steps(self) -> list[int]:
+        d = self.ckpt_dir()
+        if not d or not os.path.isdir(d):
+            return []
+        return sorted(int(n) for n in os.listdir(d) if n.isdigit())
+
+
+def corrupt_newest_checkpoint(ckpt_dir: str) -> int:
+    """Truncate every file under the newest step dir to a prefix —
+    the torn-write wreckage a crash mid-save (or a partial copy)
+    leaves, which CheckpointManager.restore must now skip past.
+    Returns the corrupted step."""
+    steps = sorted(int(n) for n in os.listdir(ckpt_dir) if n.isdigit())
+    if not steps:
+        raise RuntimeError(f"no checkpoint steps under {ckpt_dir}")
+    step_dir = os.path.join(ckpt_dir, str(steps[-1]))
+    n_files = 0
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in files:
+            path = os.path.join(root, fn)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 3))
+            n_files += 1
+    log.info("corrupted checkpoint step %d (%d files truncated)",
+             steps[-1], n_files)
+    return steps[-1]
+
+
+# ---------- scenario runner ----------
+
+class _BgLoadgen:
+    def __init__(self, args_ns):
+        self.summary: dict | None = None
+        self.rc: int | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._args = args_ns
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        try:
+            self.summary, self.rc = loadgen.run(self._args)
+        except Exception as e:  # harness bug, not a workload verdict
+            log.exception("background loadgen crashed")
+            self.summary, self.rc = {"harness_error": str(e)}, -1
+
+    def join(self, timeout_s: float):
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():
+            raise RuntimeError("background loadgen did not finish")
+
+
+def _loadgen_args(url: str, ph: dict) -> "argparse.Namespace":
+    argv = ["--url", url,
+            "--requests", str(ph.get("requests", 4)),
+            "--concurrency", str(ph.get("concurrency", 2)),
+            "--max-new-tokens", str(ph.get("max_new_tokens", 8)),
+            "--prompt-len", str(ph.get("prompt_len", 4)),
+            "--timeout", str(ph.get("timeout_s", 300))]
+    if ph.get("stream", True):
+        argv.append("--stream")
+    if ph.get("stall_timeout_s") is not None:
+        argv += ["--stall-timeout-s", str(ph["stall_timeout_s"])]
+    if ph.get("slo_ttft_p99_ms") is not None:
+        argv += ["--slo-ttft-p99-ms", str(ph["slo_ttft_p99_ms"])]
+    if ph.get("slo_tpot_p99_ms") is not None:
+        argv += ["--slo-tpot-p99-ms", str(ph["slo_tpot_p99_ms"])]
+    return loadgen.make_parser().parse_args(argv)
+
+
+def _doctor_config(spec: dict) -> doctor.DoctorConfig:
+    """Replay config scoped to chaos timescales: windows shrunk to the
+    scenario's seconds, episode re-arm disabled so one fault episode is
+    exactly one incident, SLOs off unless the scenario asks (burn needs
+    traffic volumes chaos runs don't generate)."""
+    window = float(spec.get("window_s", 6.0))
+    cfg = doctor.DoctorConfig(
+        poll_interval_s=float(spec.get("interval_s", 0.5)),
+        fast_window_s=window,
+        slow_window_s=window * 5,
+        hang_after_s=float(spec.get("hang_after_s", min(2.5, window))),
+        hbm_min_samples=4,
+        queue_min_depth=4,
+        health_storm_n=int(spec.get("health_storm_n", 3)),
+        straggler_skew_s=float(spec.get("straggler_skew_s", 60.0)),
+        clear_after_s=1e9,  # one episode per (class, subject) per run
+        slos=[],
+    )
+    if spec.get("goodput_slo"):
+        g = spec["goodput_slo"]
+        cfg.slos = [doctor.SloSpec(
+            "goodput", "goodput", objective=float(g.get("objective", 0.5)),
+            fast_burn=float(g.get("fast_burn", 1.5)),
+            slow_burn=float(g.get("slow_burn", 1.0)))]
+    return cfg
+
+
+class ScenarioRun:
+    def __init__(self, sc: dict, out_root: str):
+        import shutil
+
+        self.sc = sc
+        self.out_dir = os.path.join(out_root, sc["name"])
+        # Fresh artifact dir per run: stale trace dumps, heartbeats or
+        # checkpoints from a previous run would poison the assertions
+        # (a ghost hb file IS a straggler, an old ckpt IS a resume).
+        if os.path.isdir(self.out_dir):
+            shutil.rmtree(self.out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.subs = {
+            "$OUT": self.out_dir,
+            "$CKPT_DIR": os.path.join(self.out_dir, "ckpt"),
+            "$HEALTH_LOG": os.path.join(self.out_dir,
+                                        "health-errors.jsonl"),
+        }
+        self.workloads = {
+            w.get("id", w["kind"]): Workload(w, self.out_dir, self.subs)
+            for w in sc["workloads"]}
+        self.bg: dict[str, _BgLoadgen] = {}
+        self.loadgen_results: list[tuple[str, dict, int, dict]] = []
+        self.fault_start: float | None = None
+        self.results: list[dict] = []
+
+    def _wl(self, ph: dict) -> Workload:
+        tgt = ph.get("target")
+        if tgt is None:
+            tgt = next(iter(self.workloads))
+        return self.workloads[tgt]
+
+    # -- phase execution --
+
+    def _run_phase(self, ph: dict):
+        act = ph["action"]
+        if act in _FAULT_ACTIONS and self.fault_start is None:
+            self.fault_start = time.time()
+        if act == "sleep":
+            time.sleep(float(ph.get("seconds", 1.0)))
+        elif act == "warmup":
+            # Absorb the cold-jit stall before the scenario clock
+            # matters: a few sync requests with generous timeouts.
+            wl = self._wl(ph)
+            args = _loadgen_args(wl.url(), dict(ph, stream=True,
+                                                stall_timeout_s=None))
+            summary, rc = loadgen.run(args)
+            if rc != 0:
+                raise RuntimeError(
+                    f"warmup traffic failed (rc={rc}): {summary}")
+        elif act == "loadgen":
+            wl = self._wl(ph)
+            args = _loadgen_args(wl.url(), ph)
+            summary, rc = loadgen.run(args)
+            self.loadgen_results.append(
+                (ph.get("label", "loadgen"), summary, rc,
+                 ph.get("expect", {})))
+        elif act == "loadgen_start":
+            wl = self._wl(ph)
+            bg = _BgLoadgen(_loadgen_args(wl.url(), ph))
+            self.bg[ph.get("id", "bg")] = bg
+            bg.start()
+        elif act == "loadgen_wait":
+            bg = self.bg[ph.get("id", "bg")]
+            bg.join(float(ph.get("timeout_s", 300)))
+            self.loadgen_results.append(
+                (ph.get("label", ph.get("id", "bg")), bg.summary,
+                 bg.rc, ph.get("expect", {})))
+        elif act == "inject":
+            wl = self._wl(ph)
+            rec = {"kind": ph["kind"].replace("-", "_")}
+            rec.update(_sub({k: v for k, v in ph.items()
+                             if k not in ("action", "target", "kind")},
+                            self.subs))
+            with open(wl.fault_log, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            log.info("[%s] injected %s", wl.id, rec)
+        elif act == "health_errors":
+            from container_engine_accelerators_tpu.cli import inject_fault
+            path = _sub(ph.get("path", "$HEALTH_LOG"), self.subs)
+            for _ in range(int(ph.get("n", 4))):
+                inject_fault.main([
+                    "--error-log", path,
+                    "--chip", str(ph.get("chip", 0)),
+                    "--error-class",
+                    ph.get("error_class", "HBM_ECC_UNCORRECTABLE")])
+                time.sleep(float(ph.get("interval_s", 0.3)))
+        elif act == "kill":
+            wl = self._wl(ph)
+            sig = getattr(signal, "SIG" + ph.get("signal", "KILL"))
+            wl.kill(sig)
+        elif act == "start":
+            self._wl(ph).start()
+            self._wl(ph).wait_ready(
+                float(ph.get("ready_timeout_s", 180)))
+        elif act == "wait_exit":
+            wl = self._wl(ph)
+            rc = wl.wait_exit(float(ph.get("timeout_s", 600)))
+            expect_rc = ph.get("expect_rc")
+            if expect_rc is not None and rc not in expect_rc:
+                self.results.append(_result(
+                    f"{wl.id}.exit_code", False,
+                    f"rc={rc}, expected one of {expect_rc}"))
+            else:
+                self.results.append(_result(
+                    f"{wl.id}.exit_code", True, f"rc={rc}"))
+        elif act == "wait_ckpt_steps":
+            wl = self._wl(ph)
+            need = int(ph.get("min_steps", 2))
+            deadline = time.monotonic() + float(ph.get("timeout_s", 300))
+            while time.monotonic() < deadline:
+                if len(wl.ckpt_steps()) >= need:
+                    return
+                if wl.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{wl.id} exited before writing {need} "
+                        "checkpoints")
+                time.sleep(0.5)
+            raise RuntimeError(
+                f"{wl.id}: {need} checkpoints never appeared "
+                f"(have {wl.ckpt_steps()})")
+        elif act == "corrupt_newest_ckpt":
+            corrupt_newest_checkpoint(self._wl(ph).ckpt_dir())
+
+    # -- the full run --
+
+    def run(self) -> dict:
+        t0 = time.monotonic()
+        try:
+            for wl in self.workloads.values():
+                if wl.spec.get("autostart", True):
+                    wl.start()
+            for wl in self.workloads.values():
+                if wl.proc is not None:
+                    wl.wait_ready()
+            for ph in self.sc["phases"]:
+                log.info("== phase: %s", {k: v for k, v in ph.items()
+                                          if k != "expect"})
+                self._run_phase(ph)
+            self._collect_live_assertions()
+        except Exception as e:
+            log.exception("scenario %s harness failure", self.sc["name"])
+            self.results.append(_result("harness", False,
+                                        f"{type(e).__name__}: {e}"))
+        finally:
+            for wl in self.workloads.values():
+                try:
+                    wl.shutdown()
+                except Exception:
+                    log.exception("shutdown of %s failed", wl.id)
+        timeline = self._merge_timeline()
+        self._offline_assertions(timeline)
+        passed = all(r["ok"] for r in self.results)
+        report = {
+            "scenario": self.sc["name"],
+            "passed": passed,
+            "wall_s": round(time.monotonic() - t0, 1),
+            "fault_start": self.fault_start,
+            "assertions": self.results,
+            "artifacts": {
+                "timeline": os.path.join(self.out_dir, "timeline.json"),
+                "incidents_dir": os.path.join(self.out_dir, "incidents"),
+                "out_dir": self.out_dir,
+            },
+        }
+        tmp = os.path.join(self.out_dir, f"report.json.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2)
+        os.replace(tmp, os.path.join(self.out_dir, "report.json"))
+        return report
+
+    def _collect_live_assertions(self):
+        """Assertions that need the workloads still alive (scrapes)."""
+        asserts = self.sc["asserts"]
+        for label, summary, rc, expect in self.loadgen_results:
+            if expect:
+                self.results.extend(
+                    check_loadgen(summary or {}, rc, expect, label))
+        if asserts.get("serve_gauges_baseline"):
+            for wl in self.workloads.values():
+                if wl.kind != "serve":
+                    continue
+                # Let the worker's occupancy refresh land after the
+                # last request drained.
+                time.sleep(0.7)
+                self.results.extend(
+                    check_gauges_baseline(wl.scrape_metrics()))
+        if "healthz" in asserts:
+            for wl in self.workloads.values():
+                if wl.kind == "serve":
+                    self.results.extend(
+                        check_healthz(wl.healthz(), asserts["healthz"]))
+        specs = asserts.get("train")
+        if specs:
+            if isinstance(specs, dict):
+                specs = [specs]
+            for spec in specs:
+                for wl in self.workloads.values():
+                    if wl.kind != "train":
+                        continue
+                    if spec.get("target") not in (None, wl.id):
+                        continue
+                    self.results.extend(
+                        check_train(wl.last_summary(), spec,
+                                    label=f"train.{wl.id}"))
+
+    def _merge_timeline(self) -> dict:
+        dumps, jsonls = [], []
+        for wl in self.workloads.values():
+            dumps.extend(wl.dump_paths())
+            if wl.metrics_log and os.path.exists(wl.metrics_log):
+                jsonls.append(wl.metrics_log)
+        # Workloads share one trace dir, so each lists every dump —
+        # merging a source twice would double-count events (and turn 2
+        # recompiles into a 4-recompile "storm").
+        dumps = sorted(set(dumps))
+        trace = events.merge_traces(dumps, jsonls, [])
+        out = os.path.join(self.out_dir, "timeline.json")
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, out)
+        n = sum(1 for e in trace.get("traceEvents", ())
+                if e.get("ph") != "M")
+        log.info("merged timeline: %d events from %d dump(s) -> %s",
+                 n, len(dumps), out)
+        return trace
+
+    def _offline_assertions(self, timeline: dict):
+        asserts = self.sc["asserts"]
+        if "timeline_require" in asserts:
+            self.results.extend(
+                check_timeline(timeline, asserts["timeline_require"]))
+        doc_spec = asserts.get("doctor")
+        if doc_spec is not None:
+            inc_dir = os.path.join(self.out_dir, "incidents")
+            incidents = doctor.replay(
+                timeline, config=_doctor_config(doc_spec),
+                step_s=float(doc_spec.get("interval_s", 0.5)),
+                out_dir=inc_dir)
+            # The merged timeline is shifted so its first event sits
+            # at 0; move the epoch fault stamp onto that clock.
+            fault_start = self.fault_start
+            origin_us = (timeline.get("otherData") or {}).get(
+                "epoch_origin_us")
+            if fault_start is not None and origin_us is not None:
+                fault_start -= origin_us / 1e6
+            self.results.extend(
+                check_doctor(incidents, doc_spec, fault_start))
+
+
+# ---------- CLI ----------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ls = sub.add_parser("list", help="list scenarios")
+    ls.set_defaults(cmd="list")
+    rn = sub.add_parser("run", help="run scenarios")
+    rn.add_argument("names", nargs="*",
+                    help="scenario names (default with --all/--smoke)")
+    rn.add_argument("--all", action="store_true",
+                    help="run the full matrix")
+    rn.add_argument("--smoke", action="store_true",
+                    help="run only scenarios tagged 'smoke' (the CI "
+                         "subset)")
+    rn.add_argument("--out-dir", default="chaos_out",
+                    help="artifact root (per-scenario subdirs)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.cmd == "list":
+        for sc in discover_scenarios():
+            tags = ",".join(sc.get("tags", [])) or "-"
+            print(f"{sc['name']:<24} [{tags}] "
+                  f"{sc.get('description', '')[:70]}")
+        return 0
+
+    if not (args.names or args.all or args.smoke):
+        p.error("run needs scenario names, --all, or --smoke")
+    scenarios = discover_scenarios(names=args.names or None,
+                                   smoke=args.smoke)
+    if not scenarios:
+        print("no scenarios matched", file=sys.stderr)
+        return 2
+    os.makedirs(args.out_dir, exist_ok=True)
+    failed = []
+    for sc in scenarios:
+        print(f"=== chaos scenario: {sc['name']} ===", flush=True)
+        report = ScenarioRun(sc, args.out_dir).run()
+        for r in report["assertions"]:
+            mark = "PASS" if r["ok"] else "FAIL"
+            print(f"  [{mark}] {r['name']}: {r['detail']}")
+        verdict = "PASSED" if report["passed"] else "FAILED"
+        print(f"=== {sc['name']} {verdict} in {report['wall_s']}s "
+              f"(artifacts: {report['artifacts']['out_dir']})",
+              flush=True)
+        if not report["passed"]:
+            failed.append(sc["name"])
+    print(f"chaos: {len(scenarios) - len(failed)}/{len(scenarios)} "
+          f"scenarios passed"
+          + (f"; FAILED: {failed}" if failed else ""))
+    return 2 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
