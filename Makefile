@@ -133,11 +133,13 @@ perf-gate-smoke:
 # REAL serve/train subprocesses (worker kill mid-decode + supervised
 # restart, engine hang, fabricated HBM exhaustion, stalled data
 # loader, slow straggler, health-error storm, kill-during-checkpoint-
-# save) with recovery-SLO assertions — the doctor names each fault
-# exactly once, failed requests surface structured errors with zero
-# leaked slots/pages, train resumes within the step budget charging
-# the gap to badput — and a merged flight-recorder timeline artifact
-# per scenario under chaos_out/. CPU-hermetic; the full matrix is the
+# save, and slice-loss — a 2-process multislice train job losing a
+# rank and elastically resuming at reduced topology, ISSUE 10) with
+# recovery-SLO assertions — the doctor names each fault exactly once,
+# failed requests surface structured errors with zero leaked
+# slots/pages, train resumes within the step budget charging the gap
+# to badput — and a merged flight-recorder timeline artifact per
+# scenario under chaos_out/. CPU-hermetic; the full matrix is the
 # slow tier (~10 min).
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/chaos.py run --all --out-dir chaos_out
@@ -153,9 +155,25 @@ chaos-smoke:
 chaos-tests:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q
 
+# Multislice elastic training smoke (ISSUE 10): slice-aware mesh
+# factorisation, bounded coordinator-connect timeout, checkpoint
+# topology tags + rank-0 commit discipline, slice-loss detection/
+# restart planning units, the 2-process CPU-hermetic init + dp-psum
+# smoke (gloo collectives over loopback — the DCN stand-in), and the
+# elastic resume e2e: one of two ranks SIGKILLed, the survivor
+# re-execs into the reduced topology, reshards the checkpoint, reaches
+# the step target, and matches the single-process loss trajectory.
+# "-m ''" is not enough to pull in the slow-marked e2es, hence the
+# tautological marker expression.
+multislice-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_multislice.py \
+	    tests/test_multiprocess.py::test_two_process_elastic_resume \
+	    -q -m "slow or not slow"
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
-    introspect-smoke doctor-smoke perf-gate-smoke perf-gate chaos-smoke
+    introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
+    multislice-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -169,4 +187,4 @@ clean:
     lint lint-baseline lint-smoke bench perf hbm-plan obs-smoke \
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
     perf-gate perf-baseline perf-gate-smoke chaos chaos-smoke \
-    chaos-tests smoke dryrun clean
+    chaos-tests multislice-smoke smoke dryrun clean

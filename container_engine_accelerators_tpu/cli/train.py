@@ -12,11 +12,20 @@ synthetic or token-file data on the local devices, with:
                     stalled process trips `train_stalled` with the
                     straggler's id instead of hanging silently
 
-Multi-host: initialize_from_env() picks up the JobSet/Indexed-Job env
-contract (parallel/distributed.py); each process heartbeats under its
-own id, so one watchdog watching a shared heartbeat dir names the
-straggling rank. Set TPU_PROFILE_DIR to capture an xplane trace whose
-`train/*` annotations line up with the metric timeline.
+Multi-host / multislice: initialize_from_env() picks up the JobSet/
+Indexed-Job env contract (parallel/distributed.py, incl. the bounded
+JAX_COORDINATOR_TIMEOUT_S connect). With MEGASCALE_NUM_SLICES /
+JAX_NUM_SLICES (or --dcn-slices) > 1 the mesh places slices along the
+dp axis (gradient psum is the only DCN collective) and each slice's
+devices along fsdp. Each process heartbeats under its own id, so one
+watchdog watching a shared heartbeat dir names the straggling rank —
+and with --elastic, a PEER whose heartbeat goes stale (or whose pid is
+provably dead) triggers the slice-loss path: this process re-execs
+into the reduced topology, reshards the newest checkpoint, and charges
+the detection/restart/reshard/fast-forward gap to named goodput badput
+buckets (training/elastic.py). Set TPU_PROFILE_DIR to capture an
+xplane trace whose `train/*` annotations line up with the metric
+timeline.
 
 Prints one JSON summary line (throughput, MFU, step percentiles,
 goodput split) on exit — machine-parseable like bench.py.
@@ -27,6 +36,8 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
+import sys
 
 log = logging.getLogger(__name__)
 
@@ -75,6 +86,26 @@ def main(argv=None) -> int:
     p.add_argument("--watchdog-threshold", type=float, default=300.0,
                    help="seconds a heartbeat may age before "
                         "train_stalled fires")
+    p.add_argument("--dcn-slices", type=int, default=None,
+                   help="DCN slice count for the multislice mesh "
+                        "(slices land on the dp axis); default: "
+                        "MEGASCALE_NUM_SLICES / JAX_NUM_SLICES env, "
+                        "else 1")
+    p.add_argument("--elastic", action="store_true",
+                   help="survive slice loss: watch peer heartbeats "
+                        "(requires --heartbeat-dir) and on a lost "
+                        "peer re-exec THIS process into the reduced "
+                        "topology, resharding the newest checkpoint "
+                        "(give --ckpt-dir or the resumed run starts "
+                        "over); the gap is charged to the detection/"
+                        "restart/reshard badput buckets")
+    p.add_argument("--elastic-threshold", type=float, default=30.0,
+                   help="seconds a PEER heartbeat may age before the "
+                        "peer counts as lost (a provably dead local "
+                        "pid is detected faster)")
+    p.add_argument("--elastic-max-restarts", type=int, default=3,
+                   help="in-place elastic restarts before giving up "
+                        "to the outer Job controller")
     p.add_argument("--trace-dump", default=None,
                    help="enable the flight-recorder EventBus and write "
                         "its ring as Chrome-trace JSON to this path on "
@@ -119,18 +150,38 @@ def main(argv=None) -> int:
         MeshAxes,
         make_mesh,
     )
-    from container_engine_accelerators_tpu.parallel.distributed import (
-        initialize_from_env,
+    from container_engine_accelerators_tpu.parallel import (
+        distributed as dist,
     )
-    from container_engine_accelerators_tpu.training import make_optimizer
+    from container_engine_accelerators_tpu.training import (
+        elastic,
+        make_optimizer,
+    )
     from container_engine_accelerators_tpu.training.train import fit
 
-    initialize_from_env()
+    multiproc = dist.initialize_from_env()
     import jax
 
     cfg = build_config(args.preset, args.vocab_size)
     n_dev = len(jax.devices())
-    mesh = make_mesh(MeshAxes(fsdp=n_dev), devices=jax.devices())
+    slices = args.dcn_slices if args.dcn_slices else dist.num_slices()
+    if slices > 1:
+        # Multislice: slices along dp (gradient psum is the only DCN
+        # collective), each slice's devices along fsdp — the
+        # data-parallel-over-DCN layout (parallel/distributed.py).
+        if n_dev % slices:
+            raise SystemExit(
+                f"{n_dev} devices do not split into {slices} slices")
+        if args.batch_size % slices:
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must be a multiple "
+                f"of the {slices} dp slices")
+        mesh = make_mesh(MeshAxes(dp=slices, fsdp=n_dev // slices),
+                         devices=jax.devices(), dcn_slices=slices)
+    else:
+        mesh = make_mesh(MeshAxes(fsdp=n_dev), devices=jax.devices())
+    log.info("mesh %s over %d device(s), %d process(es), %d slice(s)",
+             dict(mesh.shape), n_dev, jax.process_count(), slices)
 
     if args.data:
         from container_engine_accelerators_tpu.training.dataset import (
@@ -151,6 +202,36 @@ def main(argv=None) -> int:
     # summary line can be printed after fit returns.
     recorder = TrainRecorder(log_path=args.metrics_log,
                              heartbeat_dir=args.heartbeat_dir)
+    # If this process is a post-slice-loss re-exec (training/elastic.py
+    # execve'd us into the reduced topology), charge the detection and
+    # restart gaps to their badput buckets now — the restore/reshard
+    # and fast-forward halves land inside fit.
+    elastic.consume_resume_state(recorder, log_fn=log.info)
+    monitor = None
+    if args.elastic:
+        if not args.heartbeat_dir:
+            raise SystemExit("--elastic requires --heartbeat-dir")
+        if jax.process_count() > 1:
+            dump_dir = None
+            if args.trace_dump:
+                dump_dir = (args.trace_dump
+                            if os.path.isdir(args.trace_dump)
+                            else os.path.dirname(
+                                os.path.abspath(args.trace_dump)))
+            monitor = elastic.SliceLossMonitor(
+                args.heartbeat_dir,
+                process_id=jax.process_index(),
+                num_processes=jax.process_count(),
+                num_slices=slices,
+                threshold_s=args.elastic_threshold,
+                max_restarts=args.elastic_max_restarts,
+                restart_argv=[
+                    "-m", "container_engine_accelerators_tpu.cli.train",
+                ] + list(argv if argv is not None else sys.argv[1:]),
+                dump_dir=dump_dir)
+            monitor.start()
+            log.info("elastic slice-loss monitor on (threshold %.1fs)",
+                     args.elastic_threshold)
     # Runtime introspection: compile tracking with recompile goodput
     # attribution (fit installs too, but wiring here covers the window
     # before fit builds its exporter), plus the hbm_plan budget this
@@ -195,8 +276,17 @@ def main(argv=None) -> int:
                    heartbeat_dir=args.heartbeat_dir,
                    watchdog_threshold_s=args.watchdog_threshold)
 
+    if monitor is not None:
+        monitor.stop()
     summary = recorder.summary()
     summary["final_step"] = int(jax.device_get(state.step))
+    summary["topology"] = {
+        "processes": jax.process_count(),
+        "devices": n_dev,
+        "slices": slices,
+        "elastic_restarts": int(
+            os.environ.get(elastic.RESTARTS_ENV, "0")),
+    }
     if doc is not None:
         doc.poll_once()  # final evaluation over the tail of the run
         doc.stop()

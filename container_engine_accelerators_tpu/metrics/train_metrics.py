@@ -24,6 +24,10 @@ every wall-clock second since the recorder started is classified into
     checkpoint   save calls on the loop thread
     stalled      data waits, plus any wall-clock the loop never
                  accounted for (hangs, host overhead)
+    detection /  elastic multislice recovery (ISSUE 10): slice loss ->
+    restart /    noticed, noticed -> restarted process attributing
+    reshard      again, and a restore that translated topologies —
+                 see the GOODPUT_BUCKETS comment for the exact edges
 
 Export is via `TrainMetricsExporter` (`fit(..., metrics_port=)` /
 `train --metrics-port`; port 0 = ephemeral, `bound_port` exposed), the
@@ -119,8 +123,18 @@ def read_metrics_jsonl(path: str) -> list[dict]:
 _PHASE_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
-GOODPUT_BUCKETS = ("productive", "restore", "recompile", "checkpoint",
-                   "stalled")
+# Goodput taxonomy. The elastic-recovery buckets (ISSUE 10) split a
+# slice-loss gap into its named phases so "% of wall-clock productive
+# across a preemption" decomposes into WHERE the badput went:
+#   detection   slice loss happened -> the survivor noticed (stale peer
+#               heartbeat past the elastic threshold)
+#   restart     noticed -> the restarted process is attributing again
+#               (exec + imports + jax/distributed re-init)
+#   reshard     checkpoint restore that translated topologies (the
+#               saved topology tag differs from the restoring run's)
+#   restore     same-topology checkpoint restore + batch fast-forward
+GOODPUT_BUCKETS = ("productive", "restore", "reshard", "recompile",
+                   "checkpoint", "stalled", "detection", "restart")
 SAMPLE_KINDS = ("step", "data_wait", "ckpt_save", "host_sync")
 
 
@@ -171,6 +185,15 @@ class TrainRecorder:
             os.makedirs(heartbeat_dir, exist_ok=True)
             self._hb_path = os.path.join(heartbeat_dir, f"hb-{process_id}")
         self.process_id = process_id or 0
+        if self._hb_path is not None:
+            # Touch at construction, not only at the first step edge: a
+            # process restarted by the elastic supervisor spends its
+            # first tens of seconds importing + compiling, and its
+            # PRE-restart heartbeat (execve preserves the file) would
+            # age into a phantom straggler for every watchdog sharing
+            # the dir — fresh-from-birth means only truly dead ranks
+            # look dead.
+            self._touch_heartbeat()
 
         reg = self.registry
         self.step_time = Histogram(
@@ -375,18 +398,55 @@ class TrainRecorder:
                                 {"n": n, "tokens": tokens})
 
     def record_restore(self, seconds: float, step: int | None = None,
+                       resharded: bool = False,
                        now: float | None = None) -> None:
+        """A checkpoint restore. `resharded=True` marks a restore that
+        translated TOPOLOGIES (the checkpoint's recorded topology tag
+        differs from the restoring run's — e.g. a slice was lost and
+        the survivor reshards to the reduced mesh): the seconds land in
+        the `reshard` bucket so elastic-recovery cost is distinguishable
+        from an ordinary same-shape resume."""
         now = time.monotonic() if now is None else now
         with self._lock:
-            self._buckets["restore"] += max(seconds, 0.0)
+            bucket = "reshard" if resharded else "restore"
+            self._buckets[bucket] += max(seconds, 0.0)
             self.resumes_total.inc()
             self._goodput_locked(now)
-            self._append_log({"kind": "restore", "t": round(time.time(), 3),
-                              "seconds": round(seconds, 6), "step": step})
+            rec = {"kind": "restore", "t": round(time.time(), 3),
+                   "seconds": round(seconds, 6), "step": step}
+            if resharded:
+                rec["resharded"] = True
+            self._append_log(rec)
             if events.enabled():
                 s = max(seconds, 0.0)
                 events.complete("train/restore", now - s, s, "train",
-                                {"step": step})
+                                {"step": step, "resharded": resharded})
+
+    def record_badput(self, bucket: str, seconds: float,
+                      detail: dict | None = None,
+                      now: float | None = None) -> None:
+        """Charge arbitrary wall-clock to a named badput bucket — the
+        elastic-recovery path uses this for `detection` (slice loss ->
+        noticed) and `restart` (noticed -> this process attributing
+        again, stamped across the execve by training/elastic.py). The
+        JSONL log gets one record per charge so the gap is auditable
+        offline."""
+        if bucket not in GOODPUT_BUCKETS:
+            raise ValueError(f"unknown goodput bucket {bucket!r} "
+                             f"(known: {GOODPUT_BUCKETS})")
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            s = max(seconds, 0.0)
+            self._buckets[bucket] += s
+            self._goodput_locked(now)
+            rec = {"kind": "badput", "bucket": bucket,
+                   "t": round(time.time(), 3), "seconds": round(s, 6)}
+            if detail:
+                rec.update(detail)
+            self._append_log(rec)
+            if events.enabled():
+                events.complete(f"train/{bucket}", now - s, s, "train",
+                                detail)
 
     def record_recompile(self, seconds: float, fn: str | None = None,
                          now: float | None = None) -> None:

@@ -6,18 +6,40 @@ replacement is `jax.distributed.initialize` against a coordinator address
 delivered by the Job/JobSet environment (SURVEY.md §7 hard part d).
 
 Env contract (set by dcn-multislice manifests; JobSet-compatible):
-  JAX_COORDINATOR_ADDRESS  host[:port] of process 0
-  JAX_COORDINATOR_PORT     default 8476 (used when address has no port)
-  JAX_NUM_PROCESSES        total processes
-  JAX_PROCESS_ID           this process's rank, or derived from
-                           JOB_COMPLETION_INDEX (Indexed Jobs) /
-                           hostname ordinal (StatefulSet/JobSet pods)
+  JAX_COORDINATOR_ADDRESS   host[:port] of process 0
+  JAX_COORDINATOR_PORT      default 8476 (used when address has no port)
+  JAX_NUM_PROCESSES         total processes
+  JAX_PROCESS_ID            this process's rank, or derived from
+                            JOB_COMPLETION_INDEX (Indexed Jobs) /
+                            hostname ordinal (StatefulSet/JobSet pods)
+  JAX_COORDINATOR_TIMEOUT_S bound on the coordinator connect/barrier
+                            (default 300). On expiry the process fails
+                            with a structured CoordinatorConnectError
+                            naming the address and rank — never an
+                            indefinite hang against a coordinator pod
+                            that is gone.
+  JAX_NUM_SLICES            DCN slice count (MEGASCALE_NUM_SLICES is
+                            honored first — the TPU runtime sets it on
+                            real multislice); 1 = single slice. The
+                            training CLI places slices along the mesh's
+                            dp axis (parallel/mesh.py dcn_slices).
 
 Device order note: after initialize, jax.devices() sorts all slices'
-devices with each process's local chips contiguous — make_mesh's
+devices with each process's local chips contiguous (and a slice's
+processes contiguous in rank, the JobSet ordering) — make_mesh's
 (dp, fsdp, sp, tp) factorisation therefore puts dp outermost, so placing
 *slices* along dp keeps gradient psum the only DCN collective (the
-data-parallel-over-DCN pattern the reference enables with NCCL).
+data-parallel-over-DCN pattern the reference enables with NCCL). When
+pp > 1 the pp axis is outermost instead; `make_mesh(..., dcn_slices=S)`
+applies the slice-aware factorisation that still lands slices on dp.
+
+CPU test backend: cross-process collectives on the CPU platform need an
+explicit collectives implementation (jax's default is none — every
+multi-process CPU computation fails with "Multiprocess computations
+aren't implemented on the CPU backend"). `initialize_from_env` selects
+gloo on CPU (JAX_CPU_COLLECTIVES overrides; older jax without the knob
+degrades with a logged warning), which is what lets the two-process
+tests/chaos scenarios drive the real DCN code path hermetically.
 """
 
 from __future__ import annotations
@@ -25,8 +47,33 @@ from __future__ import annotations
 import logging
 import os
 import re
+import time
 
 log = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_TIMEOUT_S = 300.0
+
+
+class CoordinatorConnectError(RuntimeError):
+    """jax.distributed.initialize failed or timed out. Carries the
+    coordinator address and this process's rank so the failing pod's
+    log names the exact endpoint to debug (instead of a bare gRPC
+    deadline buried in a C++ traceback)."""
+
+    def __init__(self, address: str, process_id: int, num_processes: int,
+                 timeout_s: float, cause: BaseException):
+        self.address = address
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"jax.distributed initialization failed: coordinator "
+            f"{address} unreachable from process "
+            f"{process_id}/{num_processes} within {timeout_s:.0f}s "
+            f"(JAX_COORDINATOR_TIMEOUT_S). Is the coordinator pod "
+            f"(rank 0) running and the address routable? "
+            f"Underlying error: {type(cause).__name__}: "
+            f"{str(cause)[:300]}")
 
 
 def infer_process_id() -> int | None:
@@ -42,10 +89,101 @@ def infer_process_id() -> int | None:
     return None
 
 
+def num_slices(default: int = 1) -> int:
+    """DCN slice count from the environment: MEGASCALE_NUM_SLICES (set
+    by the TPU runtime on real multislice) wins, JAX_NUM_SLICES is the
+    manifest/test spelling, else `default`."""
+    for var in ("MEGASCALE_NUM_SLICES", "JAX_NUM_SLICES"):
+        val = os.environ.get(var)
+        if val is not None and val.isdigit():
+            return max(1, int(val))
+    return default
+
+
+def coordinator_timeout_s() -> float:
+    try:
+        return float(os.environ.get("JAX_COORDINATOR_TIMEOUT_S",
+                                    DEFAULT_COORDINATOR_TIMEOUT_S))
+    except ValueError:
+        log.warning("malformed JAX_COORDINATOR_TIMEOUT_S=%r; using %gs",
+                    os.environ.get("JAX_COORDINATOR_TIMEOUT_S"),
+                    DEFAULT_COORDINATOR_TIMEOUT_S)
+        return DEFAULT_COORDINATOR_TIMEOUT_S
+
+
+def _configure_cpu_collectives() -> None:
+    """Cross-process collectives for the CPU platform (the hermetic
+    test/chaos transport): gloo unless JAX_CPU_COLLECTIVES says
+    otherwise. Must run before the backend initializes; harmless later
+    only if the value doesn't change."""
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if not plat:
+        # Workers that pick CPU via jax.config (the test harness
+        # spelling) rather than the env var.
+        plat = getattr(jax.config, "jax_platforms", None) or ""
+    if plat.lower() != "cpu":
+        return
+    impl = os.environ.get("JAX_CPU_COLLECTIVES", "gloo")
+    if impl in ("", "none"):
+        return
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception:
+        # tpulint: allow=TPL009(logged: old jax without the knob keeps the previous single-process-only behavior)
+        log.warning(
+            "jax %s has no jax_cpu_collectives_implementation option; "
+            "multi-process CPU collectives will fail", jax.__version__,
+            exc_info=True)
+
+
+def _probe_coordinator(address: str, process_id: int,
+                       num_processes: int, timeout_s: float) -> None:
+    """Bounded TCP reachability probe of the coordinator BEFORE handing
+    control to jax.distributed. Necessary because XLA's distributed
+    client turns a connect deadline into an abseil LOG(FATAL) —
+    terminating the process from C++ before any Python `except` can
+    run — so the structured, catchable failure has to be produced out
+    here. Rank 0 skips it (it IS the coordinator; it binds rather than
+    connects)."""
+    if process_id == 0:
+        return
+    import socket
+
+    host, _, port = address.rpartition(":")
+    deadline = time.monotonic() + timeout_s
+    last_err: BaseException = TimeoutError(
+        f"no listener within {timeout_s:.0f}s")
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(
+                    (host, int(port)),
+                    timeout=max(0.5, min(5.0, deadline
+                                         - time.monotonic()))):
+                return
+        except OSError as e:
+            last_err = e
+            time.sleep(min(0.5, max(0.0, deadline - time.monotonic())))
+    raise CoordinatorConnectError(address, process_id, num_processes,
+                                  timeout_s, last_err)
+
+
 def initialize_from_env() -> bool:
     """Call jax.distributed.initialize from env; returns True if multi-
     process mode was activated, False for single-process (no coordinator
-    configured)."""
+    configured).
+
+    The connect is bounded by JAX_COORDINATOR_TIMEOUT_S (default
+    300s): a coordinator that is unreachable raises a structured
+    CoordinatorConnectError naming the address and this rank (from a
+    Python-side TCP probe — XLA's own connect failure is a C++
+    LOG(FATAL) that no `except` can catch), and the same budget is
+    passed to jax.distributed's initialization_timeout for the
+    register/barrier half. A run whose coordinator pod was deleted
+    fails loudly and fast enough for the Job controller (or the
+    elastic supervisor) to act on it."""
     address = os.environ.get("JAX_COORDINATOR_ADDRESS")
     num = os.environ.get("JAX_NUM_PROCESSES")
     if not address or not num:
@@ -57,11 +195,24 @@ def initialize_from_env() -> bool:
         raise RuntimeError(
             "JAX_COORDINATOR_ADDRESS set but no process id: set "
             "JAX_PROCESS_ID or run under an Indexed Job")
+    timeout_s = coordinator_timeout_s()
+    _probe_coordinator(address, process_id, int(num), timeout_s)
+    _configure_cpu_collectives()
     import jax
 
-    jax.distributed.initialize(coordinator_address=address,
-                               num_processes=int(num),
-                               process_id=process_id)
-    log.info("jax.distributed initialized: %s process %s/%s",
-             address, process_id, num)
+    kwargs = {}
+    import inspect
+
+    if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize).parameters:
+        kwargs["initialization_timeout"] = max(1, int(timeout_s))
+    try:
+        jax.distributed.initialize(coordinator_address=address,
+                                   num_processes=int(num),
+                                   process_id=process_id, **kwargs)
+    except Exception as e:
+        raise CoordinatorConnectError(address, process_id, int(num),
+                                      timeout_s, e) from e
+    log.info("jax.distributed initialized: %s process %s/%s "
+             "(%d slice(s))", address, process_id, num, num_slices())
     return True

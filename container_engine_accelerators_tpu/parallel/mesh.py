@@ -80,8 +80,55 @@ def auto_axis_sizes(n_devices: int, tp: int | None = None,
                     sp=sp_sz, tp=tp_sz)
 
 
-def make_mesh(axes: MeshAxes | None = None, devices=None) -> Mesh:
-    """Build the 4-axis mesh. With `axes=None`, auto-factor all devices."""
+def slice_device_array(devices, axes: MeshAxes, dcn_slices: int):
+    """Arrange `devices` (slice-major order: each slice's chips form one
+    contiguous block, the jax.devices() contract after a multislice
+    `jax.distributed.initialize` — parallel/distributed.py module
+    docstring) into the (pp, dp, fsdp, ep, sp, tp) mesh shape with
+    SLICES placed along the dp axis.
+
+    This reconciles two conventions that disagree when pp > 1:
+    make_mesh's axis order puts pp outermost (stage-to-stage ppermute
+    tolerates DCN), but the raw device order varies slice-slowest — a
+    naive reshape would land slices along pp. The factorisation here
+    reshapes slice-major, then moves the slice dimension inside pp and
+    merges it into dp's leading factor, so mesh[pp_i, dp_i, ...] lives
+    on slice dp_i // (dp / dcn_slices) for every pp_i: the dp-axis
+    gradient psum is the ONLY collective that crosses DCN."""
+    import numpy as np
+
+    n = len(devices)
+    if n % dcn_slices:
+        raise ValueError(
+            f"{n} devices do not split into {dcn_slices} equal slices")
+    if axes.dp % dcn_slices:
+        raise ValueError(
+            f"dp={axes.dp} must be a multiple of dcn_slices="
+            f"{dcn_slices}: slices are placed along the dp axis "
+            "(mesh.py slice_device_array)")
+    per_slice = n // dcn_slices
+    inner = axes.pp * (axes.dp // dcn_slices) * axes.fsdp * axes.ep \
+        * axes.sp * axes.tp
+    if inner != per_slice:
+        raise ValueError(
+            f"mesh axes {axes} place {inner} devices per slice, but "
+            f"{dcn_slices} slices of {per_slice} devices were given")
+    arr = np.asarray(devices, dtype=object).reshape(
+        dcn_slices, axes.pp, axes.dp // dcn_slices, axes.fsdp, axes.ep,
+        axes.sp, axes.tp)
+    # (S, pp, dp/S, ...) -> (pp, S, dp/S, ...) -> merge (S, dp/S) = dp.
+    arr = np.moveaxis(arr, 0, 1)
+    return arr.reshape(axes.as_tuple())
+
+
+def make_mesh(axes: MeshAxes | None = None, devices=None,
+              dcn_slices: int | None = None) -> Mesh:
+    """Build the 4-axis mesh. With `axes=None`, auto-factor all devices.
+
+    `dcn_slices > 1` applies the slice-aware factorisation
+    (slice_device_array): the device list is treated as slice-major and
+    slices land along the dp axis regardless of pp, so data-parallel
+    gradient psum is the only DCN-crossing collective."""
     if devices is None:
         devices = jax.devices()
     if axes is None:
@@ -94,6 +141,12 @@ def make_mesh(axes: MeshAxes | None = None, devices=None) -> Mesh:
     # jax 0.4.x predates AxisType AND the axis_types kwarg — GSPMD
     # propagation is its only mode, so plain make_mesh is equivalent.
     axis_type = getattr(jax.sharding, "AxisType", None)
+    if dcn_slices is not None and dcn_slices > 1:
+        arr = slice_device_array(devices, axes, dcn_slices)
+        if axis_type is None:
+            return Mesh(arr, AXIS_NAMES)
+        return Mesh(arr, AXIS_NAMES,
+                    axis_types=(axis_type.Auto,) * len(AXIS_NAMES))
     if axis_type is None:
         return jax.make_mesh(axes.as_tuple(), AXIS_NAMES, devices=devices)
     return jax.make_mesh(axes.as_tuple(), AXIS_NAMES, devices=devices,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Any
 
 import jax
@@ -37,6 +38,34 @@ from container_engine_accelerators_tpu.training.train import TrainState
 log = logging.getLogger(__name__)
 
 _DEPTH_ORDER = {"interleaved": False}
+
+
+def current_topology(mesh=None) -> dict:
+    """The topology tag recorded with every checkpoint (the multislice
+    generalization of the layer-layout tag): process count, device
+    count, and — when a mesh is given — the named axis sizes. Restore
+    compares the saved tag with the restoring run's to detect a
+    TOPOLOGY translation (e.g. a slice lost between save and resume),
+    which orbax then realizes by resharding onto the new mesh from the
+    abstract target."""
+    t = {"processes": jax.process_count(),
+         "devices": jax.device_count()}
+    if mesh is not None:
+        t["axes"] = {name: int(size)
+                     for name, size in mesh.shape.items()}
+        t["devices"] = int(mesh.devices.size)
+    return t
+
+
+def topology_changed(saved: dict | None, current: dict | None) -> bool:
+    """True when a checkpoint written under `saved` restores into a
+    run shaped `current` (missing tags — pre-ISSUE-10 checkpoints —
+    compare equal: no claim, no translation)."""
+    if not saved or not current:
+        return False
+    keys = ("processes", "devices", "axes")
+    return any(saved.get(k) != current.get(k) for k in keys
+               if k in saved and k in current)
 
 
 def _relayout_state_tree(tree, saved: dict | None, target: dict | None):
@@ -65,12 +94,31 @@ def _relayout_state_tree(tree, saved: dict | None, target: dict | None):
 
 
 class CheckpointManager:
-    """Thin wrapper: save every N steps, keep last K, restore latest."""
+    """Thin wrapper: save every N steps, keep last K, restore latest.
+
+    Multi-process contract (ISSUE 10): `save` is COLLECTIVE — every
+    process must call it with the same step (each host writes its own
+    OCDBT shards), and only process 0 performs the commit-side renames
+    (orbax's primary-host atomic finalize, and this class's torn-step
+    quarantine). Non-zero ranks never touch the step directory's
+    name — a rank racing rank 0's rename is exactly the torn-namespace
+    corruption the quarantine exists to clean up. In-process, `save`
+    is additionally single-writer per directory: two concurrent saves
+    into the same directory (two managers, or two threads on one)
+    raise instead of interleaving half-written step dirs."""
+
+    # In-process single-writer registry: absolute dir -> writer token.
+    _inflight_lock = threading.Lock()
+    _inflight: dict[str, int] = {}
 
     def __init__(self, directory: str, save_interval_steps: int = 100,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, process_index: int | None = None):
         directory = os.path.abspath(directory)
         self._dir = directory
+        if process_index is None:
+            process_index = jax.process_index()
+        self._rank = process_index
+        self.last_restore_info: dict | None = None
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -81,24 +129,49 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState, force: bool = False,
-             layout: dict | None = None, cfg=None) -> bool:
+             layout: dict | None = None, cfg=None,
+             topology: dict | None = None) -> bool:
         """`layout` is the layer-storage tag the state was built under
         (training/train.py state_layer_layout); omitted means depth
         order. `cfg` (a LlamaConfig) is recorded as JSON so the
         checkpoint is self-describing — load_serving_params can rebuild
-        the model without a side-channel config."""
-        items = {
-            "state": ocp.args.StandardSave(state._asdict()),
-            "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
-        }
-        if cfg is not None:
-            from container_engine_accelerators_tpu.models.llama import (
-                cfg_to_json_dict,
-            )
-            items["cfg"] = ocp.args.JsonSave(cfg_to_json_dict(cfg))
-        saved = self._mngr.save(step, args=ocp.args.Composite(**items),
-                                force=force)
-        return bool(saved)
+        the model without a side-channel config. `topology` (defaults
+        to current_topology()) records the process/device/mesh shape
+        the state was sharded under, so a resume into a DIFFERENT
+        topology — the elastic slice-loss path — is detected and
+        attributed as a reshard, not silently treated as an ordinary
+        restore.
+
+        Collective + single-writer: see the class docstring. All ranks
+        call save; rank 0 owns every namespace-level rename."""
+        with CheckpointManager._inflight_lock:
+            holder = CheckpointManager._inflight.get(self._dir)
+            if holder is not None:
+                raise RuntimeError(
+                    f"concurrent checkpoint save into {self._dir} "
+                    "(another save is in flight in this process): the "
+                    "save path is single-writer per directory — "
+                    "serialize callers, don't race the atomic commit")
+            CheckpointManager._inflight[self._dir] = id(self)
+        try:
+            items = {
+                "state": ocp.args.StandardSave(state._asdict()),
+                "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
+                "topology": ocp.args.JsonSave(
+                    topology if topology is not None
+                    else current_topology()),
+            }
+            if cfg is not None:
+                from container_engine_accelerators_tpu.models.llama import (
+                    cfg_to_json_dict,
+                )
+                items["cfg"] = ocp.args.JsonSave(cfg_to_json_dict(cfg))
+            saved = self._mngr.save(step, args=ocp.args.Composite(**items),
+                                    force=force)
+            return bool(saved)
+        finally:
+            with CheckpointManager._inflight_lock:
+                CheckpointManager._inflight.pop(self._dir, None)
 
     def wait(self):
         self._mngr.wait_until_finished()
@@ -116,14 +189,37 @@ class CheckpointManager:
             step, args=ocp.args.Composite(layout=ocp.args.JsonRestore()))
         return dict(restored["layout"])
 
+    def saved_topology(self, step: int) -> dict | None:
+        """The topology tag recorded at `step` (None for checkpoints
+        predating it) — the sibling of saved_layout for the mesh/
+        process shape instead of the layer-storage order."""
+        step_dir = os.path.join(self._dir, str(step))
+        if not os.path.isdir(os.path.join(step_dir, "topology")):
+            return None
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(topology=ocp.args.JsonRestore()))
+        return dict(restored["topology"])
+
     def restore(self, state_like: TrainState, step: int | None = None,
-                layout: dict | None = None) -> TrainState | None:
+                layout: dict | None = None,
+                topology: dict | None = None) -> TrainState | None:
         """Restore into the shardings/dtypes of `state_like` (an existing
         or abstract TrainState). `layout` is the layer-storage order the
         CALLER needs (state_layer_layout of the current cfg/mesh); when
         it differs from the checkpoint's recorded layout, the stacked
         layer arrays and their optimizer moments are re-permuted
         automatically.
+
+        Topology translation (the multislice generalization of the
+        layout translation): `topology` is the shape the CALLER runs at
+        (current_topology(mesh); defaults to the process/device view).
+        When it differs from the checkpoint's recorded tag — the
+        elastic slice-loss resume restores a 2-slice checkpoint into
+        the survivors' reduced mesh — orbax reshards every array onto
+        the target shardings from the abstract state, and
+        `last_restore_info` records {"step", "topology_changed",
+        "saved_topology"} so the caller can charge the restore to the
+        `reshard` badput bucket instead of `restore`.
 
         Torn-checkpoint resilience: with `step=None` (restore latest),
         a newest checkpoint that fails to deserialize — truncated array
@@ -132,12 +228,16 @@ class CheckpointManager:
         instant, and the previous step is tried instead. Before this, a
         single torn newest checkpoint wedged every future auto-resume:
         the one failure checkpointing exists to survive. An explicit
-        `step` still fails loudly (the caller asked for THAT step)."""
+        `step` still fails loudly (the caller asked for THAT step).
+        Quarantine renames are rank-0-only (see _quarantine_step)."""
 
         def to_abstract(x):
             sharding = getattr(x, "sharding", None)
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
+        self.last_restore_info = None
+        if topology is None:
+            topology = current_topology()
         abstract = jax.tree.map(to_abstract, state_like._asdict())
         if step is not None:
             candidates = [step]
@@ -147,7 +247,8 @@ class CheckpointManager:
             return None
         for i, s in enumerate(candidates):
             try:
-                tree, saved_layout = self._restore_step(s, abstract)
+                tree, saved_layout, saved_topo = self._restore_step(
+                    s, abstract)
             except Exception as e:
                 if step is not None or i == len(candidates) - 1:
                     raise self._translate_restore_error(e, s)
@@ -165,6 +266,18 @@ class CheckpointManager:
                 continue
             if normalize_layout(saved_layout) != normalize_layout(layout):
                 tree = _relayout_state_tree(tree, saved_layout, layout)
+            changed = topology_changed(saved_topo, topology)
+            if changed:
+                log.info(
+                    "checkpoint step %d resharded across topologies: "
+                    "saved %s -> restoring %s", s, saved_topo, topology)
+                if events.enabled():
+                    events.instant("ckpt/reshard", "train",
+                                   {"step": s, "saved": saved_topo,
+                                    "target": topology})
+            self.last_restore_info = {"step": s,
+                                      "topology_changed": changed,
+                                      "saved_topology": saved_topo}
             return TrainState(**tree)
         raise AssertionError("unreachable: every candidate raised")
 
@@ -173,7 +286,19 @@ class CheckpointManager:
         resumed run will save at this step again, and orbax refuses to
         overwrite an existing step — the wreckage must move aside (it
         stays on disk as evidence, `<step>.corrupt*`). Best-effort:
-        a failed rename only costs the later save, not the restore."""
+        a failed rename only costs the later save, not the restore.
+
+        RANK 0 ONLY: on a multi-process run every rank walks the same
+        fallback (all see the torn step), but only the commit owner may
+        rename — N ranks racing os.rename on a shared filesystem is a
+        second corruption on top of the first. Non-zero ranks log and
+        rely on rank 0's rename landing before their next save."""
+        if self._rank != 0:
+            log.warning(
+                "rank %d skipping quarantine of torn checkpoint step "
+                "%d (rank 0 owns namespace renames)", self._rank, step)
+            self._reload_mngr()
+            return
         src = os.path.join(self._dir, str(step))
         if not os.path.isdir(src):
             return
@@ -189,6 +314,9 @@ class CheckpointManager:
         except OSError:
             log.exception("could not quarantine torn checkpoint %s", src)
             return
+        self._reload_mngr()
+
+    def _reload_mngr(self) -> None:
         # The orbax manager snapshots the step list at init on some
         # versions; refresh so a later save at this step starts clean.
         try:
@@ -197,21 +325,29 @@ class CheckpointManager:
         except Exception:
             log.debug("orbax manager reload failed", exc_info=True)
 
-    def _restore_step(self, step: int, abstract) -> tuple[dict, dict]:
-        """(state tree, saved layout) for one step; raises on any
-        deserialization failure (restore() owns fallback policy)."""
+    def _restore_step(self, step: int,
+                      abstract) -> tuple[dict, dict, dict | None]:
+        """(state tree, saved layout, saved topology) for one step;
+        raises on any deserialization failure (restore() owns fallback
+        policy)."""
         step_dir = os.path.join(self._dir, str(step))
         if os.path.isdir(os.path.join(step_dir, "state")):
+            items = {
+                "state": ocp.args.StandardRestore(abstract),
+                "layout": ocp.args.JsonRestore(),
+            }
+            has_topology = os.path.isdir(
+                os.path.join(step_dir, "topology"))
+            if has_topology:
+                items["topology"] = ocp.args.JsonRestore()
             restored = self._mngr.restore(
-                step, args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(abstract),
-                    layout=ocp.args.JsonRestore(),
-                ))
-            return restored["state"], restored["layout"]
+                step, args=ocp.args.Composite(**items))
+            topo = dict(restored["topology"]) if has_topology else None
+            return restored["state"], restored["layout"], topo
         # Pre-tag checkpoint (bare StandardSave): depth order.
         tree = self._mngr.restore(
             step, args=ocp.args.StandardRestore(abstract))
-        return tree, dict(_DEPTH_ORDER)
+        return tree, dict(_DEPTH_ORDER), None
 
     def _translate_restore_error(self, e: Exception,
                                  step: int) -> Exception:

@@ -1,0 +1,425 @@
+"""Elastic multislice supervision (ISSUE 10 tentpole): survive slice
+loss, restart into the reduced topology, and attribute every second of
+the gap.
+
+The failure this handles: a data-parallel multislice job (slices along
+the mesh's dp axis, parallel/mesh.py) loses a slice — preemption, node
+failure, a SIGKILLed process in the chaos harness. The survivors are
+then wedged inside a DCN collective that will never complete; nothing
+in jax will unblock them on a useful timescale. The recovery loop:
+
+  detect    every training process already touches a per-process
+            heartbeat file each step (metrics/train_metrics.py). The
+            SliceLossMonitor thread on each survivor watches its PEERS'
+            heartbeats. A stale heartbeat alone is NOT a loss — a long
+            jit or a slow collective freezes every rank's heartbeat at
+            once, indistinguishable from a wedge by mtimes. The loss
+            verdict needs peer-death evidence: the heartbeat records
+            the writer's pid, and a provably dead pid (same-host check;
+            the chaos harness and the two-process CI tests run all
+            ranks on one box) confirms the loss fast, while a live pid
+            VETOES staleness (that peer is a straggler — the
+            watchdog's verdict, not a topology change). Only an
+            uncheckable pid (peer on another host) falls back to the
+            staleness threshold. A peer whose heartbeat file was
+            REMOVED finished cleanly (TrainRecorder.close deregisters
+            it) and is not a loss.
+
+  restart   the monitor computes the reduced topology (survivor ranks
+            reindexed densely; all processes of a lost slice are
+            treated as lost), dumps the flight-recorder ring (the
+            pre-restart evidence would otherwise die in the execve),
+            writes a resume-state file, and re-execs THIS process in
+            place with the adjusted JAX_* environment. execve keeps the
+            pid and the inherited stdio, so supervisors (JobSet, the
+            chaos harness, a shell) see one continuous process that
+            exits 0 at the end.
+
+  reshard   the restarted process restores the newest checkpoint;
+            CheckpointManager compares the saved topology tag and
+            reshards onto the reduced mesh (training/checkpoint.py).
+
+  attribute consume_resume_state() reads the resume-state file and
+            charges `detection` (peer's last heartbeat -> the monitor
+            noticed) and `restart` (noticed -> the restarted process is
+            recording again) to the TrainRecorder's badput buckets; the
+            restore/reshard and batch fast-forward land in theirs. The
+            whole gap is named — goodput fraction across a preemption
+            is a first-class metric, not a mystery dent.
+
+Coordinator constraint: survivors can only re-form a jax.distributed
+job if the coordinator (rank 0's host) survived — its address is the
+one piece of the env we cannot recompute locally. If rank 0 was lost
+and more than one survivor remains, the monitor fails LOUDLY (exit
+EXIT_COORDINATOR_LOST) and leaves recovery to the outer Job controller
+(which recreates pods with a fresh coordinator address). A single
+survivor always recovers: it restarts single-process with the
+distributed env cleared.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+from container_engine_accelerators_tpu.metrics import events
+
+log = logging.getLogger(__name__)
+
+RESUME_STATE_ENV = "TPU_ELASTIC_RESUME_STATE"
+RESTARTS_ENV = "TPU_ELASTIC_RESTARTS"
+
+EXIT_COORDINATOR_LOST = 41
+EXIT_RESTART_BUDGET = 42
+
+_DISTRIBUTED_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_PORT",
+                     "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                     "JAX_NUM_SLICES", "MEGASCALE_NUM_SLICES")
+
+
+def read_heartbeats(heartbeat_dir: str) -> dict[int, tuple[float, int]]:
+    """process id -> (mtime, recorded pid) for every hb-<id> file.
+    A pid of -1 means the file exists but its content is unreadable
+    (racing a writer's replace)."""
+    out: dict[int, tuple[float, int]] = {}
+    try:
+        names = os.listdir(heartbeat_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("hb-") or not name[3:].isdigit():
+            continue
+        path = os.path.join(heartbeat_dir, name)
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            continue
+        pid = -1
+        try:
+            with open(path) as f:
+                first = f.read().split()
+                if first and first[0].lstrip("-").isdigit():
+                    pid = int(first[0])
+        except (OSError, ValueError):
+            pass
+        out[int(name[3:])] = (mtime, pid)
+    return out
+
+
+def pid_alive(pid: int) -> bool | None:
+    """True/False when this host can answer; None when it cannot (a
+    peer on another host, permissions). Zombies count as alive — the
+    staleness threshold covers them."""
+    if pid <= 0:
+        return None
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return None
+
+
+def slice_of(process_id: int, num_processes: int, num_slices: int) -> int:
+    """Which slice a rank belongs to under the slice-major process
+    layout (parallel/distributed.py device-order contract)."""
+    per = max(1, num_processes // max(1, num_slices))
+    return process_id // per
+
+
+def expand_lost_to_slices(lost: set[int], num_processes: int,
+                          num_slices: int) -> set[int]:
+    """A lost process loses its WHOLE slice: the slice's ICI domain is
+    broken, its other processes cannot contribute dp shards alone."""
+    lost_slices = {slice_of(p, num_processes, num_slices) for p in lost}
+    return {p for p in range(num_processes)
+            if slice_of(p, num_processes, num_slices) in lost_slices}
+
+
+def plan_restart_env(env: dict, survivors: list[int],
+                     num_slices: int) -> dict | None:
+    """The environment for a survivor's re-exec into the reduced
+    topology, or None when no in-place restart is possible (the
+    coordinator rank was lost and >1 survivor remains — the coordinator
+    address cannot be recomputed locally; the Job controller owns that
+    recovery). Pure: unit-tested without processes."""
+    new = dict(env)
+    new.pop(RESUME_STATE_ENV, None)
+    survivors = sorted(survivors)
+    if len(survivors) <= 1:
+        for var in _DISTRIBUTED_VARS:
+            new.pop(var, None)
+        # Keep the rank as the process IDENTITY even though the
+        # distributed env is gone: heartbeats key on it
+        # (infer_process_id), and a surviving rank 1 restarting as an
+        # inferred rank 0 would refresh the DEAD peer's heartbeat file
+        # — hiding exactly the straggler the watchdog should name.
+        if "JAX_PROCESS_ID" in env:
+            new["JAX_PROCESS_ID"] = env["JAX_PROCESS_ID"]
+        return new
+    if 0 not in survivors:
+        return None
+    old_num = int(env.get("JAX_NUM_PROCESSES", len(survivors)))
+    new["JAX_NUM_PROCESSES"] = str(len(survivors))
+    # Dense re-rank: survivor ranks reindex in order, so rank 0 (the
+    # coordinator) keeps rank 0 and the coordinator address stays valid.
+    self_id = int(env.get("JAX_PROCESS_ID", "0"))
+    new["JAX_PROCESS_ID"] = str(survivors.index(self_id))
+    if num_slices > 1:
+        per = max(1, old_num // num_slices)
+        surviving_slices = {s // per for s in survivors}
+        for var in ("JAX_NUM_SLICES", "MEGASCALE_NUM_SLICES"):
+            if var in new:
+                new[var] = str(len(surviving_slices))
+    return new
+
+
+class SliceLossMonitor:
+    """One daemon thread per training process. `scan()` is the pure
+    detection step (unit-testable); `start()` polls it and triggers the
+    in-place restart on a confirmed loss."""
+
+    def __init__(self, heartbeat_dir: str, process_id: int,
+                 num_processes: int, num_slices: int = 1,
+                 threshold_s: float = 30.0,
+                 interval_s: float | None = None,
+                 min_dead_age_s: float = 1.5,
+                 max_restarts: int = 3,
+                 restart_argv: list[str] | None = None,
+                 dump_dir: str | None = None,
+                 on_loss=None):
+        self.heartbeat_dir = heartbeat_dir
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.num_slices = max(1, num_slices)
+        self.threshold_s = threshold_s
+        # Poll fast regardless of the staleness threshold: the dead-pid
+        # fast path bounds detection latency by the INTERVAL, and a
+        # stat+kill(0) sweep over a handful of peers costs microseconds.
+        self.interval_s = interval_s or max(0.5, min(2.0,
+                                                     threshold_s / 6.0))
+        self.min_dead_age_s = min_dead_age_s
+        self.max_restarts = max_restarts
+        self.restart_argv = restart_argv
+        self.dump_dir = dump_dir
+        # Test seam: called instead of the execve when set; returning
+        # makes the monitor thread stop.
+        self.on_loss = on_loss
+        self._seen: dict[int, float] = {}
+        self._finished: set[int] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---------- detection (pure) ----------
+
+    def scan(self, now: float | None = None,
+             heartbeats: dict | None = None) -> set[int]:
+        """One detection pass; returns the set of LOST peer ids.
+        `now` is wall time (heartbeat mtimes). Peers whose heartbeat
+        vanished after being seen are clean finishers, never losses.
+
+        Staleness alone cannot distinguish a lost peer from a global
+        compile/collective pause (this process's own heartbeat freezes
+        in BOTH cases — a wedged loop and a long jit look identical
+        from mtimes). So: when the peer's recorded pid is CHECKABLE
+        (same host — the chaos harness and the CI two-process tests),
+        a loss requires the pid to be provably dead, and a live pid
+        vetoes staleness (a straggler is the watchdog's verdict, not a
+        topology change). Only an uncheckable pid (a peer on another
+        host) falls back to the pure staleness threshold — size it
+        well above the worst compile pause there."""
+        # tpulint: allow=TPL004(wall-vs-wall, ages come from file mtimes)
+        now = time.time() if now is None else now
+        if heartbeats is None:
+            heartbeats = read_heartbeats(self.heartbeat_dir)
+        lost: set[int] = set()
+        for peer in range(self.num_processes):
+            if peer == self.process_id or peer in self._finished:
+                continue
+            hb = heartbeats.get(peer)
+            if hb is None:
+                if peer in self._seen:
+                    # Deregistered heartbeat = clean exit
+                    # (TrainRecorder.close), not a loss.
+                    self._finished.add(peer)
+                continue
+            mtime, pid = hb
+            self._seen[peer] = mtime
+            age = now - mtime
+            if age <= self.min_dead_age_s:
+                continue
+            alive = pid_alive(pid)
+            if alive is False:
+                # Same-host fast path: the recorded pid is gone — no
+                # need to wait out the full staleness threshold.
+                lost.add(peer)
+            elif alive is None and age > self.threshold_s:
+                lost.add(peer)
+        if lost:
+            lost = expand_lost_to_slices(lost, self.num_processes,
+                                         self.num_slices)
+            lost.discard(self.process_id)
+        return lost
+
+    # ---------- the restart ----------
+
+    def _trigger(self, lost: set[int]) -> None:
+        # tpulint: allow=TPL004(wall-vs-wall: compared against heartbeat file mtimes and read back across an execve)
+        t_detect = time.time()
+        heartbeats = read_heartbeats(self.heartbeat_dir)
+        t_lost = min((heartbeats[p][0] for p in lost if p in heartbeats),
+                     default=t_detect)
+        survivors = sorted(
+            p for p in range(self.num_processes) if p not in lost)
+        restarts = int(os.environ.get(RESTARTS_ENV, "0")) + 1
+        log.warning(
+            "SLICE LOSS: peer(s) %s lost (last heartbeat %.1fs ago); "
+            "survivors %s; restarting into the reduced topology "
+            "(restart %d/%d)", sorted(lost), t_detect - t_lost,
+            survivors, restarts, self.max_restarts)
+        if events.enabled():
+            # The same verdict channel the HangWatchdog uses, with
+            # stronger evidence (a provably dead pid, not just a stale
+            # mtime): the doctor's straggler detector names the lost
+            # rank from these instants on replay, without waiting out
+            # the watchdog's staleness threshold.
+            for p in sorted(lost):
+                hb = heartbeats.get(p)
+                events.instant(
+                    "train/stalled", "health",
+                    {"process": p, "source": "elastic",
+                     "age_s": (round(t_detect - hb[0], 1)
+                               if hb else None)})
+            events.instant("elastic/slice_loss", "train",
+                           {"lost": sorted(lost), "survivors": survivors,
+                            "detection_s": round(t_detect - t_lost, 3)})
+            if self.dump_dir:
+                # The execve destroys the ring; dump the pre-restart
+                # evidence to its own file (the restarted process will
+                # reuse trace-<pid>.json — same pid).
+                events.dump_now(os.path.join(
+                    self.dump_dir,
+                    f"trace-{os.getpid()}-pre{restarts}.json"))
+        state = {
+            "t_lost": t_lost,
+            "t_detect": t_detect,
+            "lost": sorted(lost),
+            "survivors": survivors,
+            "prev_num_processes": self.num_processes,
+            "prev_num_slices": self.num_slices,
+            "restarts": restarts,
+        }
+        state_path = os.path.join(self.heartbeat_dir,
+                                  f"elastic-resume-{self.process_id}.json")
+        tmp = f"{state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+
+        if self.on_loss is not None:
+            self.on_loss(state)
+            return
+
+        if restarts > self.max_restarts:
+            log.error("elastic restart budget exhausted (%d > %d); "
+                      "exiting for the outer controller",
+                      restarts - 1, self.max_restarts)
+            os._exit(EXIT_RESTART_BUDGET)
+        env = plan_restart_env(dict(os.environ), survivors,
+                               self.num_slices)
+        if env is None:
+            log.error(
+                "coordinator rank lost with %d survivors — cannot "
+                "re-form jax.distributed in place; exiting for the "
+                "outer controller to recreate the job", len(survivors))
+            os._exit(EXIT_COORDINATOR_LOST)
+        env[RESUME_STATE_ENV] = state_path
+        env[RESTARTS_ENV] = str(restarts)
+        # The restarted interpreter must resolve this package from the
+        # repo even when launched as a bare script path.
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else repo)
+        argv = self.restart_argv or [sys.argv[0]] + sys.argv[1:]
+        log.warning("execve: %s %s", sys.executable, " ".join(argv))
+        for h in logging.getLogger().handlers:
+            try:
+                h.flush()
+            # tpulint: allow=TPL009(best-effort flush microseconds before execve replaces the process; nowhere to log)
+            except Exception:
+                pass
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # execve from this monitor thread replaces the whole process —
+        # including the main thread wedged in the dead DCN collective.
+        os.execve(sys.executable, [sys.executable] + argv, env)
+
+    # ---------- thread plumbing ----------
+
+    def poll_once(self) -> set[int]:
+        lost = self.scan()
+        if lost:
+            self._trigger(lost)
+        return lost
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.poll_once() and self.on_loss is not None:
+                    return
+            except Exception:
+                log.exception("slice-loss monitor poll failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="elastic-slice-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def consume_resume_state(recorder=None, log_fn=log.info) -> dict | None:
+    """In a restarted process: read the resume-state file the monitor
+    wrote pre-exec, charge the `detection` and `restart` badput buckets
+    on `recorder`, emit the `elastic/resumed` timeline instant, and
+    return the state (None when this run is not an elastic resume).
+    Idempotent per process: the env var is consumed."""
+    path = os.environ.pop(RESUME_STATE_ENV, None)
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("elastic resume state %s unreadable: %s", path, e)
+        return None
+    # tpulint: allow=TPL004(wall-vs-wall: t_lost/t_detect are epoch stamps written by the PRE-exec process; monotonic does not survive execve)
+    now = time.time()
+    detection_s = max(0.0, state["t_detect"] - state["t_lost"])
+    restart_s = max(0.0, now - state["t_detect"])
+    if recorder is not None:
+        recorder.record_badput("detection", detection_s,
+                               detail={"lost": state.get("lost")})
+        recorder.record_badput("restart", restart_s,
+                               detail={"restarts": state.get("restarts")})
+    if events.enabled():
+        events.instant("elastic/resumed", "train",
+                       {"lost": state.get("lost"),
+                        "survivors": state.get("survivors"),
+                        "detection_s": round(detection_s, 3),
+                        "restart_s": round(restart_s, 3)})
+    log_fn(f"elastic resume: lost {state.get('lost')}, "
+           f"now {len(state.get('survivors', []))} process(es); "
+           f"detection {detection_s:.1f}s + restart {restart_s:.1f}s "
+           "charged to badput")
+    return state

@@ -309,11 +309,21 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     The only per-loop fences left are the log-boundary `device_get`
     (reported as `train_host_sync_seconds`) and actual checkpoint
     writes.
+
+    Multi-process (multislice): call `initialize_from_env()` before
+    building `mesh` (cli/train.py does; the JAX_* env contract is in
+    parallel/distributed.py) and pass a mesh whose dp axis spans the
+    slices (`make_mesh(..., dcn_slices=)`). Every rank runs this loop
+    in lockstep: checkpoint saves are collective (each host writes its
+    own shards; rank 0 commits — CheckpointManager docstring), and the
+    recorded topology tag makes a later resume into a REDUCED topology
+    a first-class reshard, attributed to the `reshard` badput bucket.
     """
     import jax.random as jrandom
 
     from container_engine_accelerators_tpu.training.checkpoint import (
         CheckpointManager,
+        current_topology,
     )
 
     rec = recorder
@@ -352,20 +362,30 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         # goodput (the first-step heuristic stays for the initial jit).
         introspection.install(registry=rec.registry, recorder=rec)
 
+    if jax.process_count() > 1:
+        log_fn(f"multislice fit: process {jax.process_index()}/"
+               f"{jax.process_count()}, mesh {dict(mesh.shape)} "
+               f"({mesh.devices.size} devices)")
     key = key if key is not None else jrandom.key(0)
     state = create_train_state(key, cfg, mesh, optimizer)
     mngr = None
     layout = state_layer_layout(cfg, mesh)
+    # The topology tag this run saves under and restores against: a
+    # checkpoint written by a larger topology (pre-slice-loss) restores
+    # here as a RESHARD, attributed to its own badput bucket.
+    topology = current_topology(mesh)
     if ckpt_dir:
         mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
         t0 = time.perf_counter()
-        restored = mngr.restore(state, layout=layout)
+        restored = mngr.restore(state, layout=layout, topology=topology)
         if restored is not None:
             state = restored
             resumed_step = int(jax.device_get(state.step))
+            info = mngr.last_restore_info or {}
             if rec is not None:
-                rec.record_restore(time.perf_counter() - t0,
-                                   step=resumed_step)
+                rec.record_restore(
+                    time.perf_counter() - t0, step=resumed_step,
+                    resharded=bool(info.get("topology_changed")))
             # Resumes are the anchor points of cross-incident forensics
             # ("did the stall start before or after the restart?") —
             # mark them on the flight-recorder timeline even when no
@@ -432,7 +452,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                     with annotate("train/ckpt_save"):
                         ts = time.perf_counter()
                         saved = mngr.save(cur, state, layout=layout,
-                                          cfg=cfg)
+                                          cfg=cfg, topology=topology)
                         save_dt = time.perf_counter() - ts
                 loss = None
                 if log_every and i % log_every == 0:
@@ -453,7 +473,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         if mngr is not None:
             if mngr.latest_step() != cur:
                 ts = time.perf_counter()
-                mngr.save(cur, state, force=True, layout=layout, cfg=cfg)
+                mngr.save(cur, state, force=True, layout=layout, cfg=cfg,
+                          topology=topology)
                 if rec is not None:
                     rec.record_checkpoint_save(time.perf_counter() - ts)
             mngr.wait()

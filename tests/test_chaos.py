@@ -72,12 +72,12 @@ def test_all_shipped_scenarios_validate():
         if fn.endswith(".json"):
             sc = chaos.load_scenario(os.path.join(chaos.SCENARIO_DIR, fn))
             names.add(sc["name"])
-    # The acceptance floor: a full matrix of at least six scenarios,
-    # including the two headline ones.
-    assert len(names) >= 6
+    # The acceptance floor: a full matrix of at least eight scenarios,
+    # including the headline ones.
+    assert len(names) >= 8
     assert {"worker-kill", "engine-hang", "hbm-exhaustion",
             "data-stall", "straggler", "health-storm",
-            "ckpt-kill"} <= names
+            "ckpt-kill", "slice-loss"} <= names
 
 
 def test_smoke_subset_is_bounded():

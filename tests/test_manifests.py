@@ -159,8 +159,14 @@ def test_llama_8b_jobset_memory_budget():
         spec["containers"][0]["resources"]["limits"]["google.com/tpu"])
     n_chips = hosts * chips_per_host
     script = _inline_python(doc)[0]
-    assert "MeshAxes(fsdp=" in script
+    assert "MeshAxes(dp=s, fsdp=" in script
+    assert "dcn_slices=s" in script
     assert "initialize_from_env()" in script
+    # The env contract the script's bootstrap reads must be in the spec.
+    env_names = {e["name"] for e in spec["containers"][0]["env"]}
+    assert {"JAX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_PORT",
+            "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+            "JAX_COORDINATOR_TIMEOUT_S", "JAX_NUM_SLICES"} <= env_names
 
     n_params = llama.llama3_8b().num_params()
     state_per_chip = 12 * n_params / n_chips

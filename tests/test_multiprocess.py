@@ -1,13 +1,17 @@
 """Multi-process distributed backend: two real processes joined via
 jax.distributed (gRPC — the DCN transport), running cross-process
-collectives and a dp-over-processes train step. This is the in-one-box
-analog of the reference's 2-host nccl-test pods (SURVEY.md §3.5)."""
+collectives, a dp-over-processes train step, and the elastic
+slice-loss resume e2e (ISSUE 10). This is the in-one-box analog of the
+reference's 2-host nccl-test pods (SURVEY.md §3.5)."""
 
+import json
 import os
 import re
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -149,3 +153,134 @@ def test_collective_bench_cli_dcn_busbw():
         for l in lines:
             assert l["axis"] == "dcn" and l["devices"] == 8
             assert l["bus_bw_gbps"] > 0, l
+
+
+# ---------- elastic slice-loss resume (ISSUE 10 acceptance e2e) ----------
+
+def _train_argv(steps, out_dir, rank):
+    return [sys.executable, "-m",
+            "container_engine_accelerators_tpu.cli.train",
+            "--steps", str(steps), "--batch-size", "8",
+            "--seq-len", "64", "--log-every", "1",
+            "--ckpt-dir", os.path.join(out_dir, "ckpt"),
+            "--save-every", "5",
+            "--heartbeat-dir", os.path.join(out_dir, "hb"),
+            "--watchdog-threshold", "60",
+            "--metrics-log", os.path.join(out_dir, f"steps-{rank}.jsonl"),
+            "--elastic", "--elastic-threshold", "30"]
+
+
+def _last_json_line(path):
+    with open(path, errors="replace") as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+@pytest.mark.slow
+def test_two_process_elastic_resume(tmp_path):
+    """Acceptance: 2 local CPU processes (1 emulated slice each, dp
+    over gloo) train with checkpoints; one is SIGKILLed mid-run. The
+    survivor detects the loss, re-execs into the reduced single-process
+    topology, reshards the checkpoint, reaches the full step target
+    with the gap charged to the detection/restart/reshard buckets — and
+    its post-resume loss trajectory matches a single-process reference
+    run (same seed, same global batches: dp only split the batch, so
+    reduction must not have changed the math)."""
+    steps = 100
+    out_dir = str(tmp_path)
+    port = free_port()
+    procs = []
+    logs = []
+    for rank in range(2):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", XLA_FLAGS="",
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(rank),
+                   JAX_NUM_SLICES="2")
+        log_path = os.path.join(out_dir, f"out{rank}.log")
+        logs.append(log_path)
+        procs.append(subprocess.Popen(
+            _train_argv(steps, out_dir, rank),
+            cwd=os.path.dirname(HERE), env=env,
+            stdout=open(log_path, "wb"), stderr=subprocess.STDOUT))
+    try:
+        ckpt = os.path.join(out_dir, "ckpt")
+
+        def ckpt_steps():
+            if not os.path.isdir(ckpt):
+                return []
+            return sorted(int(n) for n in os.listdir(ckpt)
+                          if n.isdigit())
+
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not ckpt_steps():
+            assert procs[0].poll() is None, "rank0 died before ckpt"
+            time.sleep(0.5)
+        assert ckpt_steps(), "no checkpoint ever appeared"
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        rc0 = procs[0].wait(timeout=360)
+        assert rc0 == 0, open(logs[0], errors="replace").read()[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    summary = _last_json_line(logs[0])
+    assert summary is not None, "no summary line from the survivor"
+    assert summary["final_step"] == steps
+    assert summary["topology"]["processes"] == 1
+    assert summary["topology"]["elastic_restarts"] == 1
+    g = summary["goodput"]
+    assert g["detection"] > 0, g
+    assert g["restart"] > 0, g
+    assert g["reshard"] > 0, "restore must have translated topologies"
+
+    # Post-resume loss trajectory vs a single-process reference run
+    # from scratch: identical global batches -> identical math up to
+    # reduction-order float noise.
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        read_metrics_jsonl,
+    )
+
+    records = read_metrics_jsonl(os.path.join(out_dir, "steps-0.jsonl"))
+    restores = [r for r in records if r["kind"] == "restore"]
+    assert restores and restores[-1].get("resharded") is True
+    resume_step = int(restores[-1]["step"])
+    survivor_losses = {r["step"]: r["loss"] for r in records
+                       if r["kind"] == "step" and "loss" in r
+                       and r["step"] > resume_step}
+    assert survivor_losses, "no post-resume loss records"
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "JAX_NUM_SLICES"):
+        env.pop(var, None)
+    ref_log = str(ref_dir / "steps.jsonl")
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "container_engine_accelerators_tpu.cli.train",
+         "--steps", str(steps), "--batch-size", "8", "--seq-len", "64",
+         "--log-every", "1", "--metrics-log", ref_log],
+        cwd=os.path.dirname(HERE), env=env, capture_output=True,
+        text=True, timeout=360)
+    assert out.returncode == 0, out.stderr[-2000:]
+    ref_losses = {r["step"]: r["loss"]
+                  for r in read_metrics_jsonl(ref_log)
+                  if r["kind"] == "step" and "loss" in r}
+    compared = 0
+    for step, loss in survivor_losses.items():
+        if step in ref_losses:
+            assert loss == pytest.approx(ref_losses[step], rel=0.05), (
+                step, loss, ref_losses[step])
+            compared += 1
+    assert compared >= 10, (
+        f"only {compared} post-resume steps compared against the "
+        "reference trajectory")

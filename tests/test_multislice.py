@@ -1,0 +1,490 @@
+"""Multislice elastic training (ISSUE 10): slice-aware mesh
+factorisation, the bounded coordinator-connect timeout, checkpoint
+topology tags + multi-process save discipline, slice-loss detection
+and restart planning, and the 2-process CPU-hermetic init + dp-psum
+smoke (`make multislice-smoke` runs everything here plus the elastic
+resume e2e in tests/test_multiprocess.py)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+from container_engine_accelerators_tpu.parallel.mesh import (
+    slice_device_array,
+)
+from container_engine_accelerators_tpu.training import elastic
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+# ---------- slice-aware mesh factorisation ----------
+
+def _fake_devices(n):
+    # Pure index math: the factorisation never touches device attrs.
+    return list(range(n))
+
+
+def test_slice_device_array_dp_outermost_matches_plain_reshape():
+    """pp=1 (the common case): slice-major devices land along dp in
+    exactly the order a plain reshape would give — the slice-aware path
+    is a no-op reordering there."""
+    import numpy as np
+
+    axes = MeshAxes(dp=2, fsdp=4)
+    arr = slice_device_array(_fake_devices(8), axes, dcn_slices=2)
+    np.testing.assert_array_equal(
+        np.asarray(arr, dtype=object).astype(int),
+        np.arange(8).reshape(axes.as_tuple()).astype(int))
+
+
+def test_slice_device_array_pp_outermost_still_puts_slices_on_dp():
+    """The reconciliation case: pp > 1. Every (pp, dp) coordinate must
+    live on the slice dp_i // (dp / S) — i.e. each dp half holds ONE
+    contiguous slice's devices, for every pp stage."""
+    import numpy as np
+
+    axes = MeshAxes(pp=2, dp=2, fsdp=2)
+    arr = np.asarray(slice_device_array(_fake_devices(8), axes,
+                                        dcn_slices=2)).astype(int)
+    # Slice 0 = devices 0..3, slice 1 = devices 4..7.
+    for pp_i in range(2):
+        for dp_i in range(2):
+            devs = arr[pp_i, dp_i].ravel()
+            want_slice = dp_i  # dp/S == 1: dp index IS the slice index
+            assert all(d // 4 == want_slice for d in devs), (
+                pp_i, dp_i, devs)
+    # A naive reshape would instead put slices along pp:
+    naive = np.arange(8).reshape(axes.as_tuple())
+    assert not np.array_equal(arr, naive)
+
+
+def test_slice_device_array_rejects_bad_factorisations():
+    with pytest.raises(ValueError, match="equal slices"):
+        slice_device_array(_fake_devices(9), MeshAxes(dp=2), 2)
+    with pytest.raises(ValueError, match="multiple of dcn_slices"):
+        slice_device_array(_fake_devices(8),
+                           MeshAxes(dp=1, fsdp=8), 2)
+    with pytest.raises(ValueError, match="per slice"):
+        slice_device_array(_fake_devices(8), MeshAxes(dp=2, fsdp=2), 2)
+
+
+def test_make_mesh_dcn_slices_on_real_devices(cpu_devices):
+    """make_mesh(dcn_slices=) builds a working mesh on the 8-device
+    virtual CPU fixture, with each dp slot holding one contiguous
+    4-device block (the emulated slice)."""
+    mesh = make_mesh(MeshAxes(dp=2, fsdp=4), devices=cpu_devices,
+                     dcn_slices=2)
+    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 4, "ep": 1,
+                                "sp": 1, "tp": 1}
+    ids = [[d.id for d in mesh.devices[0, dp_i, :, 0, 0, 0]]
+           for dp_i in range(2)]
+    assert sorted(ids[0]) == [d.id for d in cpu_devices[:4]]
+    assert sorted(ids[1]) == [d.id for d in cpu_devices[4:]]
+
+
+# ---------- coordinator-connect timeout (satellite) ----------
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_initialize_from_env_timeout_is_bounded_and_structured():
+    """A coordinator that is GONE (nothing listening) must produce a
+    CoordinatorConnectError naming the address and rank within the
+    env-tuned bound — not an indefinite hang. Run in a subprocess: the
+    timeout path must exercise a real jax.distributed client."""
+    port = free_port()
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", XLA_FLAGS="",
+               JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+               JAX_NUM_PROCESSES="2", JAX_PROCESS_ID="1",
+               JAX_COORDINATOR_TIMEOUT_S="3")
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from container_engine_accelerators_tpu.parallel.distributed "
+         "import initialize_from_env; initialize_from_env()"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    wall = time.monotonic() - t0
+    assert out.returncode != 0
+    assert "CoordinatorConnectError" in out.stderr
+    assert f"127.0.0.1:{port}" in out.stderr
+    assert "process 1/2" in out.stderr
+    # Bounded: the 3s budget plus interpreter/jax startup slack.
+    assert wall < 90, f"timeout path took {wall:.0f}s"
+
+
+def test_initialize_from_env_inactive_without_env():
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_env,
+    )
+
+    saved = {k: os.environ.pop(k, None)
+             for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES")}
+    try:
+        assert initialize_from_env() is False
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def test_num_slices_env_contract(monkeypatch):
+    from container_engine_accelerators_tpu.parallel import distributed
+
+    monkeypatch.delenv("MEGASCALE_NUM_SLICES", raising=False)
+    monkeypatch.delenv("JAX_NUM_SLICES", raising=False)
+    assert distributed.num_slices() == 1
+    monkeypatch.setenv("JAX_NUM_SLICES", "4")
+    assert distributed.num_slices() == 4
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    assert distributed.num_slices() == 2  # runtime env wins
+
+
+# ---------- checkpoint topology tag + save discipline ----------
+
+def _tiny_state(mesh):
+    from container_engine_accelerators_tpu.models import llama_tiny
+    from container_engine_accelerators_tpu.training import (
+        create_train_state, make_optimizer,
+    )
+
+    cfg = llama_tiny(vocab_size=64)
+    opt = make_optimizer(warmup_steps=2, decay_steps=50)
+    return create_train_state(jax.random.key(0), cfg, mesh, opt)
+
+
+def test_checkpoint_topology_tag_roundtrip_and_reshard_flag(
+        tmp_path, mesh8):
+    """The topology tag is recorded at save and compared at restore:
+    same topology -> no translation; a DIFFERENT topology (the
+    slice-loss survivor's reduced mesh) -> last_restore_info marks the
+    reshard."""
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        CheckpointManager, current_topology,
+    )
+
+    state = _tiny_state(mesh8)
+    topo = current_topology(mesh8)
+    mngr = CheckpointManager(str(tmp_path / "ckpt"),
+                             save_interval_steps=1)
+    assert mngr.save(1, state, topology=topo)
+    mngr.wait()
+    assert mngr.saved_topology(1) == topo
+
+    restored = mngr.restore(state, topology=topo)
+    assert restored is not None
+    assert mngr.last_restore_info["topology_changed"] is False
+
+    # The survivor's view: fewer processes/devices.
+    reduced = dict(topo, processes=1, devices=topo["devices"] // 2,
+                   axes=dict(topo["axes"], dp=1))
+    restored = mngr.restore(state, topology=reduced)
+    assert restored is not None
+    info = mngr.last_restore_info
+    assert info["topology_changed"] is True
+    assert info["saved_topology"] == topo
+    mngr.close()
+
+
+def test_checkpoint_topology_changed_semantics():
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        topology_changed,
+    )
+
+    a = {"processes": 2, "devices": 8, "axes": {"dp": 2}}
+    assert topology_changed(a, dict(a, processes=1)) is True
+    assert topology_changed(a, dict(a)) is False
+    # Pre-tag checkpoints make no claim.
+    assert topology_changed(None, a) is False
+    assert topology_changed(a, None) is False
+
+
+def test_checkpoint_save_single_writer_in_process(tmp_path, mesh8):
+    """Two concurrent saves into one directory must raise, not
+    interleave (the regression: two fake ranks' managers in one
+    process racing the atomic commit)."""
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    state = _tiny_state(mesh8)
+    d = str(tmp_path / "ckpt")
+    rank0 = CheckpointManager(d, save_interval_steps=1, process_index=0)
+    rank1 = CheckpointManager(d, save_interval_steps=1, process_index=1)
+    # Simulate rank 0 mid-save: its in-flight marker is registered.
+    with CheckpointManager._inflight_lock:
+        CheckpointManager._inflight[rank0._dir] = id(rank0)
+    try:
+        with pytest.raises(RuntimeError, match="single-writer"):
+            rank1.save(1, state)
+    finally:
+        with CheckpointManager._inflight_lock:
+            CheckpointManager._inflight.pop(rank0._dir, None)
+    # With the marker released the save path works again.
+    assert rank1.save(1, state)
+    rank1.wait()
+    rank0.close()
+    rank1.close()
+
+
+def test_checkpoint_quarantine_is_rank0_only(tmp_path, mesh8):
+    """Restore fallback on a torn newest checkpoint: a non-zero rank
+    must fall back WITHOUT renaming (rank 0 owns the namespace); rank 0
+    performs the quarantine."""
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    state = _tiny_state(mesh8)
+    d = str(tmp_path / "ckpt")
+    mngr = CheckpointManager(d, save_interval_steps=1, process_index=0)
+    assert mngr.save(1, state)
+    assert mngr.save(2, state, force=True)
+    mngr.wait()
+    mngr.close()
+
+    # Tear the newest step.
+    step_dir = os.path.join(d, "2")
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in files:
+            path = os.path.join(root, fn)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(path) // 3))
+
+    rank1 = CheckpointManager(d, save_interval_steps=1, process_index=1)
+    restored = rank1.restore(_tiny_state(mesh8))
+    assert restored is not None
+    # No rename happened: the torn step dir is still there.
+    assert os.path.isdir(step_dir)
+    assert not any(".corrupt" in n for n in os.listdir(d))
+    rank1.close()
+
+    rank0 = CheckpointManager(d, save_interval_steps=1, process_index=0)
+    restored = rank0.restore(_tiny_state(mesh8))
+    assert restored is not None
+    assert not os.path.isdir(step_dir)
+    assert any(".corrupt" in n for n in os.listdir(d))
+    rank0.close()
+
+
+# ---------- goodput badput buckets ----------
+
+def test_record_badput_and_resharded_restore_buckets():
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        GOODPUT_BUCKETS, TrainRecorder,
+    )
+
+    assert {"detection", "restart", "reshard"} <= set(GOODPUT_BUCKETS)
+    rec = TrainRecorder(now=100.0)
+    rec.record_badput("detection", 3.0, now=103.0)
+    rec.record_badput("restart", 2.0, now=105.0)
+    rec.record_restore(1.5, step=4, resharded=True, now=106.5)
+    rec.record_fast_forward(0.5, batches=4, now=107.0)
+    g = rec.goodput(now=110.0)
+    assert g["detection"] == pytest.approx(3.0)
+    assert g["restart"] == pytest.approx(2.0)
+    assert g["reshard"] == pytest.approx(1.5)
+    assert g["restore"] == pytest.approx(0.5)  # fast-forward only
+    with pytest.raises(ValueError, match="unknown goodput bucket"):
+        rec.record_badput("vibes", 1.0)
+
+
+# ---------- slice-loss detection + restart planning (pure) ----------
+
+def _hb(tmp_path, pid_by_rank):
+    hb = tmp_path / "hb"
+    hb.mkdir(parents=True, exist_ok=True)
+    for rank, pid in pid_by_rank.items():
+        (hb / f"hb-{rank}").write_text(f"{pid} 0\n")
+    return str(hb)
+
+
+def test_scan_dead_pid_fast_path_and_live_pid_veto(tmp_path):
+    """A stale heartbeat with a LIVE pid is a straggler (vetoed); a
+    provably dead pid is a loss even before the staleness threshold."""
+    own = os.getpid()
+    # A pid that is certainly dead: spawn-and-reap.
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = p.pid
+    hb_dir = _hb(tmp_path, {0: own, 1: dead})
+    old = time.time() - 10
+    for r in (0, 1):
+        os.utime(os.path.join(hb_dir, f"hb-{r}"), (old, old))
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                   num_processes=2, threshold_s=3600.0)
+    assert mon.scan() == {1}
+
+    # Live pid: stale mtime alone must NOT trigger.
+    hb_dir2 = _hb(tmp_path / "b", {0: own, 1: own})
+    for r in (0, 1):
+        os.utime(os.path.join(hb_dir2, f"hb-{r}"), (old, old))
+    mon2 = elastic.SliceLossMonitor(hb_dir2, process_id=0,
+                                    num_processes=2, threshold_s=2.0)
+    assert mon2.scan() == set()
+
+
+def test_scan_removed_heartbeat_is_clean_finish_not_loss(tmp_path):
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own, 1: own})
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                   num_processes=2, threshold_s=2.0)
+    assert mon.scan() == set()          # both fresh
+    os.remove(os.path.join(hb_dir, "hb-1"))
+    assert mon.scan() == set()          # deregistered = finished
+    assert 1 in mon._finished
+
+
+def test_scan_uncheckable_pid_falls_back_to_staleness(tmp_path):
+    hb_dir = _hb(tmp_path, {0: os.getpid(), 1: -1})  # pid unreadable
+    old = time.time() - 50
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                   num_processes=2, threshold_s=30.0)
+    assert mon.scan() == {1}
+    mon2 = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                    num_processes=2, threshold_s=300.0)
+    assert mon2.scan() == set()
+
+
+def test_expand_lost_to_slices():
+    # 4 processes, 2 slices (2 procs each): losing rank 3 loses slice 1.
+    assert elastic.expand_lost_to_slices({3}, 4, 2) == {2, 3}
+    assert elastic.expand_lost_to_slices({0}, 4, 2) == {0, 1}
+    # 1 proc per slice: identity.
+    assert elastic.expand_lost_to_slices({1}, 2, 2) == {1}
+
+
+def test_plan_restart_env_reduced_topologies():
+    base = {"JAX_COORDINATOR_ADDRESS": "127.0.0.1:8476",
+            "JAX_NUM_PROCESSES": "4", "JAX_PROCESS_ID": "1",
+            "JAX_NUM_SLICES": "2", "OTHER": "kept"}
+    # Sole survivor: distributed env cleared, but the rank survives as
+    # the process IDENTITY (heartbeat file key) — a surviving rank 1
+    # must not restart as an inferred rank 0 and refresh the dead
+    # peer's heartbeat.
+    env = elastic.plan_restart_env(dict(base), [1], num_slices=2)
+    assert "JAX_COORDINATOR_ADDRESS" not in env
+    assert "JAX_NUM_PROCESSES" not in env
+    assert "JAX_NUM_SLICES" not in env
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["OTHER"] == "kept"
+    # Coordinator survived: dense re-rank, slice count reduced.
+    env = elastic.plan_restart_env(dict(base), [0, 1], num_slices=2)
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["JAX_NUM_SLICES"] == "1"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "127.0.0.1:8476"
+    # Coordinator lost with >1 survivor: no in-place restart.
+    assert elastic.plan_restart_env(dict(base), [1, 2, 3],
+                                    num_slices=2) is None
+
+
+def test_monitor_trigger_writes_resume_state_via_on_loss(tmp_path):
+    """The on_loss seam: a confirmed loss writes the resume-state file
+    (t_lost from the dead peer's heartbeat) without exec'ing; then
+    consume_resume_state charges detection + restart on a recorder."""
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder,
+    )
+
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    hb_dir = _hb(tmp_path, {0: os.getpid(), 1: p.pid})
+    old = time.time() - 5
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    got = {}
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                   num_processes=2, threshold_s=3600.0,
+                                   on_loss=got.update)
+    assert mon.poll_once() == {1}
+    assert got["lost"] == [1] and got["survivors"] == [0]
+    assert got["t_detect"] - got["t_lost"] == pytest.approx(5.0, abs=2.0)
+    state_path = os.path.join(hb_dir, "elastic-resume-0.json")
+    assert json.load(open(state_path)) == got
+
+    rec = TrainRecorder()
+    os.environ[elastic.RESUME_STATE_ENV] = state_path
+    state = elastic.consume_resume_state(rec)
+    assert state is not None
+    assert elastic.RESUME_STATE_ENV not in os.environ  # consumed
+    g = rec.goodput()
+    assert g["detection"] == pytest.approx(got["t_detect"] - got["t_lost"],
+                                           abs=0.5)
+    assert g["restart"] > 0.0
+
+
+# ---------- 2-process CPU-hermetic init + dp-psum smoke ----------
+
+_PSUM_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+from container_engine_accelerators_tpu.parallel.distributed import (
+    initialize_from_env)
+
+assert initialize_from_env(), "distributed init did not activate"
+devs = jax.devices()
+assert jax.process_count() == 2, jax.process_count()
+mesh = make_mesh(MeshAxes(dp=2, fsdp=len(devs) // 2), devices=devs,
+                 dcn_slices=2)
+x = jax.device_put(jnp.arange(8, dtype=jnp.float32).reshape(2, 4),
+                   NamedSharding(mesh, P("dp")))
+
+
+@jax.jit
+def total(x):
+    return jnp.sum(x)
+
+
+print("RESULT proc=%d total=%.1f" % (jax.process_index(),
+                                     float(jax.device_get(total(x)))),
+      flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_multislice_init_and_dp_sum(tmp_path):
+    """The multislice bootstrap end to end on CPU: two processes join
+    via jax.distributed (gloo collectives — the fix that un-broke every
+    multi-process CPU computation here), build the slice-aware mesh,
+    and reduce a dp-sharded array across the process boundary."""
+    script = tmp_path / "worker.py"
+    script.write_text(_PSUM_WORKER.format(repo=REPO))
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                   JAX_NUM_SLICES="2")
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, f"worker failed:\n{out[-2000:]}"
+    for out in outs:
+        assert "total=28.0" in out, out[-500:]

@@ -357,10 +357,14 @@ def test_injected_recompile_fails_gate_with_dim_diff(
     assert "->" in rc["diff"]  # the exact dimension change, attributed
 
 
-def test_two_hermetic_runs_agree_within_band(tier, tmp_path):
+def test_two_hermetic_runs_agree_within_band(tier, tmp_path,
+                                             monkeypatch):
     """Acceptance (determinism): learn a baseline, then two
     back-to-back hermetic runs both gate `ok` against it — the tier is
-    repeatable inside its own learned noise bands."""
+    repeatable inside its own learned noise bands. (The 2-process
+    multislice probe is pinned off: this test exercises the in-process
+    tier; the probe has its own test below.)"""
+    monkeypatch.setenv(perf_gate.MULTISLICE_ENV, "0")
     ns = argparse.Namespace(out=str(tmp_path / "PERF_BASELINE.json"),
                             k=2, steps=6)
     assert perf_gate.cmd_baseline(ns) == 0
@@ -374,3 +378,46 @@ def test_two_hermetic_runs_agree_within_band(tier, tmp_path):
             t, ns.out, report_path=str(tmp_path / f"r{i}.json"))
         verdicts.append((code, report["verdict"]))
     assert verdicts == [(0, "ok"), (0, "ok")]
+
+
+# ---------- the 2-process multislice metric (ISSUE 10) ----------
+
+@pytest.mark.slow
+def test_multislice_probe_metric_schema_and_positive():
+    """The 2-process dp-over-gloo probe produces a schema-complete
+    multislice_step_ms result with positive samples."""
+    tier = perf_gate.run_hermetic_tier(k=1, steps=4, multislice=True)
+    assert tier["multislice"] is True
+    assert perf_gate.MULTISLICE_METRIC in tier["metrics"], \
+        "multislice probe produced no metric"
+    info = tier["metrics"][perf_gate.MULTISLICE_METRIC]
+    assert len(info["samples"]) == 1 and info["samples"][0] > 0
+    result = [r for r in tier["results"]
+              if r["metric"] == perf_gate.MULTISLICE_METRIC][0]
+    assert harness.validate_result(result) == []
+
+
+def test_gate_skips_multislice_baseline_row_when_probe_off(tmp_path,
+                                                           capsys):
+    """A baseline that carries multislice_step_ms must not force a
+    missing-metric no_signal on a run that deliberately skipped the
+    probe (library calls / PERF_GATE_MULTISLICE=0) — the row is
+    dropped with a printed notice instead."""
+    metrics = {"train_step_ms": {"value": 10.0, "band": 0.5,
+                                 "unit": "ms"},
+               perf_gate.MULTISLICE_METRIC: {"value": 50.0,
+                                             "band": 0.5,
+                                             "unit": "ms"}}
+    bl = _write_baseline(tmp_path / "b.json", metrics)
+    tier = {"metrics": {"train_step_ms": {"samples": [10.0],
+                                          "unit": "ms"}},
+            "results": [], "recompiles": [], "multislice": False,
+            "backend_probe": {"outcome": "ok", "platform": "cpu"},
+            "k": 1, "steps": 4, "wall_s": 0.1}
+    code, report = perf_gate.gate_check(
+        tier, bl, report_path=str(tmp_path / "r.json"))
+    assert code == 0
+    assert report["verdict"] == "ok"
+    assert "skipped this run" in capsys.readouterr().err
+    assert not any(r["metric"] == perf_gate.MULTISLICE_METRIC
+                   for r in report["rows"])

@@ -304,7 +304,10 @@ def check_healthz(body: dict, expect: dict) -> list[dict]:
 def check_train(summary: dict | None, spec: dict,
                 label: str = "train") -> list[dict]:
     """(c) training: step target reached across the fault, with the
-    gap charged to the named badput buckets."""
+    gap charged to the named badput buckets. Every train check also
+    REPORTS the goodput fraction and the badput split as an
+    informational row, so each scenario's report.json carries
+    '% of wall-clock productive across the fault' as an artifact."""
     out = []
     if summary is None:
         return [_result(f"{label}.summary", False,
@@ -322,11 +325,34 @@ def check_train(summary: dict | None, spec: dict,
             f"goodput[{bucket}]={got:.3f}s, need >= {min_s}s "
             "(the fault's cost must be attributed, not hidden)"))
     if spec.get("resumed"):
-        got = float(g.get("restore", 0.0))
+        # A reshard IS a restore that additionally translated
+        # topologies (the elastic slice-loss resume); either bucket
+        # proves the run came back from a checkpoint.
+        got = float(g.get("restore", 0.0)) + float(g.get("reshard", 0.0))
         out.append(_result(
             f"{label}.resumed", got > 0.0,
-            f"goodput[restore]={got:.3f}s (0 means the run never "
-            "restored a checkpoint)"))
+            f"goodput[restore+reshard]={got:.3f}s (0 means the run "
+            "never restored a checkpoint)"))
+    if spec.get("resharded"):
+        got = float(g.get("reshard", 0.0))
+        out.append(_result(
+            f"{label}.resharded", got > 0.0,
+            f"goodput[reshard]={got:.3f}s (0 means the restore never "
+            "translated topologies)"))
+    if "goodput_fraction_min" in spec:
+        frac = float(g.get("goodput_fraction", 0.0))
+        out.append(_result(
+            f"{label}.goodput_fraction",
+            frac >= float(spec["goodput_fraction_min"]),
+            f"goodput_fraction={frac:.3f}, need >= "
+            f"{spec['goodput_fraction_min']}"))
+    badput = {k: round(float(v), 3) for k, v in g.items()
+              if k not in ("productive", "elapsed", "goodput_fraction")
+              and isinstance(v, (int, float)) and v > 0}
+    out.append(_result(
+        f"{label}.goodput_report", True,
+        f"goodput_fraction={g.get('goodput_fraction')} "
+        f"elapsed={g.get('elapsed')}s badput={badput}"))
     return out
 
 
@@ -666,6 +692,9 @@ class ScenarioRun:
             "$CKPT_DIR": os.path.join(self.out_dir, "ckpt"),
             "$HEALTH_LOG": os.path.join(self.out_dir,
                                         "health-errors.jsonl"),
+            # One fresh port per scenario run: multi-process train
+            # workloads point JAX_COORDINATOR_ADDRESS at it.
+            "$COORD_PORT": str(_free_port()),
         }
         self.workloads = {
             w.get("id", w["kind"]): Workload(w, self.out_dir, self.subs)

@@ -1,0 +1,112 @@
+"""Two-process multislice step probe — the perf-gate worker (ISSUE 10,
+ROADMAP item 5: items 1–3 extend the hermetic tier with their own
+metrics).
+
+Run as one rank of a 2-process jax.distributed job (env contract in
+parallel/distributed.py): builds the slice-aware dp=2 mesh, trains
+llama_tiny with the REAL make_train_step (the dp gradient psum crosses
+the process boundary over gloo — the hermetic stand-in for DCN), and
+rank 0 prints one JSON line:
+
+  {"kind": "multislice_probe", "samples_ms": [p50 per pass, k of them],
+   "percentiles": {...}}
+
+tools/perf_gate.py spawns both ranks and scores the median-of-k as
+`multislice_step_ms`. Deterministic: fixed seeds, per-step fence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--k", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = ""
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_env,
+    )
+
+    assert initialize_from_env(), "multislice probe needs the JAX_* env"
+    import jax
+
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama_tiny
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes,
+        make_mesh,
+    )
+    from container_engine_accelerators_tpu.training import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from container_engine_accelerators_tpu.training.data import (
+        synthetic_batches,
+    )
+    from container_engine_accelerators_tpu.training.train import (
+        shard_batch,
+    )
+
+    devs = jax.devices()
+    n_proc = jax.process_count()
+    assert n_proc == 2, f"expected 2 processes, got {n_proc}"
+    mesh = make_mesh(MeshAxes(dp=2, fsdp=len(devs) // 2), devices=devs,
+                     dcn_slices=2)
+    cfg = llama_tiny(vocab_size=64)
+    opt = make_optimizer(warmup_steps=2, decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    step_fn = make_train_step(cfg, mesh, opt)
+    batch = shard_batch(
+        next(iter(synthetic_batches(cfg.vocab_size, args.batch_size,
+                                    args.seq_len, num_batches=1))),
+        mesh)
+    box = [state]
+    for _ in range(3):  # warmup: all compiles land here
+        box[0], metrics = step_fn(box[0], batch)
+        float(jax.device_get(metrics["loss"]))
+
+    from container_engine_accelerators_tpu import bench_harness as harness
+
+    rec = TrainRecorder()
+    tokens = args.batch_size * args.seq_len
+    samples_ms = []
+    pcts = {}
+    for _ in range(args.k):
+        times = []
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            box[0], metrics = step_fn(box[0], batch)
+            # Per-step fence: this metric is dp-over-DCN step LATENCY.
+            float(jax.device_get(metrics["loss"]))
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            rec.record_steps(1, dt, tokens)
+        samples_ms.append(round(harness.median(times) * 1e3, 4))
+        pcts = rec.pct_ms("step")
+    if jax.process_index() == 0:
+        print(json.dumps({"kind": "multislice_probe",
+                          "samples_ms": samples_ms,
+                          "percentiles": pcts}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

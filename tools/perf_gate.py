@@ -20,6 +20,15 @@ environment's TPU plugin), tiny shapes, fixed seeds:
   decode_step_paged_ms   paged-engine decode step    (RequestRecorder)
   matmul_scan_ms         stacked scan matmul (the component_bench shape
                          family, shrunk to tier-1 budget)
+  multislice_step_ms     dp=2 train step across TWO real OS processes
+                         joined by jax.distributed over gloo — the
+                         hermetic stand-in for the DCN gradient psum
+                         (ISSUE 10; tools/multislice_probe.py). CLI
+                         runs measure it by default; library calls to
+                         run_hermetic_tier skip it unless asked
+                         (PERF_GATE_MULTISLICE overrides either way),
+                         and a skipped run drops the baseline row
+                         rather than scoring a missing metric.
 
 Each metric runs k independent passes; the per-pass value is the
 recorder-derived p50 step time and the metric's value is the
@@ -93,6 +102,14 @@ STEPS_ENV = "PERF_GATE_STEPS"
 BAND_SCALE_ENV = "PERF_GATE_BAND_SCALE"
 INJECT_SLOWDOWN_ENV = "PERF_GATE_INJECT_SLOWDOWN"
 INJECT_RECOMPILE_ENV = "PERF_GATE_INJECT_RECOMPILE"
+# The 2-process multislice metric (ISSUE 10; ROADMAP item 5 asks each
+# arc to extend the tier). "auto" = on for the CLI commands, off for
+# library calls to run_hermetic_tier (tests drive the in-process tier
+# directly and shouldn't pay two subprocess spawns per call); "1"/"0"
+# force it either way.
+MULTISLICE_ENV = "PERF_GATE_MULTISLICE"
+MULTISLICE_METRIC = "multislice_step_ms"
+MULTISLICE_TIMEOUT_ENV = "PERF_GATE_MULTISLICE_TIMEOUT_S"
 
 EXIT_OK = 0
 EXIT_REGRESSION = 2
@@ -396,8 +413,77 @@ def _matmul_bench():
     return "matmul_scan_ms", measure, None
 
 
+def _multislice_env_enabled(default: bool) -> bool:
+    raw = os.environ.get(MULTISLICE_ENV, "auto").strip().lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off"):
+        return False
+    return default
+
+
+def run_multislice_probe(k: int, steps: int) -> dict | None:
+    """Spawn the 2-process jax.distributed probe
+    (tools/multislice_probe.py) once; rank 0 reports k per-pass p50
+    samples of the dp-over-gloo train step. Returns
+    {"samples": [...ms], "percentiles": {...}} or None when the probe
+    could not run (spawn failure / timeout / bad output) — the caller
+    treats that as a missing metric, which the gate surfaces as a loud
+    no_signal, never a crash."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    timeout_s = harness.env_float(MULTISLICE_TIMEOUT_ENV, 300.0)
+    procs = []
+    outs = []
+    try:
+        for rank in range(2):
+            env = dict(os.environ,
+                       JAX_PLATFORMS="cpu", XLA_FLAGS="",
+                       JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                       JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(rank),
+                       JAX_NUM_SLICES="2")
+            procs.append(subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "multislice_probe.py"),
+                 "--k", str(k), "--steps", str(steps)],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout_s)
+            outs.append(out)
+            if p.returncode != 0:
+                print("perf-gate: multislice probe rank failed "
+                      f"(rc={p.returncode}):\n{out[-1500:]}",
+                      file=sys.stderr)
+                return None
+    except Exception as e:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        print(f"perf-gate: multislice probe did not complete: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return None
+    for out in outs:
+        for line in out.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "multislice_probe":
+                return {"samples": rec["samples_ms"],
+                        "percentiles": rec.get("percentiles", {})}
+    print("perf-gate: multislice probe produced no result line",
+          file=sys.stderr)
+    return None
+
+
 def run_hermetic_tier(k: int | None = None, steps: int | None = None,
-                      inject_recompile: bool | None = None) -> dict:
+                      inject_recompile: bool | None = None,
+                      multislice: bool = False) -> dict:
     """Run the whole CPU-hermetic tier: setup+warmup every bench (all
     compiles land HERE), then measure k passes per metric inside ONE
     RecompileGuard window. Returns samples, recorder percentiles,
@@ -444,9 +530,25 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
                 samples_ms=samples_ms, k=k, steps_per_pass=steps,
                 tier="cpu-hermetic")))
         recompiles = guard.new_recompiles()
+    multislice_on = _multislice_env_enabled(multislice)
+    if multislice_on:
+        # Outside the RecompileGuard window: the probe's compiles
+        # happen in its own processes, invisible to this tracker.
+        ms = run_multislice_probe(k, steps)
+        if ms is not None:
+            value = round(harness.median(ms["samples"]), 4)
+            metrics[MULTISLICE_METRIC] = {
+                "samples": ms["samples"], "unit": "ms",
+                "percentiles": ms["percentiles"]}
+            results.append(harness.check_result(harness.make_result(
+                MULTISLICE_METRIC, value, "ms",
+                percentiles={"multislice_step": ms["percentiles"]},
+                backend_probe=probe, status="ok",
+                samples_ms=ms["samples"], k=k, steps_per_pass=steps,
+                tier="cpu-hermetic")))
     return {"metrics": metrics, "results": results,
             "backend_probe": probe, "recompiles": recompiles,
-            "k": k, "steps": steps,
+            "k": k, "steps": steps, "multislice": multislice_on,
             "wall_s": round(time.monotonic() - t_start, 2)}
 
 
@@ -501,8 +603,18 @@ def gate_check(tier: dict, baseline_path: str,
             None, tier["backend_probe"]["platform"]):
         verdict = "no_signal:platform_mismatch"
     else:
-        verdict, rows = compare(baseline["metrics"], current,
-                                band_scale)
+        baseline_metrics = baseline["metrics"]
+        if not tier.get("multislice") and MULTISLICE_METRIC in \
+                baseline_metrics:
+            # The tier deliberately skipped the 2-process probe
+            # (library call / PERF_GATE_MULTISLICE=0): not measuring
+            # it is a choice here, not lost coverage — drop the
+            # baseline row instead of scoring a missing metric.
+            print(f"perf-gate: {MULTISLICE_METRIC} skipped this run "
+                  f"({MULTISLICE_ENV} off); not gated", file=sys.stderr)
+            baseline_metrics = {k: v for k, v in baseline_metrics.items()
+                                if k != MULTISLICE_METRIC}
+        verdict, rows = compare(baseline_metrics, current, band_scale)
 
     report = {
         "kind": "perf_gate_report",
@@ -540,7 +652,8 @@ def gate_check(tier: dict, baseline_path: str,
 
 
 def cmd_check(args) -> int:
-    tier = run_hermetic_tier(k=args.k, steps=args.steps)
+    tier = run_hermetic_tier(k=args.k, steps=args.steps,
+                             multislice=True)
     code, _ = gate_check(tier, args.baseline,
                          band_scale=args.band_scale,
                          report_path=args.report)
@@ -549,7 +662,7 @@ def cmd_check(args) -> int:
 
 def cmd_baseline(args) -> int:
     tier = run_hermetic_tier(k=args.k or BASELINE_K_DEFAULT,
-                             steps=args.steps)
+                             steps=args.steps, multislice=True)
     if tier["backend_probe"]["outcome"] != "ok":
         print("perf-gate: backend probe failed — refusing to write a "
               "baseline with no data", file=sys.stderr)
