@@ -165,6 +165,14 @@ def main(argv=None) -> int:
     cfg = build_config(args.preset, args.vocab_size)
     n_dev = len(jax.devices())
     slices = args.dcn_slices if args.dcn_slices else dist.num_slices()
+    if int(os.environ.get(elastic.RESTARTS_ENV, "0")) > 0:
+        # Elastic re-exec: the replayed argv may carry --dcn-slices /
+        # --batch-size sized for the PRE-loss topology; the reduced
+        # env the monitor wrote is authoritative.
+        slices, args.batch_size, notes = elastic.reconcile_resume_topology(
+            args.dcn_slices, dist.num_slices(), args.batch_size)
+        for note in notes:
+            log.warning("elastic resume: %s", note)
     if slices > 1:
         # Multislice: slices along dp (gradient psum is the only DCN
         # collective), each slice's devices along fsdp — the

@@ -98,6 +98,44 @@ def detect_peak_flops() -> float:
     return 197e12
 
 
+_HOST_ID: str | None = None
+
+
+def host_id() -> str:
+    """Stable identity of THIS host, stamped into heartbeat files so a
+    peer's SliceLossMonitor (training/elastic.py) knows whether the
+    recorded pid is checkable against the local pid table. Under the
+    multi-host deployment (shared heartbeat dir across JobSet pods,
+    each with its own PID namespace) every pod reports its own
+    hostname; the chaos harness and the two-process CI tests all run on
+    one box and report the same value."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        import socket
+
+        # Heartbeat fields are space-separated; a hostname with
+        # whitespace (never legal, but defensive) must not tear the
+        # format.
+        _HOST_ID = (socket.gethostname() or "unknown-host").split()[0]
+    return _HOST_ID
+
+
+def proc_start_ticks(pid: int) -> int | None:
+    """Kernel start time of `pid` (clock ticks since boot, field 22 of
+    /proc/<pid>/stat) — the pid-reuse discriminator: a recycled pid
+    number never keeps the original start time. None when unreadable
+    (no /proc, vanished process, hidepid mounts)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # comm (field 2) may itself contain spaces and parens; the
+        # numeric fields start after the LAST ')'.
+        rest = data.rpartition(b")")[2].split()
+        return int(rest[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def read_metrics_jsonl(path: str) -> list[dict]:
     """Parse a step-metrics JSONL log, tolerating a torn tail: every
     complete line is one record; the final line of a killed writer may
@@ -185,6 +223,9 @@ class TrainRecorder:
             os.makedirs(heartbeat_dir, exist_ok=True)
             self._hb_path = os.path.join(heartbeat_dir, f"hb-{process_id}")
         self.process_id = process_id or 0
+        # 0 = start time unknown (no /proc): peers then treat a live
+        # pid number as unverified rather than proof of this writer.
+        self._start_ticks = proc_start_ticks(os.getpid()) or 0
         if self._hb_path is not None:
             # Touch at construction, not only at the first step edge: a
             # process restarted by the elastic supervisor spends its
@@ -292,11 +333,15 @@ class TrainRecorder:
             return
         try:
             # tmp + os.replace: the monitor keys on mtime, but replace
-            # also keeps the `pid step` content always whole for the
-            # human debugging a stall (TPL003).
+            # also keeps the `pid step host start-ticks` content always
+            # whole for the human debugging a stall (TPL003). host and
+            # start-ticks let a peer's SliceLossMonitor decide whether
+            # the pid is checkable locally and whether a live pid
+            # number is still THIS writer (vs a post-SIGKILL reuse).
             tmp = f"{self._hb_path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
-                f.write(f"{os.getpid()} {self._last_step}\n")
+                f.write(f"{os.getpid()} {self._last_step} "
+                        f"{host_id()} {self._start_ticks}\n")
             os.replace(tmp, self._hb_path)
         except OSError:
             log.exception("heartbeat touch failed; disabling")

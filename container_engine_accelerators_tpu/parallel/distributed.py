@@ -6,7 +6,9 @@ replacement is `jax.distributed.initialize` against a coordinator address
 delivered by the Job/JobSet environment (SURVEY.md §7 hard part d).
 
 Env contract (set by dcn-multislice manifests; JobSet-compatible):
-  JAX_COORDINATOR_ADDRESS   host[:port] of process 0
+  JAX_COORDINATOR_ADDRESS   host[:port] of process 0; IPv6 literals
+                            either bare ("::1", port defaulted) or
+                            bracketed ("[::1]:8476")
   JAX_COORDINATOR_PORT      default 8476 (used when address has no port)
   JAX_NUM_PROCESSES         total processes
   JAX_PROCESS_ID            this process's rank, or derived from
@@ -111,6 +113,23 @@ def coordinator_timeout_s() -> float:
         return DEFAULT_COORDINATOR_TIMEOUT_S
 
 
+def split_host_port(address: str,
+                    default_port: str = "8476") -> tuple[str, str]:
+    """(host, port) from a coordinator address. Handles 'host',
+    'host:port', bracketed IPv6 ('[::1]:8476', '[::1]'), and bare IPv6
+    literals ('::1' — two or more colons without brackets cannot carry
+    a port, so the default applies; a naive rpartition would misread
+    the last hextet as one)."""
+    if address.startswith("["):
+        host, _, rest = address[1:].partition("]")
+        port = rest[1:] if rest.startswith(":") else ""
+        return host, port or default_port
+    if address.count(":") >= 2:
+        return address, default_port
+    host, sep, port = address.partition(":")
+    return host, (port if sep and port else default_port)
+
+
 def _configure_cpu_collectives() -> None:
     """Cross-process collectives for the CPU platform (the hermetic
     test/chaos transport): gloo unless JAX_CPU_COLLECTIVES says
@@ -152,7 +171,7 @@ def _probe_coordinator(address: str, process_id: int,
         return
     import socket
 
-    host, _, port = address.rpartition(":")
+    host, port = split_host_port(address)
     deadline = time.monotonic() + timeout_s
     last_err: BaseException = TimeoutError(
         f"no listener within {timeout_s:.0f}s")
@@ -188,8 +207,11 @@ def initialize_from_env() -> bool:
     num = os.environ.get("JAX_NUM_PROCESSES")
     if not address or not num:
         return False
-    if ":" not in address:
-        address = f"{address}:{os.environ.get('JAX_COORDINATOR_PORT', '8476')}"
+    host, port = split_host_port(
+        address, os.environ.get("JAX_COORDINATOR_PORT", "8476"))
+    # Canonical host:port — bare IPv6 hosts get brackets so the port
+    # suffix stays unambiguous for jax/gRPC.
+    address = f"[{host}]:{port}" if ":" in host else f"{host}:{port}"
     process_id = infer_process_id()
     if process_id is None:
         raise RuntimeError(

@@ -15,15 +15,24 @@ in jax will unblock them on a useful timescale. The recovery loop:
             jit or a slow collective freezes every rank's heartbeat at
             once, indistinguishable from a wedge by mtimes. The loss
             verdict needs peer-death evidence: the heartbeat records
-            the writer's pid, and a provably dead pid (same-host check;
-            the chaos harness and the two-process CI tests run all
-            ranks on one box) confirms the loss fast, while a live pid
-            VETOES staleness (that peer is a straggler — the
-            watchdog's verdict, not a topology change). Only an
-            uncheckable pid (peer on another host) falls back to the
-            staleness threshold. A peer whose heartbeat file was
-            REMOVED finished cleanly (TrainRecorder.close deregisters
-            it) and is not a loss.
+            the writer's pid, HOST, and /proc start time. The pid is
+            only consulted when the recorded host matches this host —
+            a pid number means nothing in another pod's PID namespace
+            (the multi-host deployment shares the heartbeat dir across
+            JobSet pods). For a same-host peer (the chaos harness and
+            the two-process CI tests run all ranks on one box) a
+            provably dead pid confirms the loss fast, and a live pid
+            whose start time matches the recorded one VETOES staleness
+            (that peer is a straggler — the watchdog's verdict, not a
+            topology change); a live pid whose start time DIFFERS is a
+            post-SIGKILL pid reuse and counts as dead (as does an
+            unreaped zombie — os.kill passes but the loop is gone),
+            and a live pid
+            whose identity cannot be verified (no /proc) vetoes only up
+            to `live_veto_cap_s`, never permanently. Remote peers and
+            unreadable pids fall back to the staleness threshold. A
+            peer whose heartbeat file was REMOVED finished cleanly
+            (TrainRecorder.close deregisters it) and is not a loss.
 
   restart   the monitor computes the reduced topology (survivor ranks
             reindexed densely; all processes of a lost slice are
@@ -65,8 +74,13 @@ import os
 import sys
 import threading
 import time
+from typing import NamedTuple
 
 from container_engine_accelerators_tpu.metrics import events
+from container_engine_accelerators_tpu.metrics.train_metrics import (
+    host_id,
+    proc_start_ticks,
+)
 
 log = logging.getLogger(__name__)
 
@@ -81,11 +95,22 @@ _DISTRIBUTED_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_PORT",
                      "JAX_NUM_SLICES", "MEGASCALE_NUM_SLICES")
 
 
-def read_heartbeats(heartbeat_dir: str) -> dict[int, tuple[float, int]]:
-    """process id -> (mtime, recorded pid) for every hb-<id> file.
-    A pid of -1 means the file exists but its content is unreadable
-    (racing a writer's replace)."""
-    out: dict[int, tuple[float, int]] = {}
+class Heartbeat(NamedTuple):
+    """One parsed hb-<id> file: `pid step host start-ticks` written by
+    TrainRecorder._touch_heartbeat. host/start_ticks are None for
+    legacy two-field files (a pre-upgrade writer)."""
+
+    mtime: float
+    pid: int                     # -1: content unreadable (racing a replace)
+    host: str | None             # writer's host_id()
+    start_ticks: int | None      # writer's /proc start time; None unknown
+
+
+def read_heartbeats(heartbeat_dir: str) -> dict[int, Heartbeat]:
+    """process id -> Heartbeat for every hb-<id> file. A pid of -1
+    means the file exists but its content is unreadable (racing a
+    writer's replace)."""
+    out: dict[int, Heartbeat] = {}
     try:
         names = os.listdir(heartbeat_dir)
     except OSError:
@@ -98,22 +123,28 @@ def read_heartbeats(heartbeat_dir: str) -> dict[int, tuple[float, int]]:
             mtime = os.stat(path).st_mtime
         except OSError:
             continue
-        pid = -1
+        pid, host, ticks = -1, None, None
         try:
             with open(path) as f:
-                first = f.read().split()
-                if first and first[0].lstrip("-").isdigit():
-                    pid = int(first[0])
+                fields = f.read().split()
+            if fields and fields[0].lstrip("-").isdigit():
+                pid = int(fields[0])
+            if len(fields) > 2:
+                host = fields[2]
+            if len(fields) > 3 and fields[3].isdigit():
+                ticks = int(fields[3]) or None  # 0 = writer had no /proc
         except (OSError, ValueError):
             pass
-        out[int(name[3:])] = (mtime, pid)
+        out[int(name[3:])] = Heartbeat(mtime, pid, host, ticks)
     return out
 
 
 def pid_alive(pid: int) -> bool | None:
-    """True/False when this host can answer; None when it cannot (a
-    peer on another host, permissions). Zombies count as alive — the
-    staleness threshold covers them."""
+    """Whether the LOCAL pid table has a live process with this number;
+    None when it cannot answer (bad pid, permissions without /proc).
+    This says nothing about peers on other hosts — callers must check
+    the heartbeat's recorded host first (classify_peer does). Zombies
+    count as alive — the staleness threshold covers them."""
     if pid <= 0:
         return None
     try:
@@ -123,6 +154,63 @@ def pid_alive(pid: int) -> bool | None:
         return False
     except OSError:
         return None
+
+
+# classify_peer verdicts.
+PEER_DEAD = "dead"                      # recorded process provably gone
+PEER_ALIVE = "alive"                    # verified same process, still up
+PEER_ALIVE_UNVERIFIED = "alive-unverified"  # pid number live, identity
+#                                             unconfirmed (no /proc)
+PEER_UNKNOWN = "unknown"                # cannot check (remote host,
+#                                         unreadable pid, legacy format)
+
+
+def _proc_is_zombie(pid: int) -> bool:
+    """Whether /proc says the process is an unreaped corpse (state Z).
+    A zombie passes os.kill AND keeps its start time, but its training
+    loop is gone — it must not veto staleness."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            rest = f.read().rpartition(b")")[2].split()
+        return rest[0:1] == [b"Z"]
+    except (OSError, IndexError):
+        return False
+
+
+def classify_peer(pid: int, host: str | None,
+                  start_ticks: int | None) -> str:
+    """Liveness verdict for one heartbeat's writer. The local pid
+    table is consulted ONLY when the recorded host is this host — a
+    pid number from another pod's PID namespace is meaningless here
+    (both ways: a live remote peer is not dead because its number is
+    free locally, and a dead remote peer is not alive because its
+    number happens to be taken). A missing host (legacy heartbeat) is
+    treated as uncheckable, never assumed local. For a same-host pid,
+    the recorded /proc start time distinguishes the original writer
+    from a post-SIGKILL reuse of its number."""
+    if pid <= 0:
+        return PEER_UNKNOWN
+    if host is None or host != host_id():
+        return PEER_UNKNOWN
+    try:
+        os.kill(pid, 0)
+        signal_ok = True
+    except ProcessLookupError:
+        return PEER_DEAD
+    except OSError:
+        # e.g. EPERM: some process with that number exists but is not
+        # ours; /proc can still settle whose it is.
+        signal_ok = False
+    local_ticks = proc_start_ticks(pid)
+    if start_ticks is not None and local_ticks is not None:
+        if local_ticks != start_ticks:
+            return PEER_DEAD        # number reused by a newer process
+        if _proc_is_zombie(pid):
+            return PEER_DEAD        # SIGKILLed but not yet reaped
+        return PEER_ALIVE
+    if signal_ok:
+        return PEER_ALIVE_UNVERIFIED
+    return PEER_UNKNOWN
 
 
 def slice_of(process_id: int, num_processes: int, num_slices: int) -> int:
@@ -189,6 +277,7 @@ class SliceLossMonitor:
                  threshold_s: float = 30.0,
                  interval_s: float | None = None,
                  min_dead_age_s: float = 1.5,
+                 live_veto_cap_s: float | None = None,
                  max_restarts: int = 3,
                  restart_argv: list[str] | None = None,
                  dump_dir: str | None = None,
@@ -204,6 +293,13 @@ class SliceLossMonitor:
         self.interval_s = interval_s or max(0.5, min(2.0,
                                                      threshold_s / 6.0))
         self.min_dead_age_s = min_dead_age_s
+        # How long a live-but-UNVERIFIED pid (no /proc to match start
+        # times — the identity could be a post-SIGKILL reuse of the
+        # number) may veto staleness before the staleness threshold
+        # takes over anyway. A VERIFIED live pid vetoes indefinitely.
+        self.live_veto_cap_s = (live_veto_cap_s
+                                if live_veto_cap_s is not None
+                                else max(4 * threshold_s, 60.0))
         self.max_restarts = max_restarts
         self.restart_argv = restart_argv
         self.dump_dir = dump_dir
@@ -227,12 +323,17 @@ class SliceLossMonitor:
         compile/collective pause (this process's own heartbeat freezes
         in BOTH cases — a wedged loop and a long jit look identical
         from mtimes). So: when the peer's recorded pid is CHECKABLE
-        (same host — the chaos harness and the CI two-process tests),
-        a loss requires the pid to be provably dead, and a live pid
+        (its heartbeat names THIS host — the chaos harness and the CI
+        two-process tests), a provably dead pid is a loss before the
+        threshold, and a live pid verified by its /proc start time
         vetoes staleness (a straggler is the watchdog's verdict, not a
-        topology change). Only an uncheckable pid (a peer on another
-        host) falls back to the pure staleness threshold — size it
-        well above the worst compile pause there."""
+        topology change); a live pid the start time disproves is a
+        post-SIGKILL reuse and counts as dead, and a live pid with no
+        start-time evidence vetoes only up to `live_veto_cap_s`.
+        Uncheckable pids — a peer on another host, a legacy heartbeat
+        with no host field, an unreadable pid — fall back to the pure
+        staleness threshold; size it well above the worst compile
+        pause there."""
         # tpulint: allow=TPL004(wall-vs-wall, ages come from file mtimes)
         now = time.time() if now is None else now
         if heartbeats is None:
@@ -248,18 +349,22 @@ class SliceLossMonitor:
                     # (TrainRecorder.close), not a loss.
                     self._finished.add(peer)
                 continue
-            mtime, pid = hb
-            self._seen[peer] = mtime
-            age = now - mtime
+            self._seen[peer] = hb.mtime
+            age = now - hb.mtime
             if age <= self.min_dead_age_s:
                 continue
-            alive = pid_alive(pid)
-            if alive is False:
-                # Same-host fast path: the recorded pid is gone — no
-                # need to wait out the full staleness threshold.
+            verdict = classify_peer(hb.pid, hb.host, hb.start_ticks)
+            if verdict == PEER_DEAD:
+                # Same-host fast path: the recorded process is gone
+                # (missing pid, or its number reused by a different
+                # process) — no need to wait out the full threshold.
                 lost.add(peer)
-            elif alive is None and age > self.threshold_s:
+            elif verdict == PEER_UNKNOWN and age > self.threshold_s:
                 lost.add(peer)
+            elif (verdict == PEER_ALIVE_UNVERIFIED
+                  and age > max(self.threshold_s, self.live_veto_cap_s)):
+                lost.add(peer)
+            # PEER_ALIVE: verified straggler — the watchdog's verdict.
         if lost:
             lost = expand_lost_to_slices(lost, self.num_processes,
                                          self.num_slices)
@@ -386,6 +491,35 @@ class SliceLossMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+
+def reconcile_resume_topology(flag_slices: int | None, env_slices: int,
+                              batch_size: int
+                              ) -> tuple[int, int, list[str]]:
+    """Topology for a re-exec'd survivor (cli/train.py). The restart
+    replays the original argv verbatim, so an explicit --dcn-slices
+    (and a --batch-size sized for it) describes the PRE-loss topology;
+    the JAX_NUM_SLICES the monitor computed (plan_restart_env) is
+    authoritative. Returns (slices, global_batch, notes): the env
+    slice count wins over a stale flag, and the global batch is kept
+    (dp only splits it — the post-resume trajectory must match) unless
+    it no longer divides into the surviving slices, where it rounds
+    down rather than dying on the divisibility check. Pure:
+    unit-tested without processes."""
+    notes: list[str] = []
+    slices = flag_slices if flag_slices else env_slices
+    if flag_slices and flag_slices != env_slices:
+        slices = env_slices
+        notes.append(
+            f"--dcn-slices {flag_slices} is the pre-loss topology; "
+            f"using {env_slices} slice(s) from the environment")
+    if slices > 1 and batch_size % slices:
+        new_bs = max(slices, batch_size - batch_size % slices)
+        notes.append(
+            f"--batch-size {batch_size} does not divide into {slices} "
+            f"surviving slice(s); rounding down to {new_bs}")
+        batch_size = new_bs
+    return slices, batch_size, notes
 
 
 def consume_resume_state(recorder=None, log_fn=log.info) -> dict | None:

@@ -141,6 +141,24 @@ def test_initialize_from_env_inactive_without_env():
                 os.environ[k] = v
 
 
+def test_split_host_port_handles_ipv6():
+    """The coordinator address parse must not misread IPv6 literals:
+    a bare '::1' carries no port, and brackets are address syntax, not
+    part of the host."""
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        split_host_port,
+    )
+
+    assert split_host_port("coord") == ("coord", "8476")
+    assert split_host_port("coord:1234") == ("coord", "1234")
+    assert split_host_port("10.0.0.1:8476") == ("10.0.0.1", "8476")
+    assert split_host_port("::1") == ("::1", "8476")
+    assert split_host_port("fe80::1:2:3") == ("fe80::1:2:3", "8476")
+    assert split_host_port("[::1]") == ("::1", "8476")
+    assert split_host_port("[::1]:9999") == ("::1", "9999")
+    assert split_host_port("host", default_port="9") == ("host", "9")
+
+
 def test_num_slices_env_contract(monkeypatch):
     from container_engine_accelerators_tpu.parallel import distributed
 
@@ -304,11 +322,21 @@ def test_record_badput_and_resharded_restore_buckets():
 
 # ---------- slice-loss detection + restart planning (pure) ----------
 
-def _hb(tmp_path, pid_by_rank):
+def _hb(tmp_path, pid_by_rank, host=None, ticks_by_rank=None):
+    """Heartbeat dir in the writer's `pid step host start-ticks`
+    format (train_metrics._touch_heartbeat); host and start-ticks
+    default to each pid's real local identity (0 = unknown)."""
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        host_id, proc_start_ticks,
+    )
+
     hb = tmp_path / "hb"
     hb.mkdir(parents=True, exist_ok=True)
     for rank, pid in pid_by_rank.items():
-        (hb / f"hb-{rank}").write_text(f"{pid} 0\n")
+        ticks = (ticks_by_rank or {}).get(
+            rank, (proc_start_ticks(pid) or 0) if pid > 0 else 0)
+        (hb / f"hb-{rank}").write_text(
+            f"{pid} 0 {host or host_id()} {ticks}\n")
     return str(hb)
 
 
@@ -360,6 +388,142 @@ def test_scan_uncheckable_pid_falls_back_to_staleness(tmp_path):
     assert mon2.scan() == set()
 
 
+def test_heartbeat_stamp_roundtrip(tmp_path):
+    """The real writer's stamp parses back into (pid, host, ticks) and
+    classifies its own live writer as verified-alive."""
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder, host_id, proc_start_ticks,
+    )
+
+    rec = TrainRecorder(heartbeat_dir=str(tmp_path / "hb"), process_id=7)
+    try:
+        hb = elastic.read_heartbeats(str(tmp_path / "hb"))[7]
+        assert hb.pid == os.getpid()
+        assert hb.host == host_id()
+        own_ticks = proc_start_ticks(os.getpid())
+        assert hb.start_ticks == own_ticks
+        want = (elastic.PEER_ALIVE if own_ticks is not None
+                else elastic.PEER_ALIVE_UNVERIFIED)
+        assert elastic.classify_peer(hb.pid, hb.host,
+                                     hb.start_ticks) == want
+    finally:
+        rec.close()
+
+
+def test_scan_remote_host_heartbeat_never_uses_local_pid_table(tmp_path):
+    """A remote peer's pid number means nothing in the local PID
+    namespace — in BOTH directions: a live local process with that
+    number must not veto staleness (the remote peer may be gone), and
+    a locally-free number must not fast-path a loss (the remote peer
+    may be healthy, just slow)."""
+    own = os.getpid()
+    # Remote peer whose pid number is LIVE locally: staleness governs.
+    hb_dir = _hb(tmp_path, {0: own, 1: own}, host="some-other-pod")
+    old = time.time() - 50
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    assert elastic.SliceLossMonitor(
+        hb_dir, process_id=0, num_processes=2,
+        threshold_s=30.0).scan() == {1}
+    assert elastic.SliceLossMonitor(
+        hb_dir, process_id=0, num_processes=2,
+        threshold_s=300.0).scan() == set()
+    # Remote peer whose pid number is DEAD locally, heartbeat within
+    # the threshold: NOT a loss.
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    hb_dir2 = _hb(tmp_path / "b", {0: own, 1: p.pid},
+                  host="some-other-pod")
+    old2 = time.time() - 10
+    os.utime(os.path.join(hb_dir2, "hb-1"), (old2, old2))
+    assert elastic.SliceLossMonitor(
+        hb_dir2, process_id=0, num_processes=2,
+        threshold_s=3600.0).scan() == set()
+
+
+def test_scan_pid_reuse_detected_by_start_ticks(tmp_path):
+    """A live pid whose /proc start time differs from the recorded one
+    is a post-SIGKILL reuse of the number: dead — the veto must not be
+    permanent even under a huge staleness threshold."""
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        proc_start_ticks,
+    )
+
+    own = os.getpid()
+    real = proc_start_ticks(own)
+    if real is None:
+        pytest.skip("no readable /proc start time on this platform")
+    hb_dir = _hb(tmp_path, {0: own, 1: own},
+                 ticks_by_rank={1: real + 991})
+    old = time.time() - 10
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                   num_processes=2, threshold_s=3600.0)
+    assert mon.scan() == {1}
+
+
+def test_scan_zombie_peer_is_dead_not_straggler(tmp_path):
+    """A killed-but-unreaped peer passes os.kill AND keeps its /proc
+    start time — it must still classify as dead (its training loop is
+    gone), not veto staleness forever."""
+    own = os.getpid()
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    try:
+        state = b""
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with open(f"/proc/{p.pid}/stat", "rb") as f:
+                state = f.read().rpartition(b")")[2].split()[0]
+            if state == b"Z":
+                break
+            time.sleep(0.05)
+        assert state == b"Z", "child never became a zombie"
+        hb_dir = _hb(tmp_path, {0: own, 1: p.pid})
+        old = time.time() - 10
+        os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+        mon = elastic.SliceLossMonitor(hb_dir, process_id=0,
+                                       num_processes=2,
+                                       threshold_s=3600.0)
+        assert mon.scan() == {1}
+    finally:
+        p.wait()
+
+
+def test_scan_unverified_live_pid_veto_is_capped(tmp_path):
+    """A live pid with no start-time evidence (writer recorded 0 — no
+    /proc) vetoes staleness only up to live_veto_cap_s, so a reused
+    pid number cannot hide a real loss forever."""
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own, 1: own}, ticks_by_rank={1: 0})
+    old = time.time() - 50
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    assert elastic.SliceLossMonitor(
+        hb_dir, process_id=0, num_processes=2, threshold_s=10.0,
+        live_veto_cap_s=30.0).scan() == {1}
+    assert elastic.SliceLossMonitor(
+        hb_dir, process_id=0, num_processes=2, threshold_s=10.0,
+        live_veto_cap_s=300.0).scan() == set()
+
+
+def test_scan_legacy_two_field_heartbeat_falls_back_to_staleness(
+        tmp_path):
+    """Pre-upgrade `pid step` heartbeats carry no host: the pid is NOT
+    assumed local (it may be another pod's number), so only the
+    staleness threshold can call the loss."""
+    own = os.getpid()
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    for rank in (0, 1):
+        (hb / f"hb-{rank}").write_text(f"{own} 0\n")
+    old = time.time() - 50
+    os.utime(str(hb / "hb-1"), (old, old))
+    assert elastic.SliceLossMonitor(
+        str(hb), process_id=0, num_processes=2,
+        threshold_s=30.0).scan() == {1}
+    assert elastic.SliceLossMonitor(
+        str(hb), process_id=0, num_processes=2,
+        threshold_s=300.0).scan() == set()
+
+
 def test_expand_lost_to_slices():
     # 4 processes, 2 slices (2 procs each): losing rank 3 loses slice 1.
     assert elastic.expand_lost_to_slices({3}, 4, 2) == {2, 3}
@@ -391,6 +555,21 @@ def test_plan_restart_env_reduced_topologies():
     # Coordinator lost with >1 survivor: no in-place restart.
     assert elastic.plan_restart_env(dict(base), [1, 2, 3],
                                     num_slices=2) is None
+
+
+def test_reconcile_resume_topology():
+    """The re-exec replays the original argv: a stale --dcn-slices must
+    lose to the reduced env topology, and the preserved global batch
+    rounds down (never SystemExits) when it stops dividing."""
+    # Stale flag vs the reduced env; batch 8 still divides into 1.
+    slices, bs, notes = elastic.reconcile_resume_topology(2, 1, 8)
+    assert (slices, bs) == (1, 8) and len(notes) == 1
+    # 3 slices -> 2 survivors with batch 9: both adjustments fire.
+    slices, bs, notes = elastic.reconcile_resume_topology(3, 2, 9)
+    assert (slices, bs) == (2, 8) and len(notes) == 2
+    # No flag / agreeing flag: nothing to reconcile.
+    assert elastic.reconcile_resume_topology(None, 2, 8) == (2, 8, [])
+    assert elastic.reconcile_resume_topology(2, 2, 8) == (2, 8, [])
 
 
 def test_monitor_trigger_writes_resume_state_via_on_loss(tmp_path):
