@@ -129,6 +129,23 @@ perf-baseline:
 perf-gate-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf_gate.py -q
 
+# Disaggregated-serving smoke (ISSUE 12): PrefillBudget grant math,
+# greedy token-identity for concurrent shared-prefix requests across
+# admission orderings, PageAllocator/PrefixIndex refcount invariants
+# across the pool handoff, prefill-pool worker death -> restart with
+# zero failed requests and zero leaked pages, prefix-cache hit
+# counters, and the loadgen multi-tenant mix helpers. Fast tier-1.
+serve-pools-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_serve_pools.py -q
+
+# Regenerate the committed before/after interference artifact
+# (POOLS_REPORT.json): the SAME multi-tenant shared-prefix mix through
+# the single-loop and two-pool layouts, recorder-derived TTFT/TPOT
+# percentiles, exit 2 unless pools-on improves p99 TPOT. Uses the full
+# serve --tiny model so prefill chunks cost real time (~2 min).
+pools-report:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/pools_report.py --out POOLS_REPORT.json
+
 # Chaos scenario matrix (ISSUE 9): scripted fault schedules against
 # REAL serve/train subprocesses (worker kill mid-decode + supervised
 # restart, engine hang, fabricated HBM exhaustion, stalled data
@@ -173,7 +190,7 @@ multislice-smoke:
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
-    multislice-smoke chaos-smoke
+    serve-pools-smoke multislice-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -186,5 +203,6 @@ clean:
 .PHONY: all native test test-quick device-injector-test presubmit \
     lint lint-baseline lint-smoke bench perf hbm-plan obs-smoke \
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
-    perf-gate perf-baseline perf-gate-smoke chaos chaos-smoke \
-    chaos-tests multislice-smoke smoke dryrun clean
+    perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
+    pools-report chaos chaos-smoke chaos-tests multislice-smoke \
+    smoke dryrun clean

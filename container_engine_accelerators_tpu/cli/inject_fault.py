@@ -23,6 +23,10 @@ ROADMAP item 4 chaos-harness primitive:
   --kind worker-kill     the engine worker thread DIES at its next
                          loop top with in-flight work abandoned (the
                          `serve --supervise` recovery path's trigger)
+  --kind prefill-kill    ONE prefill-pool worker DIES at its next
+                         loop top (`serve --prefill-workers`); decode
+                         keeps ticking and the supervisor replaces
+                         the worker without failing any request
   --kind recompile-storm N real steady-state recompiles of a watched
                          jit (--count)
   --kind hbm-climb       fabricated hbm/<device> exhaustion climb
@@ -60,9 +64,9 @@ from container_engine_accelerators_tpu.healthcheck.health_checker import (
     DEFAULT_ERROR_LOG,
 )
 
-FAULT_KINDS = ("health", "hang", "worker-kill", "recompile-storm",
-               "hbm-climb", "queue-collapse", "data-stall", "straggler",
-               "health-tail")
+FAULT_KINDS = ("health", "hang", "worker-kill", "prefill-kill",
+               "recompile-storm", "hbm-climb", "queue-collapse",
+               "data-stall", "straggler", "health-tail")
 
 
 def _append_jsonl(path: str, record: dict) -> None:

@@ -10,6 +10,18 @@ Modes:
              first `data:` event arrival minus request start, and TPOT
              = inter-token gaps, per request, as p50/p90/p99.
 
+Multi-tenant mix (--tenants N, ISSUE 12): request i belongs to tenant
+i % N; every tenant's requests share a deterministic per-tenant system
+prefix (--tenant-prefix-len tokens), so a prefix-cache-enabled server
+(`serve --engine paged`) sees repeat hits per tenant and /metrics
+shows a nonzero serve_prefix_hit_rate. Odd tenants are "batch" class
+and send LONG prompts (--long-prompt-len); even tenants stay "chat"
+class at --prompt-len — the interference mix the disaggregated
+prefill/decode pools (`serve --prefill-workers`) exist to survive.
+The summary grows a per-tenant block (TTFT/TPOT percentiles + SLO
+verdicts when gating); a violation in ANY tenant fails the run, so a
+mix where only the chatty tenants' TPOT collapses still exits 3.
+
 SLO gating (ISSUE 8: loadgen is the SLO driver for chaos runs and CI):
   --slo-ttft-p99-ms M   fail unless client-observed TTFT p99 <= M
   --slo-tpot-p99-ms M   fail unless pooled inter-token-gap p99 <= M
@@ -62,6 +74,52 @@ def percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
 class StreamStalled(Exception):
     """No SSE event for the stall timeout: the stream is wedged, not
     failing cleanly — the outcome chaos assertions must tell apart."""
+
+
+def tenant_class(tenant: int) -> str:
+    """Odd tenants run long-prompt "batch" traffic, even ones chatty
+    "chat" traffic — interleaving the two is the whole point of the
+    mix."""
+    return "batch" if tenant % 2 else "chat"
+
+
+def tenant_tokens(args, i: int) -> tuple[int, list[int]]:
+    """(tenant, prompt) for request i of a multi-tenant mix. The
+    prefix depends only on the TENANT (their shared system prompt —
+    deterministic, so repeat requests hit the server's prefix cache);
+    the suffix depends on the request (each conversation differs)."""
+    t = i % args.tenants
+    prefix = [(t * 31 + j) % 97 + 1
+              for j in range(args.tenant_prefix_len)]
+    body_len = (args.long_prompt_len if tenant_class(t) == "batch"
+                else args.prompt_len)
+    body = [(i * 7 + j) % 100 + 1 for j in range(body_len)]
+    return t, prefix + body
+
+
+def _slo_block(ttfts, gaps, args):
+    """(slo dict | None, violated) for one sample population — used
+    for the pooled gate and again per tenant. NaN (no samples) fails
+    closed: a population that produced no tokens cannot claim it met
+    a latency SLO."""
+    checks = []
+    if args.slo_ttft_p99_ms is not None:
+        obs = percentiles(ttfts)["p99"] * 1e3 if ttfts else float("nan")
+        checks.append(("ttft_p99_ms", args.slo_ttft_p99_ms, obs))
+    if args.slo_tpot_p99_ms is not None:
+        obs = percentiles(gaps)["p99"] * 1e3 if gaps else float("nan")
+        checks.append(("tpot_p99_ms", args.slo_tpot_p99_ms, obs))
+    if not checks:
+        return None, False
+    slo, violated = {}, False
+    for name, limit, obs in checks:
+        ok = obs <= limit
+        slo[name] = {"limit": limit,
+                     "observed": round(obs, 2) if obs == obs else None,
+                     "ok": bool(ok)}
+        if not ok:
+            violated = True
+    return slo, violated
 
 
 def one_request(url: str, tokens: list[int], max_new: int,
@@ -129,10 +187,17 @@ def run(args) -> tuple[dict, int]:
     entry the chaos harness (tools/chaos.py) consumes; main() wraps it
     for the CLI."""
     def req_i(i: int) -> dict:
-        tokens = [(i * 7 + j) % 100 + 1 for j in range(args.prompt_len)]
-        return one_request(args.url, tokens, args.max_new_tokens,
-                           args.stream, args.timeout,
-                           stall_timeout=args.stall_timeout_s)
+        if args.tenants:
+            tenant, tokens = tenant_tokens(args, i)
+        else:
+            tenant = 0
+            tokens = [(i * 7 + j) % 100 + 1
+                      for j in range(args.prompt_len)]
+        r = one_request(args.url, tokens, args.max_new_tokens,
+                        args.stream, args.timeout,
+                        stall_timeout=args.stall_timeout_s)
+        r["tenant"] = tenant
+        return r
 
     t0 = time.perf_counter()
     results = []
@@ -193,32 +258,53 @@ def run(args) -> tuple[dict, int]:
         # `slo` block in the JSON summary — the assertion surface for
         # chaos schedules and CI (metrics/doctor.py is the server-side
         # twin of this client-side verdict).
-        checks = []
-        if args.slo_ttft_p99_ms is not None:
-            obs = tt["p99"] * 1e3 if ttfts else float("nan")
-            checks.append(("ttft_p99_ms", args.slo_ttft_p99_ms, obs))
-        if args.slo_tpot_p99_ms is not None:
-            obs = (percentiles(gaps)["p99"] * 1e3 if gaps
-                   else float("nan"))
-            checks.append(("tpot_p99_ms", args.slo_tpot_p99_ms, obs))
-        if checks:
-            slo = {}
-            for name, limit, obs in checks:
-                # NaN (no samples at all) fails closed: a run that
-                # produced no tokens cannot claim it met a latency SLO.
-                ok = obs <= limit
-                slo[name] = {"limit": limit,
-                             "observed": (round(obs, 2)
-                                          if obs == obs else None),
-                             "ok": bool(ok)}
-                if not ok:
-                    slo_violated = True
+        slo, slo_violated = _slo_block(ttfts, gaps, args)
+        if slo is not None:
             summary["slo"] = slo
             verdict = "PASS" if not slo_violated else "FAIL"
             print(f"SLO {verdict} " + " ".join(
                 f"{n}={v['observed']}/{v['limit']}"
                 f"[{'ok' if v['ok'] else 'VIOLATED'}]"
                 for n, v in slo.items()))
+    if args.tenants:
+        # Per-tenant verdicts: the pooled numbers hide exactly the
+        # failure the mix exists to expose (a long-prefill tenant
+        # wrecking the chatty tenants' TPOT), so each tenant gets its
+        # own percentile block — and its own SLO verdict against the
+        # same limits, any violation failing the run.
+        tenants = {}
+        for t in sorted({r["tenant"] for r in results}):
+            rs = [r for r in results if r["tenant"] == t]
+            entry = {"class": tenant_class(t), "requests_ok": len(rs),
+                     "latency_ms": {
+                         k: round(v * 1e3, 1) for k, v in
+                         percentiles([r["latency"] for r in rs]).items()}}
+            line = (f"tenant {t} ({entry['class']}): ok={len(rs)} "
+                    f"latency_p99={entry['latency_ms']['p99']}ms")
+            if args.stream:
+                t_ttfts = [r["ttft"] for r in rs
+                           if r["ttft"] is not None]
+                t_gaps = [g for r in rs for g in r["gaps"]]
+                entry["ttft_ms"] = {k: round(v * 1e3, 1) for k, v in
+                                    percentiles(t_ttfts).items()}
+                if t_gaps:
+                    entry["tpot_ms"] = {k: round(v * 1e3, 2) for k, v in
+                                        percentiles(t_gaps).items()}
+                t_slo, t_violated = _slo_block(t_ttfts, t_gaps, args)
+                if t_slo is not None:
+                    entry["slo"] = t_slo
+                    entry["slo_ok"] = not t_violated
+                    if t_violated:
+                        slo_violated = True
+                    line += (f" ttft_p99={entry['ttft_ms']['p99']}ms"
+                             + (f" tpot_p99="
+                                f"{entry['tpot_ms']['p99']}ms"
+                                if t_gaps else "")
+                             + f" SLO "
+                             f"{'PASS' if not t_violated else 'FAIL'}")
+            tenants[str(t)] = entry
+            print(line)
+        summary["tenants"] = tenants
     print(json.dumps(summary))
     # Transport errors mean the server broke mid-conversation (exit 1);
     # SLO violations, structured errors and hung streams mean it
@@ -239,6 +325,22 @@ def make_parser() -> argparse.ArgumentParser:
                         "engine's slot pool)")
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--tenants", type=int, default=0,
+                   help="multi-tenant mix: request i belongs to tenant "
+                        "i %% N, each tenant's requests share a "
+                        "deterministic system prefix (prefix-cache "
+                        "hits server-side), odd tenants send long "
+                        "prompts (--long-prompt-len) while even ones "
+                        "stay at --prompt-len; the summary gains "
+                        "per-tenant percentiles and SLO verdicts. "
+                        "0 disables the mix")
+    p.add_argument("--tenant-prefix-len", type=int, default=64,
+                   help="shared system-prefix tokens per tenant "
+                        "(page-multiple lengths make every page "
+                        "shareable on a paged server)")
+    p.add_argument("--long-prompt-len", type=int, default=256,
+                   help="prompt body length for odd (batch-class) "
+                        "tenants in the multi-tenant mix")
     p.add_argument("--stream", action="store_true",
                    help="SSE mode: measure time-to-first-token and "
                         "inter-token gaps")

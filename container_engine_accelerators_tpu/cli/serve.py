@@ -358,6 +358,53 @@ class BatchingEngine:
             rec.set_slots(active=0, total=self.max_batch)
 
 
+class PrefillBudget:
+    """Token-budget scheduler for the prefill pool (--prefill-workers).
+
+    Each grant answers "how many prompt tokens may the prefill pool
+    forward RIGHT NOW without breaking decode's tick cadence": while
+    any slot is decoding, the grant is sized so one chunk costs about
+    `slack_frac` of a decode tick (from EMAs of the observed decode-tick
+    latency and per-token prefill cost), floored at one prompt bucket —
+    prefill always makes progress, so long prompts cannot starve — and
+    capped at the engine's prefill_chunk. With no decoding slot there is
+    no cadence to protect and the full chunk is granted. Grants are
+    prompt-bucket multiples so the chunk executables stay shape-hot.
+
+    Host-side and lock-free (the engine serializes callers); pure math,
+    unit-tested directly in tests/test_serve_pools.py."""
+
+    def __init__(self, bucket: int, chunk: int,
+                 slack_frac: float = 0.5):
+        self.bucket = max(int(bucket), 1)
+        self.chunk = int(chunk) if chunk else 0
+        self.slack_frac = slack_frac
+        self._decode_s: float | None = None  # EMA decode-tick seconds
+        self._tok_s: float | None = None     # EMA prefill seconds/token
+
+    @staticmethod
+    def _ema(old, new, alpha=0.2):
+        return new if old is None else (1 - alpha) * old + alpha * new
+
+    def note_decode(self, seconds: float) -> None:
+        self._decode_s = self._ema(self._decode_s, seconds)
+
+    def note_prefill(self, tokens: int, seconds: float) -> None:
+        if tokens > 0:
+            self._tok_s = self._ema(self._tok_s, seconds / tokens)
+
+    def grant(self, decoding: bool) -> int:
+        """Max prompt tokens the next prefill chunk may take."""
+        cap = self.chunk if self.chunk else (1 << 30)
+        if not decoding:
+            return cap
+        n = self.bucket
+        if self._decode_s and self._tok_s:
+            n = int(self._decode_s * self.slack_frac / self._tok_s)
+            n = (n // self.bucket) * self.bucket
+        return max(self.bucket, min(n, cap))
+
+
 class ContinuousEngine:
     """In-flight (continuous) batching: a fixed pool of decode slots
     steps together every iteration; new requests are prefilled into free
@@ -392,7 +439,8 @@ class ContinuousEngine:
     def __init__(self, params, cfg, max_slots: int = 8,
                  max_len: int = 2048, prompt_bucket: int = 64,
                  max_prompt_len: int = 1024, prefill_chunk: int = 0,
-                 mesh=None, recorder: RequestRecorder | None = None):
+                 prefill_workers: int = 0, mesh=None,
+                 recorder: RequestRecorder | None = None):
         from container_engine_accelerators_tpu.models.decode import (
             _kernel_eligible,
         )
@@ -418,6 +466,31 @@ class ContinuousEngine:
             prefill_chunk = -(-prefill_chunk // self.prompt_bucket) \
                 * self.prompt_bucket
         self.prefill_chunk = prefill_chunk
+        # Disaggregated pools (--prefill-workers > 0): decode keeps the
+        # tick cadence on the main worker; prefill chunks move to a
+        # pool of prefill workers scheduled by a PrefillBudget. 0 keeps
+        # the single-loop layout (prefill interleaved on the decode
+        # thread) — the before/after baseline tools/pools_report.py
+        # measures against.
+        self.prefill_workers = max(int(prefill_workers), 0)
+        self._budget = PrefillBudget(self.prompt_bucket,
+                                     self.prefill_chunk)
+        # Engine lock: in pools mode the decode tick and the prefill
+        # chunks mutate the same slot table and DONATED cache from
+        # different threads, so both hold _mu across their device call
+        # (concurrent functional updates of one donated buffer would be
+        # unsound anyway). Decode's max wait on prefill is therefore
+        # ONE budget-bounded chunk — the mechanism of the TPOT win —
+        # not a whole --prefill-chunk. RLock: recovery paths re-enter.
+        self._mu = threading.RLock()
+        self._prefill_work = threading.Event()
+        self._prefill_threads: list[threading.Thread] = []
+        self.prefill_worker_restarts = 0
+        # Per-tick pacing (pools mode): while anything is decoding the
+        # pool runs at most ONE budgeted chunk per decode tick — locks
+        # aren't fair, so without this a saturated prefill pool could
+        # re-grab _mu ahead of the waiting decode thread every time.
+        self._chunks_this_tick = 0
         # queue.Queue + Event wake, not SimpleQueue: see BatchingEngine
         # (SimpleQueue's timed get can lose a put's wakeup and wedge
         # the worker; _pump_queue never issues a timed queue-get).
@@ -426,13 +499,21 @@ class ContinuousEngine:
         # Chaos hooks (metrics/doctor.py FaultListener), same contract
         # as BatchingEngine: worker sleeps this long at its next loop
         # top (real slots-occupied/no-ticks hang) / dies abruptly with
-        # in-flight work abandoned (WorkerKilled).
+        # in-flight work abandoned (WorkerKilled). fault_kill_prefill
+        # kills ONE prefill-pool worker at its next loop top instead
+        # (inject_fault --kind prefill-kill).
         self.fault_hang_s = 0.0
         self.fault_kill = False
+        self.fault_kill_prefill = False
         self.worker_restarts = 0
         self.steps_run = 0          # decode iterations (all slots at once)
         self.prefills_run = 0       # completed request prefills
         self.prefill_chunks_run = 0
+        # Prompt tokens actually forwarded by prefill chunks: cache-hit
+        # admissions skip their shared pages' forward entirely, so this
+        # stays BELOW the summed prompt lengths exactly by the reused
+        # tokens (tests assert the hit path through this accounting).
+        self.prefill_tokens_run = 0
         # steps_run recorded at each chunk: tests assert decode keeps
         # advancing between the chunks of one long admission.
         self.prefill_chunk_trace: list[int] = []
@@ -479,6 +560,7 @@ class ContinuousEngine:
     def stop(self):
         self._stop.set()
         self._work.set()  # wake an idle worker so it can exit promptly
+        self._prefill_work.set()  # and the prefill pool, if any
 
     def recover_after_worker_death(self, err: Exception) -> None:
         """Fail every request the dead worker abandoned — occupied
@@ -486,23 +568,26 @@ class ContinuousEngine:
         structured errors, and zero the occupancy gauges so the
         recorder reflects reality (no leaked slots). Called by the
         EngineSupervisor BEFORE restarting the worker; the fresh
-        worker rebuilds the cache/pool itself at thread start."""
-        for sl in getattr(self, "_slots", []):
-            if sl is not None:
-                _fail(sl["fut"], sl["stream"], err, sl["rid"],
-                      self.recorder)
-        self._slots = [None] * self.max_slots
-        for item in getattr(self, "_backlog", []):
-            _fail(item[3], item[4], err, item[5], self.recorder)
-        self._backlog = []
-        while True:
-            try:
-                item = self.queue.get_nowait()
-            except queue.Empty:
-                break
-            _fail(item[3], item[4], err, item[5], self.recorder)
-        self._work.clear()
-        self.recorder.set_slots(active=0, total=self.max_slots)
+        worker rebuilds the cache/pool itself at thread start. Runs
+        under _mu: in pools mode live prefill workers share this
+        state and must never see it half-recovered."""
+        with self._mu:
+            for sl in getattr(self, "_slots", []):
+                if sl is not None:
+                    _fail(sl["fut"], sl["stream"], err, sl["rid"],
+                          self.recorder)
+            self._slots = [None] * self.max_slots
+            for item in getattr(self, "_backlog", []):
+                _fail(item[3], item[4], err, item[5], self.recorder)
+            self._backlog = []
+            while True:
+                try:
+                    item = self.queue.get_nowait()
+                except queue.Empty:
+                    break
+                _fail(item[3], item[4], err, item[5], self.recorder)
+            self._work.clear()
+            self.recorder.set_slots(active=0, total=self.max_slots)
 
     # ---------- engine hooks (overridden by the paged engine) ----------
 
@@ -584,14 +669,18 @@ class ContinuousEngine:
     def _worker(self):
         import jax
 
-        self._slots: list[dict | None] = [None] * self.max_slots
-        self._backlog: list = []
-        self._last_tok = [0] * self.max_slots
-        self._temps = [0.0] * self.max_slots
-        self._admit_seq = 0
-        self._base_key = jax.random.key(0)
-        self._make_fns()
-        self._fresh_state()
+        with self._mu:
+            self._slots: list[dict | None] = [None] * self.max_slots
+            self._backlog: list = []
+            self._last_tok = [0] * self.max_slots
+            self._temps = [0.0] * self.max_slots
+            self._admit_seq = 0
+            self._base_key = jax.random.key(0)
+            self._make_fns()
+            self._fresh_state()
+
+        if self.prefill_workers:
+            return self._decode_pool_loop()
 
         while not self._stop.is_set():
             _maybe_injected_hang(self)
@@ -607,6 +696,113 @@ class ContinuousEngine:
                 continue
             with annotate("serve/decode_tick"):
                 self._decode_tick()
+
+    # ---------- disaggregated pools (--prefill-workers > 0) ----------
+
+    def _decode_pool_loop(self):
+        """Decode-pool loop: owns admission and the tick cadence;
+        prefill chunks run on the prefill pool within the
+        PrefillBudget's grant. All shared-state phases hold _mu; the
+        idle waits do NOT (a parked decode loop must never block a
+        prefill worker's chunk)."""
+        self._ensure_prefill_threads()
+        while not self._stop.is_set():
+            _maybe_injected_hang(self)
+            with self._mu:
+                idle = (all(sl is None for sl in self._slots)
+                        and not self._backlog)
+            if idle:
+                self._work.wait(0.05)
+            self._work.clear()
+            with self._mu:
+                self._drain_queue()
+                with annotate("serve/admit"):
+                    self._admit_phase()
+                self._record_occupancy()
+                n_prefilling = sum(sl is not None and bool(sl["pending"])
+                                   for sl in self._slots)
+                n_decoding = sum(sl is not None and not sl["pending"]
+                                 for sl in self._slots)
+                # Per-pool depth: prefill owns the backlog (admission
+                # feeds it) plus every slot still holding prompt
+                # tokens; decode owns the ticking slots.
+                self.recorder.set_pool_depths(
+                    prefill=len(self._backlog) + n_prefilling,
+                    decode=n_decoding)
+                prefilling = n_prefilling > 0
+                decoding = n_decoding > 0
+            if prefilling:
+                self._prefill_work.set()
+            if not decoding:
+                # Nothing decoding: no cadence to protect. Park briefly
+                # — a prefill worker sets _work when a slot's first
+                # token lands and it becomes decodable.
+                if prefilling or not idle:
+                    self._work.wait(0.005)
+                continue
+            with self._mu:
+                if not self._pre_step():
+                    continue
+                with annotate("serve/decode_tick"):
+                    self._decode_tick()
+                self._chunks_this_tick = 0  # the tick paid: new grant
+            if prefilling:
+                self._prefill_work.set()
+
+    def _prefill_worker(self):
+        """Prefill-pool worker: drains budget-bounded chunks of the
+        oldest prefilling slot under the engine lock. The injected
+        prefill kill is consumed OUTSIDE _mu, so a dying worker never
+        leaves the lock held or slot/page state half-mutated — every
+        page stays owned by its slot (refcounts intact) and the
+        replacement worker resumes the pending prompt exactly where it
+        stopped: the zero-leak property the prefill-pool-kill chaos
+        scenario asserts."""
+        while not self._stop.is_set():
+            if self.fault_kill_prefill:
+                self.fault_kill_prefill = False
+                log.warning("injected prefill-pool worker kill: thread "
+                            "dying between chunks")
+                raise WorkerKilled("injected prefill worker kill "
+                                   "(inject_fault --kind prefill-kill)")
+            with self._mu:
+                with annotate("serve/prefill_chunk"):
+                    did = self._prefill_tick()
+            if not did:
+                self._prefill_work.wait(0.01)
+                self._prefill_work.clear()
+
+    def _ensure_prefill_threads(self):
+        """Top the pool back up to `prefill_workers` live threads —
+        thread start and the supervisor's replacement path share it."""
+        self._prefill_threads = [t for t in self._prefill_threads
+                                 if t.is_alive()]
+        while len(self._prefill_threads) < self.prefill_workers:
+            t = threading.Thread(
+                target=self._prefill_worker, daemon=True,
+                name=f"serve-prefill-{len(self._prefill_threads)}")
+            t.start()
+            self._prefill_threads.append(t)
+
+    def prefill_workers_alive(self) -> int:
+        return sum(t.is_alive() for t in self._prefill_threads)
+
+    def restart_dead_prefill_workers(self) -> int:
+        """Supervisor entry: replace dead prefill-pool workers,
+        returning how many were replaced. Unlike a decode-worker death
+        this is PARTIAL recovery — no request fails and no page moves:
+        slot/page state lives on the engine under _mu and a killed
+        worker dies between chunks, so replacement threads simply
+        resume the pending prompts."""
+        if not self.prefill_workers or self._stop.is_set():
+            return 0
+        dead = sum(1 for t in self._prefill_threads
+                   if not t.is_alive())
+        if dead:
+            self._ensure_prefill_threads()
+            self.prefill_worker_restarts += dead
+            self._prefill_work.set()
+        return dead
 
     def _record_occupancy(self):
         """Occupancy gauges, refreshed once per worker iteration (the
@@ -628,6 +824,9 @@ class ContinuousEngine:
         if idle:
             self._work.wait(0.05)
         self._work.clear()
+        self._drain_queue()
+
+    def _drain_queue(self):
         while True:
             try:
                 self._backlog.append(self.queue.get_nowait())
@@ -654,25 +853,37 @@ class ContinuousEngine:
                 self.recorder.admit(item[5])
                 free.pop(0)
 
-    def _prefill_tick(self):
+    def _prefill_tick(self) -> bool:
         """Run ONE prompt chunk of the oldest still-prefilling slot; on
         the final chunk, sample the request's first token and move the
-        slot to decoding."""
+        slot to decoding. Returns True iff a chunk ran (the prefill
+        pool parks when it gets False). Chunk size: the static
+        --prefill-chunk bound on the single loop, the PrefillBudget's
+        grant in pools mode."""
         import jax
         import jax.numpy as jnp
 
         cand = [i for i, sl in enumerate(self._slots)
                 if sl is not None and sl["pending"]]
         if not cand:
-            return
+            return False
         i = min(cand, key=lambda j: self._slots[j]["admitted"])
         sl = self._slots[i]
-        take = len(sl["pending"]) if not self.prefill_chunk \
-            else min(self.prefill_chunk, len(sl["pending"]))
+        if self.prefill_workers:
+            decoding = any(s is not None and not s["pending"]
+                           for s in self._slots)
+            if decoding and self._chunks_this_tick:
+                return False  # tick budget spent: next decode tick pays
+            take = min(self._budget.grant(decoding), len(sl["pending"]))
+        elif self.prefill_chunk:
+            take = min(self.prefill_chunk, len(sl["pending"]))
+        else:
+            take = len(sl["pending"])
         final = take == len(sl["pending"])
         bucketed = -(-take // self.prompt_bucket) * self.prompt_bucket
         padded = sl["pending"][:take] + [0] * (bucketed - take)
         start, new_len = sl["len"], sl["len"] + take
+        t_chunk = time.monotonic()
         try:
             last_logits = self._run_chunk(i, padded, start, new_len)
         except Exception as e:
@@ -681,13 +892,17 @@ class ContinuousEngine:
             introspection.note_failure(e, "serve/prefill_chunk")
             log.exception("prefill chunk failed")
             self._reset(e)
-            return
+            return False
+        self._budget.note_prefill(take, time.monotonic() - t_chunk)
+        self._chunks_this_tick += 1
         sl["pending"] = sl["pending"][take:]
         sl["len"] = new_len
         self.prefill_chunks_run += 1
+        self.prefill_tokens_run += take
         self.prefill_chunk_trace.append(self.steps_run)
+        self.recorder.observe_prefill_chunk(take)
         if not final:
-            return
+            return True
         self._on_prefill_complete(i, sl)
         self.prefills_run += 1
         key = jax.random.fold_in(self._base_key,
@@ -702,6 +917,11 @@ class ContinuousEngine:
         _stream_event(sl["stream"], {"token": tok}, sl["rid"])
         if sl["remaining"] <= 0:
             self._finish(i)
+        elif self.prefill_workers:
+            # The slot just became decodable: wake a decode loop that
+            # parked with nothing to tick.
+            self._work.set()
+        return True
 
     def _decode_tick(self):
         """One decode step over every DECODING slot (prefilling slots
@@ -737,7 +957,9 @@ class ContinuousEngine:
             log.exception("decode step failed")
             self._reset(e)
             return
-        self.recorder.observe_decode_step(time.monotonic() - t_step)
+        t_tick = time.monotonic() - t_step
+        self.recorder.observe_decode_step(t_tick)
+        self._budget.note_decode(t_tick)
         for i, sl in enumerate(self._slots):
             if sl is None or sl["pending"]:
                 continue
@@ -817,7 +1039,8 @@ class PagedContinuousEngine(ContinuousEngine):
                  max_len: int = 2048, page: int = 128,
                  pool_pages: int | None = None,
                  max_prompt_len: int = 1024, prefix_cap: int = 256,
-                 prefill_chunk: int = 0, mesh=None,
+                 prefill_chunk: int = 0, prefill_workers: int = 0,
+                 mesh=None,
                  recorder: RequestRecorder | None = None):
         import math
 
@@ -862,7 +1085,8 @@ class PagedContinuousEngine(ContinuousEngine):
         super().__init__(params, cfg, max_slots=max_slots,
                          max_len=max_len, prompt_bucket=page,
                          max_prompt_len=max_prompt_len,
-                         prefill_chunk=prefill_chunk, mesh=mesh,
+                         prefill_chunk=prefill_chunk,
+                         prefill_workers=prefill_workers, mesh=mesh,
                          recorder=recorder)
         assert self.max_len == self.max_pages * self.page
 
@@ -887,18 +1111,21 @@ class PagedContinuousEngine(ContinuousEngine):
         # the restarted worker builds a fresh allocator anyway, but
         # the allocator accounting and kv-page gauges must return to
         # baseline now — leaked pages are exactly what the chaos
-        # harness's worker-kill scenario asserts against.
-        for i in range(len(getattr(self, "_slots", []))):
-            self._free_slot_pages(i)
-        index = getattr(self, "_index", None)
-        if index is not None:
-            while index.evict_lru():
-                pass
-        super().recover_after_worker_death(err)
-        alloc = getattr(self, "_alloc", None)
-        total = (alloc.n_pages - 1) if alloc is not None \
-            else max(self.pool_pages - 1, 0)
-        self.recorder.set_kv_pages(used=0, total=total)
+        # harness's worker-kill scenario asserts against. Under _mu:
+        # a live prefill-pool worker must not run a chunk against a
+        # slot whose pages are being reclaimed.
+        with self._mu:
+            for i in range(len(getattr(self, "_slots", []))):
+                self._free_slot_pages(i)
+            index = getattr(self, "_index", None)
+            if index is not None:
+                while index.evict_lru():
+                    pass
+            super().recover_after_worker_death(err)
+            alloc = getattr(self, "_alloc", None)
+            total = (alloc.n_pages - 1) if alloc is not None \
+                else max(self.pool_pages - 1, 0)
+            self.recorder.set_kv_pages(used=0, total=total)
 
     # ---------- hooks ----------
 
@@ -971,6 +1198,7 @@ class PagedContinuousEngine(ContinuousEngine):
         self.recorder.set_kv_pages(
             used=self._alloc.n_pages - 1 - self._alloc.free_pages,
             total=self._alloc.n_pages - 1)
+        self.recorder.set_prefix_cache_pages(self._index.pages_held())
 
     def _preempt_youngest(self) -> int | None:
         """Free the most recently admitted request's pages and requeue
@@ -1027,6 +1255,12 @@ class PagedContinuousEngine(ContinuousEngine):
         if fresh is None:
             self._alloc.free(shared)  # drop refs; entries stay cached
             return False
+        if n_full:
+            # One lookup per ADMITTED prompt with at least one full
+            # page (shorter prompts can never hit; a backlogged retry
+            # must not inflate the miss count). Hit = any chain prefix
+            # matched — the hit-rate gauge divides these two counters.
+            self.recorder.prefix_lookup(hit=bool(shared))
         all_rows = shared + fresh
         table_row = all_rows + [0] * (self.max_pages - len(all_rows))
         self._cache = self._set_pages_fn(
@@ -1156,6 +1390,14 @@ class EngineSupervisor:
         self.gave_up = False
         self._consecutive = 0
         self._last_restart: float | None = None
+        # Prefill-pool ladder (pools mode): replacements are
+        # non-blocking (gated by a next-allowed time instead of a
+        # sleep) so a crash-looping prefill pool backs off without
+        # ever delaying decode-thread supervision.
+        self.prefill_restarts = 0
+        self._prefill_consecutive = 0
+        self._prefill_last: float | None = None
+        self._prefill_next_ok = 0.0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -1175,11 +1417,46 @@ class EngineSupervisor:
             self._thread.join(timeout=10)
             self._thread = None
 
+    def _supervise_prefill_pool(self, eng, now: float) -> None:
+        """Replace dead prefill-pool workers (pools mode). PARTIAL
+        recovery by design: a prefill death strands no request — the
+        slot/page state lives on the engine and decode keeps ticking —
+        so no future is failed and no page moves; the pool is just
+        topped back up, under the same exponential ladder as decode
+        restarts but gated by a deadline instead of a sleep."""
+        restart = getattr(eng, "restart_dead_prefill_workers", None)
+        if restart is None or now < self._prefill_next_ok:
+            return
+        if (self._prefill_last is not None
+                and now - self._prefill_last >= self.stable_after_s):
+            self._prefill_consecutive = 0  # pool had stabilized
+        n = restart()
+        if not n:
+            return
+        self._prefill_consecutive += 1
+        self._prefill_last = now
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s
+                    * 2 ** (self._prefill_consecutive - 1))
+        self._prefill_next_ok = now + delay
+        self.prefill_restarts += n
+        eng.recorder.prefill_worker_restarts.inc(n)
+        log.warning("prefill-pool worker death: %d worker(s) replaced "
+                    "(decode unaffected; next replacement gated for "
+                    "%.2fs)", n, delay)
+        if events.enabled():
+            events.instant("supervisor/prefill_worker_death", "chaos",
+                           {"workers": n})
+            events.instant("supervisor/prefill_worker_restart", "chaos",
+                           {"restarts": self.prefill_restarts,
+                            "backoff_s": round(delay, 3)})
+
     def _loop(self):
         eng = self.engine
         while not self._stop.is_set():
             if eng._stop.is_set():
                 return  # deliberate engine.stop(): nothing to revive
+            self._supervise_prefill_pool(eng, time.monotonic())
             if eng.thread.is_alive():
                 self._stop.wait(self.poll_interval_s)
                 continue
@@ -1244,15 +1521,23 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
 
         def do_GET(self):
             if self.path == "/healthz":
+                alive_fn = getattr(engine, "prefill_workers_alive", None)
                 return self._send({
                     "ok": True,
                     "batches": engine.batches_run,
                     "requests": engine.requests_served,
                     # Worker liveness: a dead worker with a green
                     # /healthz was exactly the wedge the supervisor
-                    # exists for — surface it either way.
+                    # exists for — surface it either way. The prefill
+                    # pool gets the same treatment (pools mode).
                     "worker_alive": engine.thread.is_alive(),
-                    "worker_restarts": engine.worker_restarts})
+                    "worker_restarts": engine.worker_restarts,
+                    "prefill_workers": getattr(engine,
+                                               "prefill_workers", 0),
+                    "prefill_workers_alive": (alive_fn()
+                                              if alive_fn else 0),
+                    "prefill_worker_restarts": getattr(
+                        engine, "prefill_worker_restarts", 0)})
             return self._send({"error": "not found"}, 404)
 
         def _stream_response(self, stream_q):
@@ -1346,6 +1631,19 @@ def main(argv=None) -> int:
                         "prefilled between decode steps (bounds the "
                         "latency a long admission injects into "
                         "in-flight requests); 0 = whole prompt at once")
+    p.add_argument("--prefill-workers", type=int, default=0,
+                   help="continuous/paged engine: disaggregate into a "
+                        "decode pool + this many prefill-pool workers. "
+                        "The decode thread keeps the tick cadence and "
+                        "admission; prefill chunks drain on the pool "
+                        "under a token-budget scheduler (one chunk "
+                        "costs ~half a decode tick while anything is "
+                        "decoding), so long-prompt bursts stop "
+                        "inflating in-flight streams' TPOT. 0 (the "
+                        "default) keeps the single-loop layout. "
+                        "--supervise also watches the pool: a dead "
+                        "prefill worker is replaced without failing "
+                        "any request")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel ways over the local chips "
                         "(models/decode_tp.py): weights, KV cache and "
@@ -1480,12 +1778,14 @@ def main(argv=None) -> int:
             params, cfg, max_slots=args.max_batch, max_len=args.max_len,
             page=args.page_size, pool_pages=args.pool_pages,
             prefix_cap=args.prefix_cache_cap,
-            prefill_chunk=args.prefill_chunk, mesh=mesh,
+            prefill_chunk=args.prefill_chunk,
+            prefill_workers=args.prefill_workers, mesh=mesh,
             recorder=recorder)
     elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len,
                                   prefill_chunk=args.prefill_chunk,
+                                  prefill_workers=args.prefill_workers,
                                   mesh=mesh, recorder=recorder)
     else:
         engine = BatchingEngine(params, cfg, max_batch=args.max_batch,

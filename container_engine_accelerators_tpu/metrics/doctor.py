@@ -405,11 +405,27 @@ class OomPrecursorDetector(Detector):
 class QueueCollapseDetector(Detector):
     """Queue depth at/above threshold and GROWING across the fast
     window with zero admissions: traffic arrives, nothing drains —
-    the admission path (not the decode path) is dead."""
+    the admission path (not the decode path) is dead.
+
+    Two-queue layout (serve --prefill-workers): the engine also emits
+    a per-pool serve/pool_depth counter, and each pool has its own
+    progress heartbeat — serve/prefill_chunk_tokens for the prefill
+    pool, serve/decode_step_ms for the decode pool. A pool whose depth
+    grows past threshold while ITS heartbeat is silent has collapsed
+    even though the other pool (and total admission) looks healthy, so
+    each fires its own finding naming the pool."""
 
     cls = "queue_collapse"
 
+    # (pool key in serve/pool_depth args, progress counter, label)
+    _POOLS = (("prefill", "serve/prefill_chunk_tokens",
+               "prefill chunks"),
+              ("decode", "serve/decode_step_ms", "decode steps"))
+
     def check(self, sig):
+        return self._check_total(sig) + self._check_pools(sig)
+
+    def _check_total(self, sig):
         series = sig.series("serve/queue_depth", sig.fast_since)
         if len(series) < 2:
             return []
@@ -432,6 +448,33 @@ class QueueCollapseDetector(Detector):
             f"queue depth grew {depth_first} -> {depth_last} with "
             f"zero admits in {sig.config.fast_window_s:.0f}s",
             0.9, ev)]
+
+    def _check_pools(self, sig):
+        series = sig.series("serve/pool_depth", sig.fast_since)
+        if len(series) < 2:
+            return []
+        out = []
+        for pool, progress, label in self._POOLS:
+            depth_first = series[0][1].get(pool, 0)
+            depth_last = series[-1][1].get(pool, 0)
+            if depth_last < sig.config.queue_min_depth:
+                continue
+            if depth_last <= depth_first:
+                continue
+            if sig.named(progress, "C", sig.fast_since):
+                continue
+            ev = {"pool": pool, "depth": depth_last,
+                  "depth_window_start": depth_first,
+                  "window_s": sig.config.fast_window_s,
+                  "events": [_evidence_event(
+                      {"name": "serve/pool_depth", "ph": "C", "ts": ts,
+                       "args": v}) for ts, v in series[-3:]]}
+            out.append(Finding(
+                self.cls, f"serve/{pool}-pool",
+                f"{pool} pool depth grew {depth_first} -> {depth_last} "
+                f"with zero {label} in {sig.config.fast_window_s:.0f}s",
+                0.9, ev))
+        return out
 
 
 class StragglerDetector(Detector):
@@ -1129,6 +1172,14 @@ class FaultListener:
                 log.warning("worker-kill fault with no engine attached")
                 return
             self.engine.fault_kill = True
+        elif kind == "prefill_kill":
+            if self.engine is None:
+                log.warning("prefill-kill fault with no engine attached")
+                return
+            # Consumed by ONE prefill-pool worker at its next loop top
+            # (cli/serve.py _prefill_worker) — outside the engine lock,
+            # so the death never strands _mu or half-mutated pages.
+            self.engine.fault_kill_prefill = True
         elif kind == "recompile_storm":
             self._recompile_storm(int(rec.get("n", 4)))
         elif kind == "hbm_climb":

@@ -132,6 +132,21 @@ class RequestRecorder:
             "serve_kv_pages_total",
             "Usable KV pool pages, excluding the reserved trash row "
             "(paged engine)", registry=reg)
+        self.prefix_cache_pages = Gauge(
+            "serve_prefix_cache_pages",
+            "Distinct KV pool pages retained by the prefix cache; "
+            "after a drain, kv_pages_in_use minus this must be zero "
+            "(the leak invariant chaos asserts)", registry=reg)
+        self.pool_queue_depth = Gauge(
+            "serve_pool_queue_depth",
+            "Per-pool work depth in the disaggregated layout "
+            "(serve --prefill-workers): prefill = backlogged requests "
+            "plus slots still holding prompt tokens, decode = slots "
+            "ticking", ["pool"], registry=reg)
+        self.prefix_hit_rate = Gauge(
+            "serve_prefix_hit_rate",
+            "prefix_hits / prefix_lookups over this process's "
+            "lifetime (paged engine)", registry=reg)
 
         self.requests = Counter(
             "serve_requests", "Requests closed, by outcome",
@@ -152,10 +167,36 @@ class RequestRecorder:
             "serve_prefix_pages_reused",
             "Full prompt pages served from the prefix cache instead of "
             "recomputed (paged engine)", registry=reg)
+        # Lookup/hit/miss make the cache's EFFECTIVENESS computable:
+        # reused-page counts alone can't distinguish "never asked"
+        # from "asked and missed" (ISSUE 12 observability fix).
+        self.prefix_lookups = Counter(
+            "serve_prefix_lookups",
+            "Prefix-cache lookups at paged admission (prompts with at "
+            "least one full page)", registry=reg)
+        self.prefix_hits = Counter(
+            "serve_prefix_hits",
+            "Prefix-cache lookups that matched at least one full "
+            "prompt page", registry=reg)
+        self.prefix_misses = Counter(
+            "serve_prefix_misses",
+            "Prefix-cache lookups that matched nothing", registry=reg)
+        self.prefill_chunks = Counter(
+            "serve_prefill_chunks",
+            "Prompt chunks forwarded by the prefill path (the prefill "
+            "pool's progress signal in the disaggregated layout)",
+            registry=reg)
         self.worker_restarts = Counter(
             "serve_worker_restarts",
             "Engine worker threads restarted by the supervisor after an "
             "unexpected death (serve --supervise)", registry=reg)
+        self.prefill_worker_restarts = Counter(
+            "serve_prefill_worker_restarts",
+            "Prefill-pool workers replaced by the supervisor after an "
+            "unexpected death (serve --prefill-workers --supervise); "
+            "partial recovery — no request fails", registry=reg)
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
 
     # ---------- lifecycle edges ----------
 
@@ -288,6 +329,44 @@ class RequestRecorder:
         if events.enabled():
             events.counter("serve/kv_pages", {"used": used,
                                               "total": total})
+
+    def set_prefix_cache_pages(self, pages: int) -> None:
+        self.prefix_cache_pages.set(pages)
+
+    def set_pool_depths(self, prefill: int, decode: int) -> None:
+        """Per-pool depth gauges (disaggregated layout); the twin
+        flight-recorder counter is what the doctor's two-queue
+        queue_collapse detector reads (metrics/doctor.py)."""
+        self.pool_queue_depth.labels(pool="prefill").set(prefill)
+        self.pool_queue_depth.labels(pool="decode").set(decode)
+        if events.enabled():
+            events.counter("serve/pool_depth", {"prefill": prefill,
+                                                "decode": decode})
+
+    # ---------- prefix cache / prefill progress ----------
+
+    def prefix_lookup(self, hit: bool) -> None:
+        """One prefix-cache lookup at admission; keeps the hit-rate
+        gauge consistent with the counters under one lock."""
+        with self._lock:
+            self._prefix_lookups += 1
+            self.prefix_lookups.inc()
+            if hit:
+                self._prefix_hits += 1
+                self.prefix_hits.inc()
+            else:
+                self.prefix_misses.inc()
+            self.prefix_hit_rate.set(
+                self._prefix_hits / self._prefix_lookups)
+
+    def observe_prefill_chunk(self, tokens: int) -> None:
+        """One forwarded prompt chunk — the prefill pool's progress
+        heartbeat (a growing prefill queue with none of these is a
+        collapsed prefill pool, the doctor's two-queue case)."""
+        self.prefill_chunks.inc()
+        if events.enabled():
+            events.counter("serve/prefill_chunk_tokens",
+                           {"tokens": tokens})
 
     # ---------- offline summaries ----------
 

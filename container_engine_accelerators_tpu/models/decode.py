@@ -895,6 +895,13 @@ class PrefixIndex:
         if len(self._lru) > self.cap:
             self.evict_lru()
 
+    def pages_held(self) -> int:
+        """Distinct pool rows the cache currently references. After a
+        full request drain these are the ONLY legitimately-in-use
+        pages, so `pages_in_use - pages_held() == 0` is the engine's
+        leak invariant (chaos asserts it over /metrics)."""
+        return len({row for row, _ in self._lru.values()})
+
     def evict_lru(self) -> bool:
         """Drop the least-recently-used entry (freeing its reference);
         False when empty."""

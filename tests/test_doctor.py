@@ -174,6 +174,50 @@ def test_queue_collapse_growth_with_zero_admits():
     assert det.check(sig(shallow, now=8.0)) == []
 
 
+def test_queue_collapse_names_the_dead_prefill_pool():
+    """Two-queue layout: prefill depth grows with zero prefill-chunk
+    heartbeats -> one finding naming the prefill pool, even though the
+    decode pool keeps ticking (and vice versa stays quiet)."""
+    det = doctor.QueueCollapseDetector()
+    evs = [C("serve/pool_depth", 1.0 + i, prefill=1 + i, decode=2)
+           for i in range(6)]
+    evs += [C("serve/decode_step_ms", 2.0 + i, ms=4.0) for i in range(5)]
+    found = det.check(sig(evs, now=8.0))
+    assert classes(found) == ["queue_collapse"]
+    assert found[0].subject == "serve/prefill-pool"
+    assert "prefill pool depth grew 1 -> 6" in found[0].summary
+    # The prefill pool IS making progress: no finding.
+    healthy = evs + [C("serve/prefill_chunk_tokens", 3.0 + i, tokens=32)
+                     for i in range(4)]
+    assert det.check(sig(healthy, now=8.0)) == []
+
+
+def test_queue_collapse_names_the_dead_decode_pool():
+    det = doctor.QueueCollapseDetector()
+    evs = [C("serve/pool_depth", 1.0 + i, prefill=0, decode=1 + i)
+           for i in range(6)]
+    evs += [C("serve/prefill_chunk_tokens", 2.0 + i, tokens=32)
+            for i in range(5)]
+    found = det.check(sig(evs, now=8.0))
+    assert classes(found) == ["queue_collapse"]
+    assert found[0].subject == "serve/decode-pool"
+    healthy = evs + [C("serve/decode_step_ms", 3.0 + i, ms=4.0)
+                     for i in range(4)]
+    assert det.check(sig(healthy, now=8.0)) == []
+
+
+def test_queue_collapse_pool_depth_quiet_when_shallow_or_draining():
+    det = doctor.QueueCollapseDetector()
+    # Deep but shrinking: the pool is draining, not collapsed.
+    draining = [C("serve/pool_depth", 1.0 + i, prefill=8 - i, decode=0)
+                for i in range(4)]
+    assert det.check(sig(draining, now=8.0)) == []
+    # Growing but below the depth threshold.
+    shallow = [C("serve/pool_depth", 1.0, prefill=0, decode=0),
+               C("serve/pool_depth", 2.0, prefill=2, decode=0)]
+    assert det.check(sig(shallow, now=8.0)) == []
+
+
 def test_straggler_from_watchdog_instant_and_heartbeat_skew(tmp_path):
     det = doctor.StragglerDetector()
     stall = [I("train/stalled", 5.0, process=3, age_s=42.0)]

@@ -1,0 +1,267 @@
+"""Disaggregated prefill/decode serving (serve --prefill-workers):
+PrefillBudget grant math, greedy token-identity for concurrent
+shared-prefix requests across admission orderings, decode progress
+between one admission's chunks, PageAllocator/PrefixIndex refcount
+invariants across the pool handoff, prefill-pool worker death →
+restart with zero failed requests and zero leaked pages, and the
+prefix-cache hit counters + cache-hit prefill skip (chunk-token
+accounting)."""
+
+import time
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.cli import loadgen
+from container_engine_accelerators_tpu.cli.serve import (
+    PagedContinuousEngine,
+    PrefillBudget,
+)
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models.decode import generate
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def direct(params, cfg, tokens, n_new):
+    import jax.numpy as jnp
+    out = generate(params, jnp.asarray([tokens], jnp.int32), cfg, n_new)
+    return [int(t) for t in out[0]]
+
+
+def pooled_engine(params, cfg, **kw):
+    defaults = dict(max_slots=4, max_len=256, page=16, pool_pages=40,
+                    max_prompt_len=128, prefill_chunk=32,
+                    prefill_workers=2)
+    defaults.update(kw)
+    return PagedContinuousEngine(params, cfg, **defaults)
+
+
+def wait_until(cond, timeout_s=60.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------- PrefillBudget (pure math) ----------
+
+def test_budget_full_chunk_when_nothing_decodes():
+    b = PrefillBudget(bucket=32, chunk=256)
+    assert b.grant(decoding=False) == 256
+    # Unchunked engine: no cap at all when idle.
+    assert PrefillBudget(32, 0).grant(decoding=False) == 1 << 30
+
+
+def test_budget_floors_at_one_bucket_while_decoding():
+    b = PrefillBudget(bucket=32, chunk=256)
+    # No EMAs yet: the floor is the whole grant.
+    assert b.grant(decoding=True) == 32
+    # Slack affords less than a bucket: still one bucket (progress).
+    b.note_decode(0.001)
+    b.note_prefill(100, 0.010)   # 1e-4 s/token -> slack covers 5 tokens
+    assert b.grant(decoding=True) == 32
+
+
+def test_budget_scales_with_slack_and_bucket_aligns():
+    b = PrefillBudget(bucket=32, chunk=256, slack_frac=0.5)
+    b.note_decode(0.0200)        # 20 ms ticks
+    b.note_prefill(1000, 0.100)  # 1e-4 s/token
+    # 20ms * 0.5 / 1e-4 = 100 tokens -> bucket-aligned down to 96.
+    assert b.grant(decoding=True) == 96
+
+
+def test_budget_caps_at_prefill_chunk():
+    b = PrefillBudget(bucket=32, chunk=64)
+    b.note_decode(1.0)
+    b.note_prefill(1000, 0.001)  # slack affords far more than the cap
+    assert b.grant(decoding=True) == 64
+
+
+# ---------- token identity across the pool handoff ----------
+
+def test_pools_greedy_identity_shared_prefix_orderings(model):
+    """N concurrent requests sharing a page-aligned prefix, admitted in
+    two different orders (and hitting the prefix cache in the second
+    round), must each return exactly the single-request greedy result:
+    the slot/page handoff between the pools never corrupts KV."""
+    params, cfg = model
+    prefix = list(range(1, 33))                   # 2 full 16-token pages
+    reqs = [(prefix + [40 + k] * (3 + k), 5 + k) for k in range(4)]
+    for ordering in (reqs, list(reversed(reqs))):
+        eng = pooled_engine(params, cfg)
+        try:
+            futs = [eng.submit(list(t), n, 0.0) for t, n in ordering]
+            for (t, n), fut in zip(ordering, futs):
+                assert fut.result(timeout=300) == \
+                    direct(params, cfg, t, n), (t, n)
+        finally:
+            eng.stop()
+
+
+def test_pools_decode_advances_between_chunks(model):
+    """A long admission's chunks must interleave with decode ticks —
+    the trace of steps_run recorded at each chunk strictly increases
+    while another request decodes (the single-loop layout also passes
+    this; pools must not regress it)."""
+    params, cfg = model
+    eng = pooled_engine(params, cfg, prefill_chunk=16)
+    try:
+        short = eng.submit([1, 2, 3], 60, 0.0)
+        wait_until(lambda: eng.steps_run > 2, what="short req decoding")
+        marker = len(eng.prefill_chunk_trace)
+        long_fut = eng.submit(list(range(1, 97)), 4, 0.0)  # >= 6 chunks
+        assert long_fut.result(timeout=300) == \
+            direct(params, cfg, list(range(1, 97)), 4)
+        trace = eng.prefill_chunk_trace[marker:]
+        assert len(trace) >= 2
+        assert trace[-1] > trace[0], \
+            f"decode made no progress across prefill chunks: {trace}"
+        short.result(timeout=300)
+    finally:
+        eng.stop()
+
+
+# ---------- refcount invariants across the handoff ----------
+
+def test_refcounts_drain_to_prefix_cache_only(model):
+    """After every request drains, the ONLY outstanding page references
+    belong to the prefix index (pages_in_use == index.pages_held());
+    clearing the index empties the allocator completely — the zero-leak
+    invariant the chaos scenario asserts over /metrics."""
+    params, cfg = model
+    eng = pooled_engine(params, cfg)
+    try:
+        prefix = list(range(1, 33))
+        futs = [eng.submit(prefix + [50 + k] * 4, 4, 0.0)
+                for k in range(4)]
+        for f in futs:
+            f.result(timeout=300)
+        wait_until(lambda: all(sl is None for sl in eng._slots),
+                   what="slots released")
+        with eng._mu:
+            assert eng._alloc.pages_in_use == eng._index.pages_held()
+            held = {row for row, _ in eng._index._lru.values()}
+            assert set(eng._alloc.outstanding_rows()) == held
+            eng._index.clear()
+            assert eng._alloc.outstanding_rows() == {}
+            assert eng._alloc.pages_in_use == 0
+    finally:
+        eng.stop()
+
+
+def test_shared_prefix_page_survives_other_holder(model):
+    """Two live requests share prefix pages; the first finishing must
+    not free the shared rows out from under the second (refcount > 1
+    while both hold them)."""
+    params, cfg = model
+    eng = pooled_engine(params, cfg)
+    try:
+        prefix = list(range(1, 33))
+        f1 = eng.submit(prefix + [60], 2, 0.0)       # finishes first
+        f2 = eng.submit(prefix + [61] * 3, 30, 0.0)  # long decode
+        assert f1.result(timeout=300) == \
+            direct(params, cfg, prefix + [60], 2)
+        assert f2.result(timeout=300) == \
+            direct(params, cfg, prefix + [61] * 3, 30)
+    finally:
+        eng.stop()
+
+
+# ---------- prefill-pool worker death ----------
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_prefill_kill_is_absorbed_without_failing_requests(model):
+    """Killing one prefill-pool worker mid-load fails NO request (the
+    replacement resumes pending prompts), restart_dead_prefill_workers
+    reports exactly the dead worker, and no page leaks."""
+    params, cfg = model
+    eng = pooled_engine(params, cfg, prefill_chunk=16)
+    try:
+        prefix = list(range(1, 33))
+        futs = [eng.submit(prefix + [70 + k] * 40, 6, 0.0)
+                for k in range(6)]
+        # The decode loop spawns the pool at startup; the kill flag is
+        # only consumed by a live worker.
+        wait_until(lambda: eng.prefill_workers_alive() == 2,
+                   what="prefill pool up")
+        eng.fault_kill_prefill = True
+        wait_until(lambda: eng.prefill_workers_alive() < 2,
+                   what="a prefill worker to die")
+        assert eng.restart_dead_prefill_workers() == 1
+        assert eng.prefill_worker_restarts == 1
+        assert eng.prefill_workers_alive() == 2
+        for k, f in enumerate(futs):
+            assert f.result(timeout=300) == \
+                direct(params, cfg, prefix + [70 + k] * 40, 6), k
+        wait_until(lambda: all(sl is None for sl in eng._slots),
+                   what="slots released")
+        with eng._mu:
+            eng._index.clear()
+            assert eng._alloc.outstanding_rows() == {}
+    finally:
+        eng.stop()
+
+
+# ---------- prefix-cache hit accounting ----------
+
+def test_prefix_hit_counters_and_cached_prefill_skip(model):
+    """A repeat prompt must count as a prefix-cache hit AND actually
+    skip its shared pages' forward: prefill_tokens_run grows by only
+    the non-shared tail the second time."""
+    params, cfg = model
+    eng = pooled_engine(params, cfg)
+    try:
+        prompt = list(range(1, 37))                  # 2 full pages + 4
+        r1 = eng.submit(list(prompt), 3, 0.0).result(timeout=300)
+        tokens_first = eng.prefill_tokens_run
+        assert tokens_first >= len(prompt)
+        r2 = eng.submit(list(prompt), 3, 0.0).result(timeout=300)
+        assert r1 == r2 == direct(params, cfg, prompt, 3)
+        # Second admission forwarded only the 4-token tail (bucketed to
+        # one 16-token page); the 32 shared tokens never ran.
+        assert eng.prefill_tokens_run - tokens_first == \
+            tokens_first - 32
+        rec = eng.recorder
+        assert rec._prefix_lookups == 2
+        assert rec._prefix_hits == 1
+    finally:
+        eng.stop()
+
+
+# ---------- loadgen multi-tenant mix (pure helpers) ----------
+
+def test_loadgen_tenant_mix_shapes():
+    args = loadgen.make_parser().parse_args(
+        ["--tenants", "4", "--tenant-prefix-len", "64",
+         "--prompt-len", "8", "--long-prompt-len", "32"])
+    assert loadgen.tenant_class(0) == "chat"
+    assert loadgen.tenant_class(1) == "batch"
+    t0, p0 = loadgen.tenant_tokens(args, 0)
+    t4, p4 = loadgen.tenant_tokens(args, 4)
+    assert t0 == t4 == 0
+    # Same tenant => same shared prefix; different request => body
+    # differs (the cache shares exactly the system prompt, no more).
+    assert p0[:64] == p4[:64]
+    assert p0[64:] != p4[64:]
+    assert len(p0) == 64 + 8
+    t1, p1 = loadgen.tenant_tokens(args, 1)
+    assert t1 == 1 and len(p1) == 64 + 32     # batch: long body
+    assert p1[:64] != p0[:64]                 # tenants don't share
+
+
+def test_loadgen_tenant_slo_nan_fails_closed():
+    args = loadgen.make_parser().parse_args(
+        ["--tenants", "2", "--slo-ttft-p99-ms", "100"])
+    slo, violated = loadgen._slo_block([], [], args)
+    assert violated and slo["ttft_p99_ms"]["observed"] is None
+    slo, violated = loadgen._slo_block([0.05], [], args)
+    assert not violated and slo["ttft_p99_ms"]["ok"]

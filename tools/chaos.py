@@ -270,18 +270,31 @@ def parse_gauge(metrics_text: str, name: str) -> float | None:
 
 
 def check_gauges_baseline(metrics_text: str) -> list[dict]:
-    """(b) leak check: after recovery + drain, slot and KV-page
-    occupancy must be back to zero — reclaimed, not abandoned."""
+    """(b) leak check: after recovery + drain, slot occupancy must be
+    back to zero and every in-use KV page must be attributable to the
+    prefix cache's own references (serve_prefix_cache_pages) — pages
+    held by neither a slot nor the cache were abandoned."""
     out = []
-    for g in ("serve_active_slots", "serve_kv_pages_in_use"):
-        v = parse_gauge(metrics_text, g)
-        if v is None:
-            # A scrape without the family at all (window engine has no
-            # kv pages) counts as baseline.
-            out.append(_result(f"gauges.{g}", True, "family absent"))
-            continue
-        out.append(_result(f"gauges.{g}", v == 0.0,
-                           f"{g}={v} after recovery (leak)"))
+    v = parse_gauge(metrics_text, "serve_active_slots")
+    if v is None:
+        out.append(_result("gauges.serve_active_slots", True,
+                           "family absent"))
+    else:
+        out.append(_result("gauges.serve_active_slots", v == 0.0,
+                           f"serve_active_slots={v} after recovery "
+                           "(leak)"))
+    used = parse_gauge(metrics_text, "serve_kv_pages_in_use")
+    if used is None:
+        # A scrape without the family at all (window engine has no
+        # kv pages) counts as baseline.
+        out.append(_result("gauges.serve_kv_pages_in_use", True,
+                           "family absent"))
+        return out
+    cached = parse_gauge(metrics_text, "serve_prefix_cache_pages") or 0.0
+    out.append(_result(
+        "gauges.serve_kv_pages_in_use", used == cached,
+        f"serve_kv_pages_in_use={used} vs prefix_cache_pages={cached} "
+        "after recovery (orphaned pages)"))
     return out
 
 
@@ -298,6 +311,20 @@ def check_healthz(body: dict, expect: dict) -> list[dict]:
             "healthz.worker_alive",
             bool(body.get("worker_alive")) == bool(expect["worker_alive"]),
             f"worker_alive={body.get('worker_alive')}"))
+    if "prefill_worker_restarts_min" in expect:
+        got = int(body.get("prefill_worker_restarts", 0))
+        out.append(_result(
+            "healthz.prefill_worker_restarts",
+            got >= expect["prefill_worker_restarts_min"],
+            f"prefill_worker_restarts={got}, need >= "
+            f"{expect['prefill_worker_restarts_min']}"))
+    if "prefill_workers_alive_min" in expect:
+        got = int(body.get("prefill_workers_alive", 0))
+        out.append(_result(
+            "healthz.prefill_workers_alive",
+            got >= expect["prefill_workers_alive_min"],
+            f"prefill_workers_alive={got}, need >= "
+            f"{expect['prefill_workers_alive_min']}"))
     return out
 
 
@@ -645,6 +672,12 @@ def _loadgen_args(url: str, ph: dict) -> "argparse.Namespace":
         argv += ["--slo-ttft-p99-ms", str(ph["slo_ttft_p99_ms"])]
     if ph.get("slo_tpot_p99_ms") is not None:
         argv += ["--slo-tpot-p99-ms", str(ph["slo_tpot_p99_ms"])]
+    if ph.get("tenants"):
+        argv += ["--tenants", str(ph["tenants"]),
+                 "--tenant-prefix-len",
+                 str(ph.get("tenant_prefix_len", 64)),
+                 "--long-prompt-len",
+                 str(ph.get("long_prompt_len", 256))]
     return loadgen.make_parser().parse_args(argv)
 
 

@@ -11,7 +11,7 @@ accelerator, NO network, and a bounded wall clock:
   python tools/perf_gate.py baseline   # learn PERF_BASELINE.json + bands
   python tools/perf_gate.py check      # gate against the committed baseline
 
-**The tier.** Four micro-benchmarks of the real hot paths on the CPU
+**The tier.** Six micro-benchmarks of the real hot paths on the CPU
 backend (forced in-process — the env var alone does not override this
 environment's TPU plugin), tiny shapes, fixed seeds:
 
@@ -20,6 +20,13 @@ environment's TPU plugin), tiny shapes, fixed seeds:
   decode_step_paged_ms   paged-engine decode step    (RequestRecorder)
   matmul_scan_ms         stacked scan matmul (the component_bench shape
                          family, shrunk to tier-1 budget)
+  prefill_cached_ms      cache-HIT admission: set_slot_pages onto
+                         shared prefix rows + one-page suffix prefill
+                         (the disaggregated engine's prefix-cache win)
+  decode_tick_under_prefill_ms
+                         one decode tick with a budget-bounded prefill
+                         chunk interleaved before it — the two-pool
+                         scheduler's TPOT invariant (RequestRecorder)
   multislice_step_ms     dp=2 train step across TWO real OS processes
                          joined by jax.distributed over gloo — the
                          hermetic stand-in for the DCN gradient psum
@@ -376,6 +383,144 @@ def _decode_bench(paged: bool):
     return name, measure, perturb
 
 
+def _paged_prefill_setup():
+    """Shared setup for the two disaggregated-serving benches: a paged
+    cache whose pool rows 1..3 hold the KV of a real 96-token prefix
+    (computed once here via prefill_slot_paged), plus the warmed
+    executables. Shapes match _decode_bench(paged=True) — n_slots=4,
+    max_len=128, page=32 — so the decode executable is shared and the
+    tier pays no extra compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_paged,
+        _jitted_prefill_slot_paged,
+        _jitted_prefill_suffix_paged,
+        _jitted_set_slot_pages,
+        init_paged_cache,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_slots, page, max_pages = 4, 32, 4
+    # Same pool shape as _decode_bench(paged=True) (its
+    # build_page_tables yields n_slots*max_pages rows + trash row 0),
+    # so decode_step_paged's executable is SHARED with that bench.
+    n_pages = n_slots * max_pages + 1
+    cache = init_paged_cache(cfg, n_slots, n_pages, page, max_pages)
+    step = _jitted_decode_step_paged(cfg)
+    set_pages = _jitted_set_slot_pages()
+    suffix = _jitted_prefill_suffix_paged(cfg)
+
+    prefix_len = 3 * page  # rows 1..3
+    prompt = jnp.arange(1, prefix_len + 1, dtype=jnp.int32) % 97 + 1
+    rows_prefix = jnp.asarray([1, 2, 3], jnp.int32)
+    _, cache = _jitted_prefill_slot_paged(cfg)(
+        params, cache, 0, rows_prefix, prompt, prefix_len)
+    return dict(cfg=cfg, params=params, cache=cache, step=step,
+                set_pages=set_pages, suffix=suffix, n_slots=n_slots,
+                page=page, max_pages=max_pages, prefix_len=prefix_len,
+                jnp=jnp)
+
+
+def _prefill_cached_bench():
+    """('prefill_cached_ms'): the cache-HIT admission path of the
+    disaggregated paged engine — set_slot_pages points the slot's table
+    at the already-computed shared prefix rows plus one fresh suffix
+    row, then prefill_suffix_paged runs ONLY the one-page suffix
+    through the model. This is what a prefix-cache hit costs end to
+    end; a regression here means cache-hit admissions stopped being
+    cheap (the whole point of the cache)."""
+    env = _paged_prefill_setup()
+    jnp = env["jnp"]
+    params, set_pages, suffix = (env["params"], env["set_pages"],
+                                 env["suffix"])
+    page, prefix_len = env["page"], env["prefix_len"]
+    true_len = prefix_len + page
+    # Prefix rows 1..3 shared, row 4 fresh for the suffix page.
+    rows_full = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    chunk = jnp.arange(1, page + 1, dtype=jnp.int32) % 89 + 1
+    box = [env["cache"]]
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        box[0] = set_pages(box[0], 0, rows_full, prefix_len)
+        last, box[0] = suffix(params, box[0], 0, chunk, true_len)
+        float(jnp.sum(last))
+
+    def measure(n_steps: int):
+        times = []
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            box[0] = set_pages(box[0], 0, rows_full, prefix_len)
+            last, box[0] = suffix(params, box[0], 0, chunk, true_len)
+            float(jnp.sum(last))
+            times.append(time.perf_counter() - t0)
+        return times, harness.pct_ms(times)
+
+    return "prefill_cached_ms", measure, None
+
+
+def _decode_under_prefill_bench():
+    """('decode_tick_under_prefill_ms'): one decode tick's latency with
+    a budget-bounded prefill chunk interleaved before it — the
+    disaggregated scheduler's TPOT invariant. Slot 0 perpetually
+    prefills one-page chunks (the prefill pool's unit of work), slots
+    1..3 decode; the sample times the DECODE step alone, so the metric
+    regresses if interleaving prefill chunks makes decode ticks slower
+    (executable churn, cache-layout damage), not if prefill itself
+    does. Percentiles come from the same RequestRecorder the serving
+    engine exports."""
+    import jax  # noqa: F401  (device init via setup)
+
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+
+    env = _paged_prefill_setup()
+    jnp = env["jnp"]
+    params, step, set_pages, suffix = (env["params"], env["step"],
+                                       env["set_pages"], env["suffix"])
+    n_slots, page = env["n_slots"], env["page"]
+    rows0 = jnp.asarray([4, 0, 0, 0], jnp.int32)
+    chunk = jnp.arange(1, page + 1, dtype=jnp.int32) % 89 + 1
+    toks = jnp.ones((n_slots,), jnp.int32)
+    # Slot 0 is the prefilling request: never active in decode.
+    active = jnp.asarray([False, True, True, True])
+
+    def fresh_len():
+        # Decoding slots restart every pass at page tokens so each pass
+        # times the SAME length trajectory (determinism over realism,
+        # like _decode_bench); slot 0 restarts empty for its chunk.
+        return jnp.asarray([0] + [page] * (n_slots - 1), jnp.int32)
+
+    box = [env["cache"]._replace(length=fresh_len()), toks]
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        box[0] = set_pages(box[0], 0, rows0, 0)
+        _, box[0] = suffix(params, box[0], 0, chunk, page)
+        last, box[0] = step(params, box[0], box[1], active)
+        box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        float(jnp.sum(last))
+
+    def measure(n_steps: int):
+        box[0] = box[0]._replace(length=fresh_len())
+        rec = RequestRecorder()
+        times = []
+        for _ in range(n_steps):
+            box[0] = set_pages(box[0], 0, rows0, 0)
+            _, box[0] = suffix(params, box[0], 0, chunk, page)
+            t0 = time.monotonic()
+            last, box[0] = step(params, box[0], box[1], active)
+            box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            float(jnp.sum(last))
+            dt = time.monotonic() - t0
+            times.append(dt)
+            rec.observe_decode_step(dt)
+        return times, rec.pct_ms("decode_step")
+
+    return "decode_tick_under_prefill_ms", measure, None
+
+
 def _matmul_bench():
     """Stacked scan matmul — the component_bench shape family shrunk to
     the tier-1 budget, watched for compile attribution like the real
@@ -520,7 +665,8 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
                 "wall_s": round(time.monotonic() - t_start, 2)}
 
     benches = [_train_bench(), _decode_bench(paged=False),
-               _decode_bench(paged=True), _matmul_bench()]
+               _decode_bench(paged=True), _matmul_bench(),
+               _prefill_cached_bench(), _decode_under_prefill_bench()]
     metrics: dict = {}
     results: list = []
     with harness.RecompileGuard() as guard:

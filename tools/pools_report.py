@@ -1,0 +1,168 @@
+"""Before/after evidence for disaggregated prefill/decode serving
+(--prefill-workers): run the SAME multi-tenant shared-prefix mix
+through two in-process PagedContinuousEngine instances — the
+single-loop layout (prefill interleaved on the decode thread) and the
+two-pool layout — and report recorder-derived TTFT/TPOT percentiles
+for each, plus the p99-TPOT interference verdict.
+
+The mix is the one cli/loadgen.py --tenants generates: every tenant
+prefixes its prompts with a tenant-specific 64-token system prompt
+(page-aligned, so the prefix cache shares it), even tenants are
+interactive "chat" (short bodies, long decodes), odd tenants are
+"batch" (long bodies, short decodes). Batch tenants' long prefills are
+exactly the interference that inflates chat TPOT on the single loop:
+each decode tick waits for a whole --prefill-chunk there, vs one
+PrefillBudget-bounded chunk in pools mode.
+
+Percentiles come from the engines' own RequestRecorder (the object
+/metrics exports), not ad-hoc client timing; warmup requests (compile
+tainted) are excluded from the samples. Writes POOLS_REPORT.json and
+exits 2 when pools-on fails to improve p99 TPOT — the committed report
+is the PR's before/after artifact:
+
+  JAX_PLATFORMS=cpu python tools/pools_report.py --out POOLS_REPORT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PAGE = 32
+MAX_SLOTS = 4
+MAX_LEN = 512
+PREFILL_CHUNK = 256
+PREFIX_LEN = 64          # 2 full pages: shared per tenant
+CHAT_BODY, CHAT_NEW = 96, 48
+BATCH_BODY, BATCH_NEW = 352, 12
+
+
+def build_mix(tenants: int, requests: int) -> list[tuple[list[int], int]]:
+    """The loadgen --tenants mix, deterministic: request i belongs to
+    tenant i % tenants; its prompt is the tenant's fixed prefix plus a
+    per-request body (distinct per request, so prefill work is real and
+    only the prefix pages are shareable)."""
+    reqs = []
+    for i in range(requests):
+        t = i % tenants
+        prefix = [(t * 31 + j) % 97 + 1 for j in range(PREFIX_LEN)]
+        body_len = BATCH_BODY if t % 2 else CHAT_BODY
+        body = [(i * 7 + j) % 100 + 1 for j in range(body_len)]
+        n_new = BATCH_NEW if t % 2 else CHAT_NEW
+        reqs.append((prefix + body, n_new))
+    return reqs
+
+
+def run_mix(params, cfg, prefill_workers: int, tenants: int,
+            requests: int) -> dict:
+    from container_engine_accelerators_tpu.cli.serve import (
+        PagedContinuousEngine,
+    )
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+
+    rec = RequestRecorder()
+    eng = PagedContinuousEngine(
+        params, cfg, max_slots=MAX_SLOTS, max_len=MAX_LEN, page=PAGE,
+        pool_pages=MAX_SLOTS * (MAX_LEN // PAGE) + 17,
+        max_prompt_len=PREFIX_LEN + BATCH_BODY, prefix_cap=64,
+        prefill_chunk=PREFILL_CHUNK, prefill_workers=prefill_workers,
+        recorder=rec)
+    try:
+        # Warmup: one request per tenant compiles every bucket
+        # executable and seeds the prefix cache, exactly like a warm
+        # server; its compile-tainted samples are dropped below.
+        for tokens, n_new in build_mix(tenants, tenants):
+            eng.submit(list(tokens), n_new, 0.0).result(timeout=600)
+        with rec._lock:
+            for xs in rec.samples.values():
+                xs.clear()
+        t0 = time.monotonic()
+        futs = [eng.submit(list(tokens), n_new, 0.0)
+                for tokens, n_new in build_mix(tenants, requests)]
+        for f in futs:
+            f.result(timeout=600)
+        wall_s = time.monotonic() - t0
+        return {
+            "layout": ("two-pool" if prefill_workers else "single-loop"),
+            "prefill_workers": prefill_workers,
+            "requests": requests,
+            "wall_s": round(wall_s, 2),
+            "ttft_ms": rec.pct_ms("ttft"),
+            "tpot_ms": rec.pct_ms("tpot"),
+            "prefill_chunks": eng.prefill_chunks_run,
+            "prefill_tokens": eng.prefill_tokens_run,
+            "prefix_pages_reused": eng.prefix_pages_reused,
+        }
+    finally:
+        eng.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--prefill-workers", type=int, default=2,
+                    help="pool size for the pools-on run")
+    ap.add_argument("--out", default="POOLS_REPORT.json")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from container_engine_accelerators_tpu.models import (
+        init_params, llama_tiny,
+    )
+
+    # The serve --tiny model (not the 1-layer test shrink): prefill
+    # chunks must cost real time relative to a decode tick, or there is
+    # no interference to disaggregate away.
+    cfg = llama_tiny(max_seq_len=MAX_LEN)
+    params = init_params(jax.random.key(0), cfg)
+
+    single = run_mix(params, cfg, 0, args.tenants, args.requests)
+    pools = run_mix(params, cfg, args.prefill_workers, args.tenants,
+                    args.requests)
+    before = single["tpot_ms"].get("p99")
+    after = pools["tpot_ms"].get("p99")
+    win = (before is not None and after is not None and after < before)
+    report = {
+        "kind": "pools_report",
+        "version": 1,
+        "t": round(time.time(), 3),
+        "mix": {"tenants": args.tenants, "requests": args.requests,
+                "tenant_prefix_len": PREFIX_LEN,
+                "chat": {"body": CHAT_BODY, "new": CHAT_NEW},
+                "batch": {"body": BATCH_BODY, "new": BATCH_NEW},
+                "page": PAGE, "max_slots": MAX_SLOTS,
+                "prefill_chunk": PREFILL_CHUNK},
+        "single_loop": single,
+        "pools": pools,
+        "tpot_p99_before_ms": before,
+        "tpot_p99_after_ms": after,
+        "tpot_p99_improvement": (round(1 - after / before, 4)
+                                 if win else None),
+        "verdict": "pools_win" if win else "no_win",
+    }
+    tmp = f"{args.out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps({k: report[k] for k in
+                      ("tpot_p99_before_ms", "tpot_p99_after_ms",
+                       "tpot_p99_improvement", "verdict")}))
+    print(f"pools-report -> {args.out}", file=sys.stderr)
+    return 0 if win else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
