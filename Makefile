@@ -184,13 +184,23 @@ chaos-tests:
 # tautological marker expression.
 multislice-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_multislice.py \
+	    tests/test_dcn_overlap.py \
 	    tests/test_multiprocess.py::test_two_process_elastic_resume \
+	    -q -m "slow or not slow"
+
+# DCN compute/communication overlap (ISSUE 13): bucket partitioner +
+# int8/error-feedback units, overlap-vs-ground-truth gradient check,
+# loss-trajectory parity (incl. grad_accum fusion), checkpoint-format
+# preservation, and the 2-process overlap-vs-baseline CLI parity e2e
+# with exposed-comm attribution on the metrics log.
+dcn-overlap-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_dcn_overlap.py \
 	    -q -m "slow or not slow"
 
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
-    serve-pools-smoke multislice-smoke chaos-smoke
+    serve-pools-smoke multislice-smoke dcn-overlap-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -205,4 +215,4 @@ clean:
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
-    smoke dryrun clean
+    dcn-overlap-smoke smoke dryrun clean

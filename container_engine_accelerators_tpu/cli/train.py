@@ -91,6 +91,22 @@ def main(argv=None) -> int:
                         "(slices land on the dp axis); default: "
                         "MEGASCALE_NUM_SLICES / JAX_NUM_SLICES env, "
                         "else 1")
+    p.add_argument("--dcn-overlap", action="store_true",
+                   help="bucketed overlapped dp gradient reduction "
+                        "(parallel/grad_comm.py): psum bucket i while "
+                        "bucket i+1's backward still computes, with a "
+                        "one-shot exposed-comm calibration reported on "
+                        "/metrics and the step log; off = the seed's "
+                        "single-psum step, bit-exact")
+    p.add_argument("--dcn-bucket-mb", type=float, default=4.0,
+                   help="target gradient bucket size in MiB for "
+                        "--dcn-overlap (uncompressed f32 bytes)")
+    p.add_argument("--dcn-grad-compress", choices=("none", "int8"),
+                   default="none",
+                   help="compress dp/DCN gradient traffic: int8 "
+                        "quantization with per-leaf error feedback "
+                        "(requires --dcn-overlap); ICI collectives "
+                        "are never compressed")
     p.add_argument("--elastic", action="store_true",
                    help="survive slice loss: watch peer heartbeats "
                         "(requires --heartbeat-dir) and on a lost "
@@ -191,6 +207,20 @@ def main(argv=None) -> int:
     log.info("mesh %s over %d device(s), %d process(es), %d slice(s)",
              dict(mesh.shape), n_dev, jax.process_count(), slices)
 
+    dcn_overlap = None
+    if args.dcn_overlap:
+        from container_engine_accelerators_tpu.parallel import (
+            DcnOverlapConfig,
+        )
+        dcn_overlap = DcnOverlapConfig(
+            bucket_bytes=max(int(args.dcn_bucket_mb * (1 << 20)), 1),
+            compress=args.dcn_grad_compress)
+        log.info("dcn overlap on: bucket %.1f MiB, compress=%s",
+                 args.dcn_bucket_mb, args.dcn_grad_compress)
+    elif args.dcn_grad_compress != "none":
+        raise SystemExit("--dcn-grad-compress requires --dcn-overlap "
+                         "(compression rides the bucketed reducer)")
+
     if args.data:
         from container_engine_accelerators_tpu.training.dataset import (
             token_file_batches,
@@ -282,7 +312,8 @@ def main(argv=None) -> int:
                    metrics_port=args.metrics_port,
                    metrics_host=args.metrics_host,
                    heartbeat_dir=args.heartbeat_dir,
-                   watchdog_threshold_s=args.watchdog_threshold)
+                   watchdog_threshold_s=args.watchdog_threshold,
+                   dcn_overlap=dcn_overlap)
 
     if monitor is not None:
         monitor.stop()

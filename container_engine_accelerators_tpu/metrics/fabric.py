@@ -65,13 +65,16 @@ class FabricMetricServer(ExporterBase):
         self.interval = interval
         self._stop = threading.Event()
         self._last: dict[tuple[str, str], tuple[int, float]] = {}
-        # Opt-in active ICI probe (the reference fabric-metrics-server
-        # analog run from inside the workload): a callable returning
-        # [(collective, axis, busbw_bytes_per_second), ...] — e.g.
-        # ops/collectives.make_probe_hook(mesh, axis). It RUNS a real
-        # collective over the fabric, so it is rate-limited to one
-        # round per `collective_probe_interval` seconds and never
-        # enabled by default.
+        # Opt-in active fabric probe (the reference fabric-metrics-
+        # server analog run from inside the workload): a callable
+        # returning [(collective, axis, fabric, busbw_bytes_per_second),
+        # ...] — e.g. ops/collectives.make_probe_hook(mesh, axis), with
+        # fabric 'ici' or 'dcn'. Legacy 3-tuples without the fabric
+        # element are accepted and labeled 'ici' (every pre-existing
+        # hook probed an intra-slice axis). It RUNS a real collective
+        # over the fabric, so it is rate-limited to one round per
+        # `collective_probe_interval` seconds and never enabled by
+        # default.
         self.collective_probe = collective_probe
         self.collective_probe_interval = collective_probe_interval
         self._next_collective_probe = 0.0  # due on the first poll
@@ -112,8 +115,10 @@ class FabricMetricServer(ExporterBase):
             "fabric_collective_busbw_bytes_per_second",
             "Measured collective bus bandwidth over a mesh axis "
             "(nccl-tests busBW convention; ops/collectives probe via "
-            "an opt-in rate-limited background hook)",
-            ["collective", "axis"], registry=self.registry)
+            "an opt-in rate-limited background hook). `fabric` is the "
+            "physical interconnect the axis rides: 'ici' within a "
+            "slice, 'dcn' for the cross-slice dp axis",
+            ["collective", "axis", "fabric"], registry=self.registry)
 
     # ---------- collection ----------
 
@@ -162,9 +167,14 @@ class FabricMetricServer(ExporterBase):
             self._next_collective_probe = (
                 now + self.collective_probe_interval)
             try:
-                for coll, axis, busbw in self.collective_probe():
+                for row in self.collective_probe():
+                    if len(row) == 4:
+                        coll, axis, fabric, busbw = row
+                    else:  # legacy 3-tuple hook: intra-slice probe
+                        (coll, axis, busbw), fabric = row, "ici"
                     self.collective_busbw.labels(
-                        collective=coll, axis=axis).set(busbw)
+                        collective=coll, axis=axis,
+                        fabric=fabric).set(busbw)
             except Exception:
                 log.exception("collective busBW probe failed")
         self.scrapes.inc()

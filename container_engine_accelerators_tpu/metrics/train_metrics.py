@@ -203,6 +203,12 @@ class TrainRecorder:
         # a step's productive charge — the recompile happens INSIDE
         # the step dispatch the next record_step will report.
         self._pending_recompile = 0.0
+        # DCN overlap attribution (record_dcn_attribution): gauges are
+        # created lazily on the first calibration — a registered-but-
+        # never-set Gauge exports 0.0, which would read as "perfectly
+        # overlapped" on runs that never measured anything.
+        self._dcn_gauges: dict | None = None
+        self._dcn_exposed_per_step = 0.0
         self.samples = {k: collections.deque(maxlen=max_samples)
                         for k in SAMPLE_KINDS}
 
@@ -368,6 +374,13 @@ class TrainRecorder:
                 # productive AND recompile.
                 self._buckets["productive"] += max(
                     cs - self._pending_recompile, 0.0)
+                if self._dcn_exposed_per_step > 0.0:
+                    # The calibration probe said this much of every
+                    # steady step is non-overlapped dp reduction;
+                    # accumulate it (clamped to the step's own charge)
+                    # so total exposed comm reads next to productive.
+                    self._dcn_gauges["exposed_total"].inc(
+                        min(self._dcn_exposed_per_step, cs))
             self._pending_recompile = 0.0
             self._buckets["stalled"] += max(data_wait_s, 0.0)
             self._steps += 1
@@ -558,6 +571,62 @@ class TrainRecorder:
                 s = max(seconds, 0.0)
                 events.complete("train/host_sync", time.monotonic() - s,
                                 s, "train")
+
+    def record_dcn_attribution(self, attr: dict,
+                               now: float | None = None) -> None:
+        """Result of a DCN overlap calibration (training/train.py
+        make_dcn_probes): exports the measured overlap fraction,
+        exposed-comm seconds per step, and gradient-reduction busBW,
+        and remembers the per-step exposure so subsequent record_step
+        calls grow a cumulative `train_dcn_exposed_seconds` counter —
+        the wall-clock the overlap failed to hide, readable next to
+        the productive bucket without inventing a new goodput class.
+        Charges nothing itself (the probe steps are not training)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._dcn_gauges is None:
+                reg = self.registry
+                self._dcn_gauges = {
+                    "overlap_fraction": Gauge(
+                        "train_dcn_overlap_fraction",
+                        "Fraction of the bucketed dp gradient-reduction "
+                        "time hidden under backward compute, in [0,1] "
+                        "(calibration probe)", registry=reg),
+                    "exposed": Gauge(
+                        "train_dcn_exposed_seconds_per_step",
+                        "Exposed (non-overlapped) DCN communication per "
+                        "step: full-step minus compute-only probe time",
+                        registry=reg),
+                    "busbw": Gauge(
+                        "train_dcn_busbw_bytes_per_second",
+                        "Gradient-reduction bus bandwidth over the dp "
+                        "axis (wire bytes / summed bucket reduce time)",
+                        registry=reg),
+                    "wire": Gauge(
+                        "train_dcn_wire_bytes_per_step",
+                        "Bytes crossing the dp axis per step after "
+                        "gradient compression", registry=reg),
+                    "exposed_total": Counter(
+                        "train_dcn_exposed_seconds",
+                        "Cumulative exposed-DCN wall clock charged at "
+                        "step edges (per-step exposure x steady steps)",
+                        registry=reg),
+                }
+            g = self._dcn_gauges
+            exposed = max(float(attr.get("exposed_s_per_step", 0.0)), 0.0)
+            g["overlap_fraction"].set(attr.get("overlap_fraction", 0.0))
+            g["exposed"].set(exposed)
+            g["busbw"].set(attr.get("busbw_bytes_per_second", 0.0))
+            g["wire"].set(attr.get("wire_bytes_per_step", 0.0))
+            self._dcn_exposed_per_step = exposed
+            rec = {"kind": "dcn_attribution",
+                   "t": round(time.time(), 3), **attr}
+            self._append_log(rec)
+            if events.enabled():
+                events.counter("train/dcn_overlap", {
+                    "overlap_fraction": round(
+                        float(attr.get("overlap_fraction", 0.0)), 4),
+                    "exposed_ms_per_step": round(exposed * 1e3, 3)})
 
     # ---------- derived rates / goodput ----------
 

@@ -43,6 +43,21 @@ class CollectiveResult:
                 f"{self.bus_bw_gbps:>8.2f}")
 
 
+def axis_fabric(axis: str) -> str:
+    """Which physical fabric a collective over this mesh axis rides.
+
+    The multislice layout (parallel/mesh.py) places slices along 'dp',
+    making the dp gradient reduction the only collective that crosses
+    DCN — but only when more than one process/slice is actually
+    present; a single-host dp axis is ordinary ICI. Every other axis
+    (fsdp/sp/tp/ep/pp) stays inside a slice. The recorder and the
+    busBW gauges use this to attribute exposed time to the right
+    fabric instead of lumping ~100 GB/s ICI with ~10 GB/s DCN."""
+    if axis == "dp" and jax.process_count() > 1:
+        return "dcn"
+    return "ici"
+
+
 _BUS_FACTORS: dict[str, Callable[[int], float]] = {
     "all_reduce": lambda n: 2 * (n - 1) / n,
     "all_gather": lambda n: (n - 1) / n,
@@ -136,12 +151,19 @@ def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
     # against whatever the timeline shows running next to it.
     from container_engine_accelerators_tpu.metrics import events
     if events.enabled():
+        fabric = axis_fabric(axis)
         events.complete(f"fabric/probe/{collective}", m0,
                         time.monotonic() - m0, "fabric",
-                        {"axis": axis, "size_bytes": size,
+                        {"axis": axis, "fabric": fabric,
+                         "size_bytes": size,
                          "time_us": round(dt * 1e6, 1),
                          "bus_bw_gbps": round(bus_bw, 3)})
-        events.counter("fabric/busbw_gbps", {collective: round(bus_bw, 3)})
+        # One counter series per (collective, axis, fabric): a dp/DCN
+        # all-reduce must never overwrite the tp/ICI series on the
+        # trace-merge timeline — they differ by an order of magnitude.
+        events.counter("fabric/busbw_gbps",
+                       {f"{collective}.{axis}.{fabric}":
+                        round(bus_bw, 3)})
     return CollectiveResult(collective, size, dt * 1e6, alg_bw, bus_bw)
 
 
@@ -167,14 +189,18 @@ def make_probe_hook(mesh: Mesh, axis: str,
     FabricMetricServer(collective_probe=...): each invocation times the
     given collectives once at one small size (defaults keep one round
     well under a second on healthy ICI) and returns
-    [(collective, axis, busbw_bytes_per_second), ...] for the
-    `fabric_collective_busbw_bytes_per_second` gauge family."""
+    [(collective, axis, fabric, busbw_bytes_per_second), ...] for the
+    `fabric_collective_busbw_bytes_per_second` gauge family, where
+    `fabric` is 'ici' or 'dcn' (axis_fabric) so the recorder can
+    attribute exposed time to the right interconnect."""
+    fabric = axis_fabric(axis)
+
     def hook():
         out = []
         for c in collectives:
             r = probe_collective(mesh, axis, c, size_bytes,
                                  warmup=warmup, iters=iters)
-            out.append((c, axis, r.bus_bw_gbps * 1e9))
+            out.append((c, axis, fabric, r.bus_bw_gbps * 1e9))
         return out
 
     return hook

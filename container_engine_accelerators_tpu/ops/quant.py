@@ -81,6 +81,50 @@ def dequantize_kv(q: jnp.ndarray, scales: jnp.ndarray,
             * jnp.swapaxes(scales, -1, -2)[..., None]).astype(dtype)
 
 
+def quantize_grads(g: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 for GRADIENT leaves on the DCN wire
+    (parallel/grad_comm.py int8 bucket reduction, ZeRO++-style).
+
+    The leaf arrives STACKED: leading axis = dp slot (one per-slice
+    gradient per row), so scales must never mix slots — each slice
+    quantizes against its own absmax or a hot slice would crush its
+    peers' resolution. Scale granularity by rank:
+
+      ndim <= 1  ([] or [S])          one scale over everything
+      ndim == 2  ([S, D])             per leading row (absmax over D)
+      ndim >= 3  ([S, ..., F])        per (slot, last-dim channel) —
+                                      the quantize_weights granularity,
+                                      generalized to any middle rank
+
+    Scales keep reduced dims (keepdims) so dequantize is a plain
+    broadcast multiply. The absmax floor is 1e-30, not quantize_weights'
+    1e-8: late-training gradients live many decades below weights, and
+    an 1e-8 floor would silently zero every leaf whose absmax drops
+    under it (the error-feedback accumulator would then grow without
+    bound)."""
+    g_f = g.astype(jnp.float32)
+    if g_f.ndim <= 1:
+        axes = tuple(range(g_f.ndim))
+    elif g_f.ndim == 2:
+        axes = (1,)
+    else:
+        axes = tuple(range(1, g_f.ndim - 1))
+    absmax = jnp.max(jnp.abs(g_f), axis=axes, keepdims=True)
+    scales = jnp.maximum(absmax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(g_f / scales), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_grads(q: jnp.ndarray, scales: jnp.ndarray,
+                     scale: float | jnp.ndarray = 1.0) -> jnp.ndarray:
+    """Inverse of quantize_grads. `scale` is an EXTRA factor fused into
+    the per-leaf scales before the broadcast multiply — grad_comm fuses
+    the 1/(n_slices * grad_accum) mean denominator here, so composing
+    bucketed reduction with gradient accumulation costs no second
+    tree_map pass over the full-size gradients."""
+    return q.astype(jnp.float32) * (scales * scale)
+
+
 def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, *, block_f: int):
     x = x_ref[:, :]                        # [T, D] bf16
     q = q_ref[:, :]                        # [D, bf] int8

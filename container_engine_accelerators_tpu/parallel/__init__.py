@@ -6,6 +6,11 @@ transports (reference gpudirect-*/); here scaling is expressed natively as
 `jax.sharding.Mesh` axes + XLA collectives over ICI/DCN.
 """
 
+from container_engine_accelerators_tpu.parallel.grad_comm import (
+    DcnOverlapConfig,
+    make_bucket_reducer,
+    partition_buckets,
+)
 from container_engine_accelerators_tpu.parallel.mesh import (
     MeshAxes,
     auto_axis_sizes,
@@ -19,6 +24,9 @@ from container_engine_accelerators_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "DcnOverlapConfig",
+    "make_bucket_reducer",
+    "partition_buckets",
     "MeshAxes",
     "auto_axis_sizes",
     "make_mesh",

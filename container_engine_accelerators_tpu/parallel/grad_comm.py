@@ -1,0 +1,431 @@
+"""Bucketed DCN gradient reduction with optional int8 compression
+(ISSUE 13 tentpole; ROADMAP item 4).
+
+PR 10's multislice layout makes the data-parallel gradient reduction
+the ONLY collective that crosses DCN, and the seed train step pays it
+as one monolithic implicit psum after the whole backward pass: GSPMD
+sees per-microbatch gradients whose dp mean it materializes in a
+single fused all-reduce, fully exposed behind the last layer's
+backward. This module restructures that reduction MegaScale-style:
+
+  1. The train step computes PER-SLICE gradients explicitly — batch
+     reshaped to [S, B/S, ...], vmapped grad over the slice axis, the
+     stacked result pinned to P('dp', *param_spec) so no implicit dp
+     mean ever forms.
+  2. The stacked gradient pytree is partitioned into size-targeted
+     BUCKETS in reverse flatten order (lm_head first — the grads the
+     backward pass finishes first), and each bucket is reduced
+     independently. Under one jit, each bucket is an independent
+     collective with no data dependency on the others, which is
+     exactly what XLA's latency-hiding scheduler needs to overlap
+     bucket i's DCN transfer with bucket i+1's remaining backward
+     compute; the monolithic path hands it a single all-or-nothing
+     dependency instead.
+  3. With compress='int8', the WIRE payload is int8: each slice
+     quantizes its slot of the stacked gradient locally
+     (ops/quant.quantize_grads — per-(slot, channel) symmetric
+     scales), the int8 values + f32 scales are replicated over dp
+     (an all-gather of one-quarter the f32 bytes), and the mean is
+     taken locally after dequantization, with the 1/(n_slices *
+     grad_accum) denominator fused into the dequant scales. The
+     compression error is returned per-slot for the caller to carry
+     as the error-feedback accumulator (ZeRO++-style: next step's
+     gradient re-injects it, so the quantization error is bounded
+     instead of accumulating as bias).
+
+Everything here is GSPMD-level: sharding constraints force where the
+collectives land, XLA emits them. On jax 0.4.x there is no
+partial-manual shard_map to write the psum by hand (see
+spmd_util.compat_shard_map), and the constraint formulation keeps the
+reducer differentiable-free and donation-friendly inside the one
+train-step jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.ops.quant import (
+    dequantize_grads,
+    quantize_grads,
+)
+
+COMPRESS_MODES = ("none", "int8")
+
+# Default bucket target: 4 MiB of per-slice f32 gradient payload. Large
+# enough that per-collective latency amortizes, small enough that the
+# first bucket is in flight long before the backward pass finishes
+# (MegaScale and DDP both land in the 1–25 MiB range).
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class DcnOverlapConfig:
+    """Configuration for the overlapped dp-gradient reduction.
+
+    bucket_bytes: target per-bucket payload (per-slice f32 bytes).
+    compress: 'none' (f32 wire) or 'int8' (quantized wire + error
+        feedback carried in TrainState.dcn_ef).
+    axis: mesh axis the reduction crosses — 'dp' is the DCN axis in
+        the multislice layout (parallel/mesh.py)."""
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    compress: str = "none"
+    axis: str = "dp"
+
+    def __post_init__(self):
+        if self.compress not in COMPRESS_MODES:
+            raise ValueError(
+                f"compress={self.compress!r} not in {COMPRESS_MODES}")
+        if self.bucket_bytes <= 0:
+            raise ValueError("bucket_bytes must be positive")
+
+
+def _leaf_bytes(leaf) -> int:
+    """Per-slice f32 payload of one gradient leaf (shape/dtype duck:
+    arrays and ShapeDtypeStructs both work)."""
+    return int(math.prod(leaf.shape)) * 4
+
+
+def partition_buckets(leaves: Sequence[Any],
+                      bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                      ) -> list[list[int]]:
+    """Partition flattened gradient leaves into size-targeted buckets.
+
+    Deterministic greedy packing in REVERSE flatten order (the backward
+    pass produces the tree's last leaves first, so the first bucket can
+    start reducing while earlier layers' grads are still computing):
+    leaves accumulate until the bucket would exceed `bucket_bytes`,
+    then a new bucket opens. A single leaf larger than the target gets
+    its own bucket (never split — a leaf is one collective). Returns a
+    list of buckets, each a list of ORIGINAL leaf indices; every index
+    appears exactly once, so scatter/gather round-trips the pytree."""
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for idx in reversed(range(len(leaves))):
+        nbytes = _leaf_bytes(leaves[idx])
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(idx)
+        cur_bytes += nbytes
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _scale_count(stacked_shape: tuple[int, ...]) -> int:
+    """Number of f32 scales quantize_grads emits for a stacked leaf
+    (keepdims shapes; see ops/quant.quantize_grads rank rules)."""
+    ndim = len(stacked_shape)
+    if ndim <= 1:
+        return 1
+    if ndim == 2:
+        return stacked_shape[0]
+    return stacked_shape[0] * stacked_shape[-1]
+
+
+def leaf_wire_bytes(leaf, n_slices: int, compress: str) -> int:
+    """Bytes this leaf puts on the dp/DCN wire per step.
+
+    'none': the f32 all-reduce payload (nccl-tests 'size' convention —
+    the reduced tensor's bytes). 'int8': the all-gather payload — the
+    full stacked int8 values plus their f32 scales (what every slice
+    must receive)."""
+    per_slice = int(math.prod(leaf.shape))
+    if compress == "none":
+        return per_slice * 4
+    stacked = (n_slices,) + tuple(leaf.shape)
+    return per_slice * n_slices + _scale_count(stacked) * 4
+
+
+def wire_bytes(leaves: Sequence[Any], n_slices: int, compress: str) -> int:
+    return sum(leaf_wire_bytes(lf, n_slices, compress) for lf in leaves)
+
+
+def stacked_spec(spec: P, axis: str) -> P:
+    """The PartitionSpec of a per-slice-stacked leaf: slot axis on the
+    reduction (dp) axis, original dims keep their param placement."""
+    return P(axis, *tuple(spec))
+
+
+def flatten_specs(params_like, specs_tree) -> list[P]:
+    """Flatten a PartitionSpec tree in the SAME order params flatten.
+
+    P is a tuple subclass, so a naive joint tree_map would descend into
+    the specs; flatten the spec tree with an explicit is_leaf instead
+    and check the leaf counts line up."""
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs_tree, is_leaf=lambda x: isinstance(x, P))[0]
+    n = len(jax.tree_util.tree_flatten(params_like)[0])
+    if len(spec_leaves) != n:
+        raise ValueError(
+            f"spec tree has {len(spec_leaves)} leaves for {n} params")
+    return spec_leaves
+
+
+class BucketReducer:
+    """The bucketed dp reduction over a FLATTENED stacked-grad list.
+
+    Built once per train-step trace from the param leaf shapes + specs;
+    `reduce` runs inside the jit. `reduce_bucket` exposes one bucket's
+    reduction alone for the attribution probes (tools/multislice_probe
+    times each bucket's collective against the wire-byte ledger)."""
+
+    def __init__(self, mesh: Mesh, leaves: Sequence[Any],
+                 spec_leaves: Sequence[P], cfg: DcnOverlapConfig,
+                 denom: float):
+        if len(leaves) != len(spec_leaves):
+            raise ValueError("leaves/specs length mismatch")
+        self.mesh = mesh
+        self.cfg = cfg
+        self.n_slices = mesh.shape[cfg.axis]
+        self.denom = float(denom)
+        self.buckets = partition_buckets(leaves, cfg.bucket_bytes)
+        self.spec_leaves = list(spec_leaves)
+        self.wire_bytes = wire_bytes(leaves, self.n_slices, cfg.compress)
+        self.bucket_wire_bytes = [
+            sum(leaf_wire_bytes(leaves[i], self.n_slices, cfg.compress)
+                for i in b)
+            for b in self.buckets]
+
+    # ---------- traced reduction ----------
+
+    def _constrain(self, x, spec: P):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec))
+
+    def _reduce_leaf_f32(self, stacked, spec: P):
+        # Sum over the slot axis with the output pinned to the param
+        # placement (no dp): GSPMD lowers the cross-slice sum to ONE
+        # dp all-reduce per leaf, and the mean denominator (including
+        # grad_accum) folds into the same fused multiply.
+        out = jnp.sum(stacked, axis=0) * (1.0 / self.denom)
+        return self._constrain(out, spec), None
+
+    def _reduce_leaf_int8(self, stacked, ef, spec: P):
+        axis = self.cfg.axis
+        # Error feedback: quantize (gradient + carried error), carry
+        # the fresh quantization error forward. All per-slot, local to
+        # each slice — no collective touches f32 gradient data.
+        c = stacked if ef is None else stacked + ef
+        q, scales = quantize_grads(c)
+        new_ef = c - dequantize_grads(q, scales)
+        new_ef = self._constrain(new_ef, stacked_spec(spec, axis))
+        # The WIRE: replicate the int8 payload (and its small f32
+        # scales) over dp — an all-gather of one-quarter the f32
+        # bytes. Pinning q's sharding BEFORE dequant guarantees the
+        # gathered tensor is the int8 one; XLA cannot hoist the f32
+        # dequant across it.
+        q = self._constrain(q, P(None, *tuple(spec)))
+        scales = self._constrain(scales, P())
+        # Local mean after the gather, denominator fused into the
+        # dequant scales (one multiply on the tiny scale tensor, not a
+        # second pass over the gradient).
+        out = jnp.sum(dequantize_grads(q, scales, scale=1.0 / self.denom),
+                      axis=0)
+        return self._constrain(out, spec), new_ef
+
+    def reduce_bucket(self, bucket_idx: int, stacked_leaves, ef_leaves):
+        """Reduce ONE bucket: returns ({leaf_idx: grad}, {leaf_idx: ef})."""
+        grads: dict[int, Any] = {}
+        efs: dict[int, Any] = {}
+        for i in self.buckets[bucket_idx]:
+            spec = self.spec_leaves[i]
+            if self.cfg.compress == "int8":
+                ef = None if ef_leaves is None else ef_leaves[i]
+                grads[i], efs[i] = self._reduce_leaf_int8(
+                    stacked_leaves[i], ef, spec)
+            else:
+                grads[i], _ = self._reduce_leaf_f32(
+                    stacked_leaves[i], spec)
+        return grads, efs
+
+    def reduce(self, stacked_leaves, ef_leaves=None):
+        """Reduce every bucket (reverse-layer issue order). Returns
+        (grad_leaves, new_ef_leaves_or_None) in flatten order."""
+        grads: list[Any] = [None] * len(self.spec_leaves)
+        new_ef: list[Any] = [None] * len(self.spec_leaves)
+        for b in range(len(self.buckets)):
+            g, e = self.reduce_bucket(b, stacked_leaves, ef_leaves)
+            for i, v in g.items():
+                grads[i] = v
+            for i, v in e.items():
+                new_ef[i] = v
+        if self.cfg.compress != "int8":
+            return grads, None
+        return grads, new_ef
+
+
+def make_bucket_reducer(mesh: Mesh, params_like, specs_tree,
+                        cfg: DcnOverlapConfig,
+                        denom: float | None = None) -> BucketReducer:
+    """Build the reducer from a param pytree (shape/dtype source) and
+    its PartitionSpec tree. `denom` defaults to the slice count (the
+    plain dp mean); pass n_slices * grad_accum to fold accumulation's
+    denominator into the same fused scale."""
+    leaves = jax.tree_util.tree_flatten(params_like)[0]
+    spec_leaves = flatten_specs(params_like, specs_tree)
+    n = mesh.shape[cfg.axis]
+    return BucketReducer(mesh, leaves, spec_leaves, cfg,
+                         denom=float(denom if denom is not None else n))
+
+
+def init_error_feedback(mesh: Mesh, params, specs_tree,
+                        cfg: DcnOverlapConfig):
+    """Eagerly build the per-slot error-feedback accumulator: zeros
+    shaped [n_slices, *leaf.shape] f32, sharded P(axis, *param_spec) —
+    one slot per dp slice, resident on that slice. Eager (not lazily
+    inside the step) because a carried leaf appearing mid-run would
+    change the step's input structure and force a steady-state
+    recompile — the exact failure the perf gate hard-fails on.
+
+    Returns None for compress='none': no accumulator, and TrainState
+    keeps its seed pytree structure (checkpoints unchanged)."""
+    if cfg.compress != "int8":
+        return None
+    n = mesh.shape[cfg.axis]
+    spec_leaves = flatten_specs(params, specs_tree)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shardings = [NamedSharding(mesh, stacked_spec(s, cfg.axis))
+                 for s in spec_leaves]
+
+    def _zeros():
+        return [jnp.zeros((n,) + tuple(lf.shape), jnp.float32)
+                for lf in leaves]
+
+    # tpulint: allow=TPL008(one-shot accumulator init at startup, not a step path)
+    ef_leaves = jax.jit(_zeros, out_shardings=shardings)()
+    return jax.tree_util.tree_unflatten(treedef, ef_leaves)
+
+
+def validate_mesh_for_overlap(mesh: Mesh, cfg: DcnOverlapConfig,
+                              sequence_parallel: bool = False) -> None:
+    """The overlap path reshapes the batch over the dp axis and vmaps
+    the per-slice gradient; composing that with pipeline/expert/
+    sequence parallelism is future work, and silently mis-sharding
+    would be worse than refusing."""
+    if cfg.axis not in mesh.shape:
+        raise ValueError(f"mesh has no {cfg.axis!r} axis: {dict(mesh.shape)}")
+    for ax in ("pp", "sp", "ep"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"dcn_overlap does not compose with {ax}>1 yet "
+                f"(mesh {dict(mesh.shape)})")
+    if sequence_parallel:
+        raise ValueError("dcn_overlap does not compose with "
+                         "sequence_parallel yet")
+
+
+# ---------- exposed-communication attribution ----------
+#
+# One XLA computation cannot be phase-timed from the host: the train
+# step's backward compute and its DCN reduction land in a single
+# executable whose internal schedule is invisible to time.perf_counter.
+# Attribution therefore comes from three NON-donating probe
+# executables over the same machinery:
+#
+#   compute  grads only — the reduction replaced by nothing (stacked
+#            per-slice grads stay unreduced)
+#   full     grads + the bucketed reduction
+#   bucket_i the reduction of bucket i ALONE, given precomputed
+#            stacked grads (its collective is the only DCN work)
+#
+# exposed = t(full) - t(compute) is the reduction time the step could
+# NOT hide behind compute; sum_i t(bucket_i) is the serial cost of the
+# reduction; overlap_fraction = 1 - exposed/serial in [0, 1]. busBW
+# charges the wire-byte ledger against the serial reduction time.
+# These probes are calibration-time one-shots (built and timed once
+# after warmup, never on the step path), so they are deliberately NOT
+# introspection.watch'ed and their timing fences are the measurement,
+# not a hot-loop hazard.
+
+
+class AttributionProbes:
+    def __init__(self, mesh: Mesh, stacked_fn, params, specs_tree,
+                 cfg: DcnOverlapConfig, denom: float):
+        self.reducer = make_bucket_reducer(mesh, params, specs_tree,
+                                           cfg, denom=denom)
+        self.treedef = jax.tree_util.tree_structure(params)
+        reducer = self.reducer
+
+        def _full(p, batch, ef_leaves):
+            loss, stacked = stacked_fn(p, batch)
+            grads, new_ef = reducer.reduce(stacked, ef_leaves)
+            return loss, grads
+
+        self.compute = jax.jit(stacked_fn)
+        self.full = jax.jit(_full)
+        self.bucket_fns = []
+        for b in range(len(reducer.buckets)):
+            def _bucket(stacked, ef_leaves, _b=b):
+                g, _ = reducer.reduce_bucket(_b, stacked, ef_leaves)
+                return [g[i] for i in sorted(g)]
+            self.bucket_fns.append(jax.jit(_bucket))
+
+    def _ef_leaves(self, ef):
+        if ef is None:
+            return None
+        return jax.tree_util.tree_flatten(ef)[0]
+
+    def calibrate(self, params, batch, ef=None, iters: int = 5) -> dict:
+        """Time the probes (median of `iters`, fenced — calibration IS
+        the measurement) and derive the attribution summary."""
+        ef_leaves = self._ef_leaves(ef)
+
+        def timed(fn, *args):
+            jax.block_until_ready(fn(*args))  # compile + warm
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            return times[len(times) // 2]
+
+        t_compute = timed(self.compute, params, batch)
+        t_full = timed(self.full, params, batch, ef_leaves)
+        _, stacked = jax.block_until_ready(self.compute(params, batch))
+        bucket_s = [timed(fn, stacked, ef_leaves)
+                    for fn in self.bucket_fns]
+        t_reduce = sum(bucket_s)
+        exposed = max(t_full - t_compute, 0.0)
+        if t_reduce > 0:
+            overlap_fraction = min(max(1.0 - exposed / t_reduce, 0.0), 1.0)
+            busbw = self.reducer.wire_bytes / t_reduce
+        else:
+            overlap_fraction, busbw = 1.0, 0.0
+        return {
+            "overlap_fraction": round(overlap_fraction, 4),
+            "exposed_s_per_step": exposed,
+            "reduce_s_per_step": t_reduce,
+            "compute_s_per_step": t_compute,
+            "full_s_per_step": t_full,
+            "bucket_ms": [round(s * 1e3, 4) for s in bucket_s],
+            "busbw_bytes_per_second": busbw,
+            **summarize(self.reducer),
+        }
+
+
+def summarize(reducer: BucketReducer) -> dict:
+    """JSON-able description for bench/trace artifacts."""
+    return {
+        "n_buckets": len(reducer.buckets),
+        "bucket_bytes_target": reducer.cfg.bucket_bytes,
+        "compress": reducer.cfg.compress,
+        "axis": reducer.cfg.axis,
+        "n_slices": reducer.n_slices,
+        "wire_bytes_per_step": reducer.wire_bytes,
+        "bucket_wire_bytes": list(reducer.bucket_wire_bytes),
+        "bucket_sizes": [len(b) for b in reducer.buckets],
+    }

@@ -154,8 +154,14 @@ class CheckpointManager:
                     "serialize callers, don't race the atomic commit")
             CheckpointManager._inflight[self._dir] = id(self)
         try:
+            state_tree = state._asdict()
+            # dcn_ef is resident comm state (TrainState docstring): fit
+            # strips it before saving, and the dropped key keeps the
+            # on-disk tree identical to pre-overlap checkpoints.
+            if state_tree.get("dcn_ef") is None:
+                state_tree.pop("dcn_ef", None)
             items = {
-                "state": ocp.args.StandardSave(state._asdict()),
+                "state": ocp.args.StandardSave(state_tree),
                 "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
                 "topology": ocp.args.JsonSave(
                     topology if topology is not None
@@ -239,6 +245,11 @@ class CheckpointManager:
         if topology is None:
             topology = current_topology()
         abstract = jax.tree.map(to_abstract, state_like._asdict())
+        # Mirror of save()'s dcn_ef drop: the on-disk tree never has the
+        # key when the accumulator is None, and TrainState(**tree) below
+        # defaults the field back in.
+        if abstract.get("dcn_ef") is None:
+            abstract.pop("dcn_ef", None)
         if step is not None:
             candidates = [step]
         else:

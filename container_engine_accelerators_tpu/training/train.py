@@ -34,6 +34,15 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    # Error-feedback accumulator for int8-compressed DCN gradient
+    # reduction (parallel/grad_comm.py): a params-shaped pytree of
+    # [n_slices, *leaf.shape] f32 slots sharded over dp, carrying each
+    # slice's quantization error into the next step. None (an empty
+    # pytree subtree) whenever dcn_overlap compression is off, so the
+    # seed state structure — and every existing checkpoint — is
+    # unchanged; fit() additionally strips it from saves (the EF is
+    # resident comm state, reset on resume, never a reshard concern).
+    dcn_ef: Any = None
 
 
 def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
@@ -74,7 +83,8 @@ def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
 
 
 def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
-                       optimizer: optax.GradientTransformation) -> TrainState:
+                       optimizer: optax.GradientTransformation,
+                       dcn_overlap=None) -> TrainState:
     """Params initialised directly into their NamedSharding (no host-side
     full copy); optimizer state inherits placement from the sharded params."""
     pipeline = bool(cfg.pipeline_microbatches) and mesh.shape.get("pp", 1) > 1
@@ -118,7 +128,19 @@ def create_train_state(key: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
 
     opt_state = jax.tree.map(span_mesh, opt_state)
     step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
-    return TrainState(step=step, params=params, opt_state=opt_state)
+    # Error feedback for compressed DCN reduction is allocated HERE,
+    # eagerly: a carried leaf materializing lazily inside the step
+    # would change the jit's input structure mid-run — a steady-state
+    # recompile the perf gate hard-fails.
+    dcn_ef = None
+    if dcn_overlap is not None and dcn_overlap.compress == "int8":
+        from container_engine_accelerators_tpu.parallel import grad_comm
+        dcn_ef = grad_comm.init_error_feedback(
+            mesh, params,
+            shd.llama_param_specs(pipeline=False, moe=bool(cfg.n_experts)),
+            dcn_overlap)
+    return TrainState(step=step, params=params, opt_state=opt_state,
+                      dcn_ef=dcn_ef)
 
 
 def loss_fn(params, batch, cfg: llama.LlamaConfig, constrain, mesh):
@@ -140,14 +162,25 @@ def loss_fn(params, batch, cfg: llama.LlamaConfig, constrain, mesh):
 
 def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
                     optimizer: optax.GradientTransformation,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1, dcn_overlap=None):
     """Returns jitted `step(state, batch) -> (state, metrics)`.
 
     `grad_accum > 1` splits the batch's leading dim into that many
     microbatches and averages their gradients under one `lax.scan` before
     a single optimizer update — the standard trick for global batch sizes
     whose activations exceed HBM (equal-sized microbatches make it
-    numerically the full-batch gradient)."""
+    numerically the full-batch gradient).
+
+    `dcn_overlap` (a parallel.grad_comm.DcnOverlapConfig) switches to
+    the bucketed cross-slice gradient reduction: per-slice gradients
+    computed explicitly, reduced bucket-by-bucket so XLA can overlap
+    each bucket's DCN collective with the remaining backward compute,
+    optionally int8-compressed on the wire with error feedback carried
+    in `state.dcn_ef`. `None` (the default) is the seed single-psum
+    path, byte-for-byte — the branch below is untouched."""
+    if dcn_overlap is not None:
+        return _make_overlap_step(cfg, mesh, optimizer, grad_accum,
+                                  dcn_overlap)
     sp = cfg.sequence_parallel
     constrain = shd.make_constrain(mesh, sequence_parallel=sp)
     grad_fn = jax.value_and_grad(loss_fn)
@@ -192,6 +225,163 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
         watch,
     )
     return watch(jax.jit(step, donate_argnums=(0,)), "train_step")
+
+
+def _make_overlap_grads(cfg: llama.LlamaConfig, mesh: Mesh, dcn,
+                        grad_accum: int = 1):
+    """stacked_fn(params, batch) -> (loss, stacked_grad_leaves) — the
+    gradient producer of the DCN-overlap path (parallel/grad_comm.py).
+
+    The batch's leading dim is reshaped to [n_slices, B/n_slices] (one
+    row per dp slice; [grad_accum, n_slices, mb] when accumulating) and
+    the gradient is taken PER SLICE under `vmap`, with the stacked
+    result pinned to P('dp', *param_spec): no implicit GSPMD dp mean
+    ever forms, so the bucketed reducer owns the cross-slice reduction
+    entirely. Inside the vmap the model runs mesh-agnostic (identity
+    constrain, mesh=None) — exact because validate_mesh_for_overlap
+    pins pp == sp == ep == 1 and no sequence parallelism, leaving
+    dp/fsdp/tp placement to GSPMD propagation from the pinned inputs
+    and outputs. Stacked leaves come back FLATTENED (the reducer's
+    currency), SUMMED over microbatches: the 1/(n_slices * grad_accum)
+    mean denominator is the reducer's to fuse (into the int8 dequant
+    scales — the satellite's "no extra tree_map pass")."""
+    from container_engine_accelerators_tpu.parallel import grad_comm
+
+    n_slices = mesh.shape[dcn.axis]
+    specs = shd.llama_param_specs(pipeline=False, moe=bool(cfg.n_experts))
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def slice_constrain(x, kind):
+        # Inside the per-slice vmap only the UNMAPPED embed table keeps
+        # its activation hint — the gather-safe reshard (parallel/
+        # sharding.py): without it the tp+fsdp-sharded table against
+        # dp/fsdp-sharded token indices forces the SPMD full-remat
+        # fallback. Batch-dim hints are skipped: their dp placement is
+        # carried by the stacked slot axis, which doesn't exist on the
+        # per-slice view the hint would annotate.
+        if kind == "embed_table":
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, shd._ACTIVATION_SPECS[kind](False)))
+        return x
+
+    def per_slice(params, sbatch):
+        return grad_fn(params, sbatch, cfg, slice_constrain, None)
+
+    def stacked_fn(params, batch):
+        spec_leaves = grad_comm.flatten_specs(params, specs)
+
+        def pin_stacked(leaves):
+            return [jax.lax.with_sharding_constraint(
+                        g, NamedSharding(
+                            mesh, grad_comm.stacked_spec(s, dcn.axis)))
+                    for g, s in zip(leaves, spec_leaves)]
+
+        def split(x):
+            b = x.shape[0]
+            if b % (grad_accum * n_slices):
+                raise ValueError(
+                    f"batch dim {b} not divisible by grad_accum * "
+                    f"n_slices = {grad_accum} * {n_slices}")
+            lead = ((grad_accum, n_slices) if grad_accum > 1
+                    else (n_slices,))
+            x = x.reshape(*lead, b // (grad_accum * n_slices),
+                          *x.shape[1:])
+            # Slot axis on dp, per-slice batch dim on fsdp: every
+            # slice's sub-batch stays resident on that slice, so the
+            # vmapped grad is collective-free over dp.
+            spec = P(*([None] * (len(lead) - 1)), dcn.axis, "fsdp",
+                     *([None] * (x.ndim - len(lead) - 1)))
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+
+        sliced = jax.tree.map(split, batch)
+        if grad_accum > 1:
+            def accum(carry, mb):
+                loss_sum, g_sum = carry
+                loss, grads = jax.vmap(per_slice, in_axes=(None, 0))(
+                    params, mb)
+                g_leaves = pin_stacked(
+                    jax.tree_util.tree_flatten(grads)[0])
+                return (loss_sum + jnp.mean(loss),
+                        [a + g for a, g in zip(g_sum, g_leaves)]), None
+
+            zeros = pin_stacked(
+                [jnp.zeros((n_slices,) + p.shape, p.dtype)
+                 for p in jax.tree_util.tree_flatten(params)[0]])
+            (loss, stacked), _ = jax.lax.scan(
+                accum, (jnp.zeros(()), zeros), sliced)
+            loss = loss / grad_accum
+        else:
+            loss, grads = jax.vmap(per_slice, in_axes=(None, 0))(
+                params, sliced)
+            loss = jnp.mean(loss)
+            stacked = pin_stacked(jax.tree_util.tree_flatten(grads)[0])
+        return loss, stacked
+
+    return stacked_fn
+
+
+def _make_overlap_step(cfg: llama.LlamaConfig, mesh: Mesh,
+                       optimizer: optax.GradientTransformation,
+                       grad_accum: int, dcn):
+    """The `dcn_overlap` branch of make_train_step: explicit per-slice
+    grads + bucketed dp reduction (parallel/grad_comm.BucketReducer) in
+    ONE jit, so XLA's latency-hiding scheduler can float each bucket's
+    DCN collective behind the remaining backward compute. Kept separate
+    from the baseline closure so the single-psum path stays
+    byte-identical when the feature is off."""
+    from container_engine_accelerators_tpu.parallel import grad_comm
+
+    grad_comm.validate_mesh_for_overlap(
+        mesh, dcn, sequence_parallel=bool(cfg.sequence_parallel))
+    stacked_fn = _make_overlap_grads(cfg, mesh, dcn, grad_accum)
+    specs = shd.llama_param_specs(pipeline=False, moe=bool(cfg.n_experts))
+    denom = mesh.shape[dcn.axis] * grad_accum
+
+    def step(state: TrainState, batch):
+        reducer = grad_comm.make_bucket_reducer(
+            mesh, state.params, specs, dcn, denom=denom)
+        loss, stacked = stacked_fn(state.params, batch)
+        treedef = jax.tree_util.tree_structure(state.params)
+        ef_leaves = (None if state.dcn_ef is None else
+                     jax.tree_util.tree_flatten(state.dcn_ef)[0])
+        grad_leaves, new_ef_leaves = reducer.reduce(stacked, ef_leaves)
+        grads = jax.tree_util.tree_unflatten(treedef, grad_leaves)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = grad_norm_metric(new_opt, grads)
+        new_ef = (None if new_ef_leaves is None else
+                  jax.tree_util.tree_unflatten(treedef, new_ef_leaves))
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "tokens": jnp.sum(
+                       (batch["targets"] >= 0).astype(jnp.int32))}
+        return TrainState(state.step + 1, new_params, new_opt,
+                          new_ef), metrics
+
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+    return watch(jax.jit(step, donate_argnums=(0,)), "train_step")
+
+
+def make_dcn_probes(cfg: llama.LlamaConfig, mesh: Mesh, dcn, params,
+                    grad_accum: int = 1):
+    """Attribution probes over the SAME stacked-grad + bucket machinery
+    the overlap step runs (parallel/grad_comm.AttributionProbes):
+    calibrate() times compute-only / full / per-bucket executables to
+    split wall-clock into compute vs exposed DCN and derive the overlap
+    fraction and DCN busBW. One-shot calibration, never on the step
+    path."""
+    from container_engine_accelerators_tpu.parallel import grad_comm
+
+    grad_comm.validate_mesh_for_overlap(
+        mesh, dcn, sequence_parallel=bool(cfg.sequence_parallel))
+    stacked_fn = _make_overlap_grads(cfg, mesh, dcn, grad_accum)
+    specs = shd.llama_param_specs(pipeline=False, moe=bool(cfg.n_experts))
+    return grad_comm.AttributionProbes(
+        mesh, stacked_fn, params, specs, dcn,
+        denom=mesh.shape[dcn.axis] * grad_accum)
 
 
 def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
@@ -278,7 +468,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         log_fn=print, recorder=None, metrics_port: int | None = None,
         metrics_host: str = "", metrics_log: str | None = None,
         heartbeat_dir: str | None = None,
-        watchdog_threshold_s: float = 300.0):
+        watchdog_threshold_s: float = 300.0,
+        dcn_overlap=None):
     """Train with checkpoint/auto-resume — the elastic-recovery loop
     (SURVEY.md §5: the reference's recovery is node-level repair; the
     workload-level half is resuming from the latest checkpoint after a
@@ -318,6 +509,16 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     own shards; rank 0 commits — CheckpointManager docstring), and the
     recorded topology tag makes a later resume into a REDUCED topology
     a first-class reshard, attributed to the `reshard` badput bucket.
+
+    `dcn_overlap` (parallel.grad_comm.DcnOverlapConfig) turns on the
+    bucketed/compressed cross-slice gradient reduction — see
+    make_train_step. fit additionally (a) strips the error-feedback
+    accumulator from every checkpoint save/restore (EF is resident comm
+    state, reset to zeros on resume; the on-disk format stays the seed
+    format), and (b) runs a one-shot attribution calibration after the
+    first step — on EVERY rank, since its probes contain collectives —
+    reporting overlap fraction and DCN busBW to the recorder and the
+    flight recorder.
     """
     import jax.random as jrandom
 
@@ -367,7 +568,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                f"{jax.process_count()}, mesh {dict(mesh.shape)} "
                f"({mesh.devices.size} devices)")
     key = key if key is not None else jrandom.key(0)
-    state = create_train_state(key, cfg, mesh, optimizer)
+    state = create_train_state(key, cfg, mesh, optimizer,
+                               dcn_overlap=dcn_overlap)
     mngr = None
     layout = state_layer_layout(cfg, mesh)
     # The topology tag this run saves under and restores against: a
@@ -377,9 +579,13 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     if ckpt_dir:
         mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
         t0 = time.perf_counter()
-        restored = mngr.restore(state, layout=layout, topology=topology)
+        restored = mngr.restore(state._replace(dcn_ef=None),
+                                layout=layout, topology=topology)
         if restored is not None:
-            state = restored
+            # Reattach the eagerly-built zero EF: the accumulator is
+            # never checkpointed (TrainState docstring), so a resume
+            # restarts error feedback cleanly at zero.
+            state = restored._replace(dcn_ef=state.dcn_ef)
             resumed_step = int(jax.device_get(state.step))
             info = mngr.last_restore_info or {}
             if rec is not None:
@@ -395,7 +601,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                {"step": resumed_step})
             log_fn(f"resumed from step {resumed_step}")
 
-    step_fn = make_train_step(cfg, mesh, optimizer)
+    step_fn = make_train_step(cfg, mesh, optimizer,
+                              dcn_overlap=dcn_overlap)
     sp = cfg.sequence_parallel
     start_step = int(jax.device_get(state.step))
     metrics = None
@@ -451,8 +658,10 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                 if mngr is not None:
                     with annotate("train/ckpt_save"):
                         ts = time.perf_counter()
-                        saved = mngr.save(cur, state, layout=layout,
-                                          cfg=cfg, topology=topology)
+                        saved = mngr.save(cur,
+                                          state._replace(dcn_ef=None),
+                                          layout=layout, cfg=cfg,
+                                          topology=topology)
                         save_dt = time.perf_counter() - ts
                 loss = None
                 if log_every and i % log_every == 0:
@@ -469,12 +678,39 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                     first=(i == 0))
                     if saved:
                         rec.record_checkpoint_save(save_dt)
+                if (i == 0 and dcn_overlap is not None
+                        and mesh.shape.get(dcn_overlap.axis, 1) > 1):
+                    # One-shot exposed-comm attribution after the first
+                    # (compiling) step. Runs on every rank UNCONDITIONALLY
+                    # of `rec` — the probes contain dp collectives, and a
+                    # rank skipping them deadlocks the others.
+                    with annotate("train/dcn_calibrate"):
+                        try:
+                            probes = make_dcn_probes(cfg, mesh,
+                                                     dcn_overlap,
+                                                     state.params)
+                            attr = probes.calibrate(state.params, batch,
+                                                    ef=state.dcn_ef)
+                            log_fn(
+                                "dcn overlap: "
+                                f"{attr['overlap_fraction']:.0%} "
+                                f"overlapped, {attr['n_buckets']} "
+                                "buckets, busBW "
+                                f"{attr['busbw_bytes_per_second']/1e9:.2f}"
+                                " GB/s")
+                            if rec is not None:
+                                rec.record_dcn_attribution(attr)
+                        except Exception as e:
+                            # Advisory: a failed calibration must not
+                            # kill the run it is measuring.
+                            log_fn("dcn attribution calibration "
+                                   f"failed: {e}")
                 i += 1
         if mngr is not None:
             if mngr.latest_step() != cur:
                 ts = time.perf_counter()
-                mngr.save(cur, state, force=True, layout=layout, cfg=cfg,
-                          topology=topology)
+                mngr.save(cur, state._replace(dcn_ef=None), force=True,
+                          layout=layout, cfg=cfg, topology=topology)
                 if rec is not None:
                     rec.record_checkpoint_save(time.perf_counter() - ts)
             mngr.wait()

@@ -135,14 +135,17 @@ def test_http_server_serves_metrics(tmp_path):
 
 def test_collective_busbw_probe_hook_rate_limited(tmp_path):
     """Opt-in background collective probe (ISSUE 4 satellite): results
-    land on fabric_collective_busbw_bytes_per_second{collective,axis},
-    the hook runs at most once per interval, and a failing hook never
-    kills the poll loop."""
+    land on fabric_collective_busbw_bytes_per_second{collective,axis,
+    fabric}, the hook runs at most once per interval, and a failing
+    hook never kills the poll loop. 4-tuple rows carry the fabric
+    ('ici'/'dcn'); legacy 3-tuple rows default to 'ici'."""
     calls = []
 
     def hook():
         calls.append(1)
-        return [("all_reduce", "tp", 1.5e9), ("all_gather", "tp", 2.5e9)]
+        return [("all_reduce", "tp", "ici", 1.5e9),
+                ("all_reduce", "dp", "dcn", 0.1e9),
+                ("all_gather", "tp", 2.5e9)]   # legacy 3-tuple
 
     srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
                              sysfs_accel=str(tmp_path / "accel"),
@@ -152,9 +155,11 @@ def test_collective_busbw_probe_hook_rate_limited(tmp_path):
     assert calls == [1]
     text = scrape(srv)
     assert ('fabric_collective_busbw_bytes_per_second{axis="tp",'
-            'collective="all_reduce"} 1.5e+09') in text
+            'collective="all_reduce",fabric="ici"} 1.5e+09') in text
+    assert ('fabric_collective_busbw_bytes_per_second{axis="dp",'
+            'collective="all_reduce",fabric="dcn"} 1e+08') in text
     assert ('fabric_collective_busbw_bytes_per_second{axis="tp",'
-            'collective="all_gather"} 2.5e+09') in text
+            'collective="all_gather",fabric="ici"} 2.5e+09') in text
 
     srv.poll_once(now=300.0)   # inside the interval: rate-limited
     assert calls == [1]

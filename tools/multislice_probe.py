@@ -13,6 +13,15 @@ rank 0 prints one JSON line:
 
 tools/perf_gate.py spawns both ranks and scores the median-of-k as
 `multislice_step_ms`. Deterministic: fixed seeds, per-step fence.
+
+With --overlap the step switches to the bucketed DCN-overlapped
+gradient reduction (parallel/grad_comm.py; --compress int8 adds
+error-feedback gradient compression on the dp wire) and the JSON
+grows an "overlap" block — overlap_fraction, per-bucket psum
+milliseconds, wire bytes, busBW — from a one-shot calibration run
+on BOTH ranks (the probes contain dp collectives; a rank that
+skipped them would deadlock its peer). The gate scores this mode as
+`multislice_overlap_step_ms`.
 """
 
 from __future__ import annotations
@@ -34,7 +43,20 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--overlap", action="store_true",
+                    help="bucketed overlapped dp gradient reduction "
+                         "instead of the single-psum step")
+    ap.add_argument("--compress", choices=("none", "int8"),
+                    default="none",
+                    help="int8 wire compression with error feedback "
+                         "(needs --overlap)")
+    ap.add_argument("--bucket-mb", type=float, default=0.0625,
+                    help="gradient bucket target in MiB; the default "
+                         "keeps llama_tiny at several buckets so "
+                         "overlap is actually exercised")
     args = ap.parse_args(argv)
+    if args.compress != "none" and not args.overlap:
+        ap.error("--compress requires --overlap")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["XLA_FLAGS"] = ""
@@ -72,8 +94,17 @@ def main(argv=None) -> int:
                      dcn_slices=2)
     cfg = llama_tiny(vocab_size=64)
     opt = make_optimizer(warmup_steps=2, decay_steps=100)
-    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
-    step_fn = make_train_step(cfg, mesh, opt)
+    dcn = None
+    if args.overlap:
+        from container_engine_accelerators_tpu.parallel import (
+            DcnOverlapConfig,
+        )
+        dcn = DcnOverlapConfig(
+            bucket_bytes=max(int(args.bucket_mb * (1 << 20)), 1),
+            compress=args.compress)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt,
+                               dcn_overlap=dcn)
+    step_fn = make_train_step(cfg, mesh, opt, dcn_overlap=dcn)
     batch = shard_batch(
         next(iter(synthetic_batches(cfg.vocab_size, args.batch_size,
                                     args.seq_len, num_batches=1))),
@@ -82,6 +113,27 @@ def main(argv=None) -> int:
     for _ in range(3):  # warmup: all compiles land here
         box[0], metrics = step_fn(box[0], batch)
         float(jax.device_get(metrics["loss"]))
+
+    overlap_attr = None
+    if dcn is not None:
+        # Calibrate BEFORE the measured window: the probe jits compile
+        # here, and every rank must participate (dp collectives).
+        from container_engine_accelerators_tpu.training.train import (
+            make_dcn_probes,
+        )
+        probes = make_dcn_probes(cfg, mesh, dcn, box[0].params)
+        attr = probes.calibrate(box[0].params, batch, ef=box[0].dcn_ef)
+        overlap_attr = {
+            "overlap_fraction": round(attr["overlap_fraction"], 4),
+            "exposed_ms_per_step": round(
+                attr["exposed_s_per_step"] * 1e3, 4),
+            "bucket_ms": [round(t, 4) for t in attr["bucket_ms"]],
+            "n_buckets": attr["n_buckets"],
+            "compress": attr["compress"],
+            "wire_bytes_per_step": attr["wire_bytes_per_step"],
+            "busbw_bytes_per_second": round(
+                attr["busbw_bytes_per_second"], 1),
+        }
 
     from container_engine_accelerators_tpu import bench_harness as harness
 
@@ -102,9 +154,12 @@ def main(argv=None) -> int:
         samples_ms.append(round(harness.median(times) * 1e3, 4))
         pcts = rec.pct_ms("step")
     if jax.process_index() == 0:
-        print(json.dumps({"kind": "multislice_probe",
-                          "samples_ms": samples_ms,
-                          "percentiles": pcts}), flush=True)
+        out = {"kind": "multislice_probe",
+               "samples_ms": samples_ms,
+               "percentiles": pcts}
+        if overlap_attr is not None:
+            out["overlap"] = overlap_attr
+        print(json.dumps(out), flush=True)
     return 0
 
 

@@ -116,6 +116,12 @@ INJECT_RECOMPILE_ENV = "PERF_GATE_INJECT_RECOMPILE"
 # force it either way.
 MULTISLICE_ENV = "PERF_GATE_MULTISLICE"
 MULTISLICE_METRIC = "multislice_step_ms"
+# Same 2-process probe with --overlap --compress int8 (PR 13): the
+# bucketed DCN-overlapped reduction gated as its own metric so a
+# regression in the overlap path can't hide behind a healthy
+# single-psum number (and vice versa).
+MULTISLICE_OVERLAP_METRIC = "multislice_overlap_step_ms"
+MULTISLICE_METRICS = (MULTISLICE_METRIC, MULTISLICE_OVERLAP_METRIC)
 MULTISLICE_TIMEOUT_ENV = "PERF_GATE_MULTISLICE_TIMEOUT_S"
 
 EXIT_OK = 0
@@ -567,10 +573,14 @@ def _multislice_env_enabled(default: bool) -> bool:
     return default
 
 
-def run_multislice_probe(k: int, steps: int) -> dict | None:
+def run_multislice_probe(k: int, steps: int,
+                         extra_args: tuple = ()) -> dict | None:
     """Spawn the 2-process jax.distributed probe
     (tools/multislice_probe.py); rank 0 reports k per-pass p50
-    samples of the dp-over-gloo train step. Returns
+    samples of the dp-over-gloo train step. `extra_args` forwards
+    probe flags — ("--overlap", "--compress", "int8") runs the
+    DCN-overlap step and the result gains an "overlap" attribution
+    block. Returns
     {"samples": [...ms], "percentiles": {...}} or None when the probe
     could not run (spawn failure / timeout / bad output) — the caller
     treats that as a missing metric, which the gate surfaces as a loud
@@ -578,15 +588,16 @@ def run_multislice_probe(k: int, steps: int) -> dict | None:
     bind-and-release, so another process can claim it in the gap; one
     retry on a fresh port absorbs that rare collision instead of
     degrading the metric to no_signal."""
-    result = _multislice_probe_once(k, steps)
+    result = _multislice_probe_once(k, steps, extra_args)
     if result is None:
         print("perf-gate: retrying multislice probe once on a fresh "
               "port", file=sys.stderr)
-        result = _multislice_probe_once(k, steps)
+        result = _multislice_probe_once(k, steps, extra_args)
     return result
 
 
-def _multislice_probe_once(k: int, steps: int) -> dict | None:
+def _multislice_probe_once(k: int, steps: int,
+                           extra_args: tuple = ()) -> dict | None:
     import socket
     import subprocess
 
@@ -606,7 +617,8 @@ def _multislice_probe_once(k: int, steps: int) -> dict | None:
             procs.append(subprocess.Popen(
                 [sys.executable,
                  os.path.join(REPO, "tools", "multislice_probe.py"),
-                 "--k", str(k), "--steps", str(steps)],
+                 "--k", str(k), "--steps", str(steps),
+                 *extra_args],
                 cwd=REPO, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True))
         for p in procs:
@@ -631,8 +643,11 @@ def _multislice_probe_once(k: int, steps: int) -> dict | None:
             except ValueError:
                 continue
             if rec.get("kind") == "multislice_probe":
-                return {"samples": rec["samples_ms"],
-                        "percentiles": rec.get("percentiles", {})}
+                out = {"samples": rec["samples_ms"],
+                       "percentiles": rec.get("percentiles", {})}
+                if "overlap" in rec:
+                    out["overlap"] = rec["overlap"]
+                return out
     print("perf-gate: multislice probe produced no result line",
           file=sys.stderr)
     return None
@@ -692,18 +707,33 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
     if multislice_on:
         # Outside the RecompileGuard window: the probe's compiles
         # happen in its own processes, invisible to this tracker.
-        ms = run_multislice_probe(k, steps)
-        if ms is not None:
+        # Two modes, gated as separate metrics: the seed single-psum
+        # step, and the bucketed DCN-overlap step with int8 gradient
+        # compression (PR 13) whose calibration attribution rides
+        # along in the report.
+        probe_modes = (
+            (MULTISLICE_METRIC, "multislice_step", ()),
+            (MULTISLICE_OVERLAP_METRIC, "multislice_overlap_step",
+             ("--overlap", "--compress", "int8")),
+        )
+        for metric_name, pct_key, extra in probe_modes:
+            ms = run_multislice_probe(k, steps, extra_args=extra)
+            if ms is None:
+                continue
             value = round(harness.median(ms["samples"]), 4)
-            metrics[MULTISLICE_METRIC] = {
+            metrics[metric_name] = {
                 "samples": ms["samples"], "unit": "ms",
                 "percentiles": ms["percentiles"]}
+            extra_kw = {}
+            if "overlap" in ms:
+                metrics[metric_name]["overlap"] = ms["overlap"]
+                extra_kw["overlap"] = ms["overlap"]
             results.append(harness.check_result(harness.make_result(
-                MULTISLICE_METRIC, value, "ms",
-                percentiles={"multislice_step": ms["percentiles"]},
+                metric_name, value, "ms",
+                percentiles={pct_key: ms["percentiles"]},
                 backend_probe=probe, status="ok",
                 samples_ms=ms["samples"], k=k, steps_per_pass=steps,
-                tier="cpu-hermetic")))
+                tier="cpu-hermetic", **extra_kw)))
     return {"metrics": metrics, "results": results,
             "backend_probe": probe, "recompiles": recompiles,
             "k": k, "steps": steps, "multislice": multislice_on,
@@ -762,16 +792,19 @@ def gate_check(tier: dict, baseline_path: str,
         verdict = "no_signal:platform_mismatch"
     else:
         baseline_metrics = baseline["metrics"]
-        if not tier.get("multislice") and MULTISLICE_METRIC in \
-                baseline_metrics:
-            # The tier deliberately skipped the 2-process probe
+        if not tier.get("multislice"):
+            # The tier deliberately skipped the 2-process probes
             # (library call / PERF_GATE_MULTISLICE=0): not measuring
-            # it is a choice here, not lost coverage — drop the
-            # baseline row instead of scoring a missing metric.
-            print(f"perf-gate: {MULTISLICE_METRIC} skipped this run "
-                  f"({MULTISLICE_ENV} off); not gated", file=sys.stderr)
+            # them is a choice here, not lost coverage — drop the
+            # baseline rows instead of scoring missing metrics.
+            skipped = [m for m in MULTISLICE_METRICS
+                       if m in baseline_metrics]
+            for m in skipped:
+                print(f"perf-gate: {m} skipped this run "
+                      f"({MULTISLICE_ENV} off); not gated",
+                      file=sys.stderr)
             baseline_metrics = {k: v for k, v in baseline_metrics.items()
-                                if k != MULTISLICE_METRIC}
+                                if k not in MULTISLICE_METRICS}
         verdict, rows = compare(baseline_metrics, current, band_scale)
 
     report = {
