@@ -197,10 +197,24 @@ dcn-overlap-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_dcn_overlap.py \
 	    -q -m "slow or not slow"
 
+# Elastic scale-UP + async checkpointing smoke (ISSUE 14): scan_returned
+# / scale-up planning units, resume-state staleness discard, async save
+# donation-safety + torn-tail SIGKILL + leaked-tmp-sweep units,
+# straggler exemption for in-flight saves, and the 2-process scale-up
+# e2e (survivor re-execs back into the LARGER topology and matches the
+# single-process loss trajectory). The full lose->regain->lose
+# preemption schedule with its goodput floor runs as the
+# preemption-schedule scenario inside `make chaos`.
+preemption-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_checkpoint.py \
+	    tests/test_multiprocess.py::test_two_process_elastic_scale_up \
+	    -q -m "slow or not slow"
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
-    serve-pools-smoke multislice-smoke dcn-overlap-smoke chaos-smoke
+    serve-pools-smoke multislice-smoke dcn-overlap-smoke \
+    preemption-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -215,4 +229,4 @@ clean:
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
-    dcn-overlap-smoke smoke dryrun clean
+    dcn-overlap-smoke preemption-smoke smoke dryrun clean

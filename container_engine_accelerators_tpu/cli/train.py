@@ -70,6 +70,12 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--ckpt-async", action="store_true",
+                   help="asynchronous checkpoint saves: the step loop "
+                        "pays only a host-buffer snapshot (charged to "
+                        "the near-zero ckpt_async badput bucket); "
+                        "serialize + rank-0 commit run on a background "
+                        "thread overlapping the next steps")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve training metrics on this port; 0 binds "
@@ -175,7 +181,22 @@ def main(argv=None) -> int:
     )
     from container_engine_accelerators_tpu.training.train import fit
 
+    announce_stop = None
+    if (args.elastic and args.heartbeat_dir
+            and os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        # Scale-up rejoin half 1 (training/elastic.py): a RETURNING
+        # rank blocks inside initialize_from_env until every peer
+        # dials the coordinator — and the shrunk survivors only
+        # re-exec back into the full topology once they SEE this rank
+        # heartbeating. The heartbeat must therefore start ticking
+        # BEFORE the blocking call; the TrainRecorder takes over the
+        # same file afterwards.
+        announce_stop = elastic.announce_heartbeat(
+            args.heartbeat_dir, dist.infer_process_id() or 0)
+
     multiproc = dist.initialize_from_env()
+    if announce_stop is not None:
+        announce_stop()
     import jax
 
     cfg = build_config(args.preset, args.vocab_size)
@@ -183,8 +204,8 @@ def main(argv=None) -> int:
     slices = args.dcn_slices if args.dcn_slices else dist.num_slices()
     if int(os.environ.get(elastic.RESTARTS_ENV, "0")) > 0:
         # Elastic re-exec: the replayed argv may carry --dcn-slices /
-        # --batch-size sized for the PRE-loss topology; the reduced
-        # env the monitor wrote is authoritative.
+        # --batch-size sized for the PRE-restart topology; the env the
+        # monitor wrote (shrunk or regrown) is authoritative.
         slices, args.batch_size, notes = elastic.reconcile_resume_topology(
             args.dcn_slices, dist.num_slices(), args.batch_size)
         for note in notes:
@@ -249,7 +270,15 @@ def main(argv=None) -> int:
     if args.elastic:
         if not args.heartbeat_dir:
             raise SystemExit("--elastic requires --heartbeat-dir")
-        if jax.process_count() > 1:
+        # A single-process cohort still needs the monitor when it is a
+        # SHRUNK survivor (TPU_ELASTIC_ORIG_* recorded by the first
+        # shrink): there are no peers to lose, but the monitor's
+        # scan_returned watches for the lost capacity heartbeating
+        # again and re-execs back into the full original topology.
+        orig = elastic.original_topology(os.environ)
+        watch_scale_up = (orig is not None
+                          and orig[0] > jax.process_count())
+        if jax.process_count() > 1 or watch_scale_up:
             dump_dir = None
             if args.trace_dump:
                 dump_dir = (args.trace_dump
@@ -258,7 +287,13 @@ def main(argv=None) -> int:
                                 os.path.abspath(args.trace_dump)))
             monitor = elastic.SliceLossMonitor(
                 args.heartbeat_dir,
-                process_id=jax.process_index(),
+                # The identity the heartbeat/resume files key on: the
+                # dense rank in a re-formed distributed world, but a
+                # single survivor KEEPS its original rank
+                # (plan_restart_env), where process_index() is 0.
+                process_id=(jax.process_index()
+                            if jax.process_count() > 1
+                            else dist.infer_process_id() or 0),
                 num_processes=jax.process_count(),
                 num_slices=slices,
                 threshold_s=args.elastic_threshold,
@@ -266,10 +301,14 @@ def main(argv=None) -> int:
                 restart_argv=[
                     "-m", "container_engine_accelerators_tpu.cli.train",
                 ] + list(argv if argv is not None else sys.argv[1:]),
-                dump_dir=dump_dir)
+                dump_dir=dump_dir,
+                orig_num_processes=orig[0] if orig else None,
+                orig_num_slices=orig[1] if orig else None)
             monitor.start()
-            log.info("elastic slice-loss monitor on (threshold %.1fs)",
-                     args.elastic_threshold)
+            log.info("elastic slice-loss monitor on (threshold %.1fs%s)",
+                     args.elastic_threshold,
+                     (f"; scale-up watch to {orig[0]} processes"
+                      if watch_scale_up else ""))
     # Runtime introspection: compile tracking with recompile goodput
     # attribution (fit installs too, but wiring here covers the window
     # before fit builds its exporter), plus the hbm_plan budget this
@@ -313,7 +352,7 @@ def main(argv=None) -> int:
                    metrics_host=args.metrics_host,
                    heartbeat_dir=args.heartbeat_dir,
                    watchdog_threshold_s=args.watchdog_threshold,
-                   dcn_overlap=dcn_overlap)
+                   dcn_overlap=dcn_overlap, ckpt_async=args.ckpt_async)
 
     if monitor is not None:
         monitor.stop()

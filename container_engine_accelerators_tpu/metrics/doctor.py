@@ -482,12 +482,36 @@ class StragglerDetector(Detector):
     the timeline (works offline too), or — live, with a heartbeat dir
     attached — hb-<id> mtime skew beyond straggler_skew_s while at
     least one process stays fresh (the skew form catches a straggler
-    BEFORE the absolute-age watchdog threshold trips)."""
+    BEFORE the absolute-age watchdog threshold trips).
+
+    A rank with an IN-FLIGHT asynchronous checkpoint save is not a
+    straggler: its last ckpt/async_save instant has phase=start with
+    no matching end, meaning a background commit is running and the
+    step loop may legitimately pause at the next save boundary. The
+    exemption never applies to elastic-sourced stalls (train/stalled
+    with source=elastic): those carry peer-DEATH evidence — a provably
+    dead pid — not slowness, and suppressing them would hide real
+    losses behind a save that will never finish."""
 
     cls = "straggler"
 
+    def _async_save_in_flight(self, sig) -> set:
+        """Processes whose newest ckpt/async_save instant is an
+        unmatched phase=start (CheckpointManager emits start on the
+        step path and end from the writer thread)."""
+        last: dict = {}
+        for e in sig.named("ckpt/async_save", "i", 0.0):
+            proc = e["args"].get("process")
+            if proc is not None:
+                last[proc] = e["args"].get("phase")
+        return {p for p, phase in last.items() if phase == "start"}
+
     def check(self, sig):
+        in_flight = self._async_save_in_flight(sig)
         stalls = sig.named("train/stalled", "i", sig.fast_since)
+        stalls = [e for e in stalls
+                  if e["args"].get("source") == "elastic"
+                  or e["args"].get("process") not in in_flight]
         if stalls:
             last = stalls[-1]
             proc = last["args"].get("process", "?")
@@ -507,6 +531,8 @@ class StragglerDetector(Detector):
         worst = max(ages, key=lambda p: ages[p])
         skew = ages[worst] - min(ages.values())
         if skew < sig.config.straggler_skew_s:
+            return []
+        if worst in in_flight:
             return []
         ev = {"source": "heartbeat_skew",
               "ages_s": {str(k): round(v, 1) for k, v in ages.items()},

@@ -171,8 +171,15 @@ _PHASE_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
 #   reshard     checkpoint restore that translated topologies (the
 #               saved topology tag differs from the restoring run's)
 #   restore     same-topology checkpoint restore + batch fast-forward
+#   ckpt_async  the STEP-PATH stall of an asynchronous checkpoint save
+#               (host-buffer snapshot + any wait for the previous
+#               in-flight save) — the serialize/commit itself runs on a
+#               background thread and overlaps productive steps, so
+#               this bucket staying near zero IS the async win; the
+#               synchronous save path keeps charging `checkpoint`
 GOODPUT_BUCKETS = ("productive", "restore", "reshard", "recompile",
-                   "checkpoint", "stalled", "detection", "restart")
+                   "checkpoint", "ckpt_async", "stalled", "detection",
+                   "restart")
 SAMPLE_KINDS = ("step", "data_wait", "ckpt_save", "host_sync")
 
 
@@ -546,18 +553,30 @@ class TrainRecorder:
                                 {"batches": batches})
 
     def record_checkpoint_save(self, seconds: float,
-                               now: float | None = None) -> None:
+                               now: float | None = None,
+                               async_mode: bool = False) -> None:
+        """Loop-thread time inside a checkpoint save call. With
+        `async_mode=True` the seconds are the STEP-PATH stall of an
+        asynchronous save (snapshot + join of the previous in-flight
+        save) and land in the `ckpt_async` bucket — the background
+        serialize/commit overlaps productive steps and is never charged
+        here. Synchronous saves keep charging `checkpoint`."""
         now = time.monotonic() if now is None else now
         with self._lock:
             self._observe("ckpt_save", self.ckpt_save, seconds)
-            self._buckets["checkpoint"] += max(seconds, 0.0)
+            bucket = "ckpt_async" if async_mode else "checkpoint"
+            self._buckets[bucket] += max(seconds, 0.0)
             self._goodput_locked(now)
-            self._append_log({"kind": "ckpt_save",
-                              "t": round(time.time(), 3),
-                              "seconds": round(seconds, 6)})
+            rec = {"kind": "ckpt_save", "t": round(time.time(), 3),
+                   "seconds": round(seconds, 6)}
+            if async_mode:
+                rec["async"] = True
+            self._append_log(rec)
             if events.enabled():
                 s = max(seconds, 0.0)
-                events.complete("train/ckpt_save", now - s, s, "train")
+                events.complete("train/ckpt_save", now - s, s, "train",
+                                {"async": async_mode} if async_mode
+                                else None)
 
     def record_host_sync(self, seconds: float) -> None:
         """Log-boundary device_get fence. Counted PRODUCTIVE: the wait

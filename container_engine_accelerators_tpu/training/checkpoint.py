@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any
 
 import jax
@@ -38,6 +39,42 @@ from container_engine_accelerators_tpu.training.train import TrainState
 log = logging.getLogger(__name__)
 
 _DEPTH_ORDER = {"interleaved": False}
+
+# How long an elastic pre-exec drain waits for an in-flight async save
+# before ABANDONING it: the execve kills the writer thread mid-write,
+# and the torn step dir is quarantined by the restarted process's
+# restore fallback — bounded loss (one checkpoint interval), bounded
+# wait (the restart is racing a wedged collective).
+ASYNC_DRAIN_TIMEOUT_S = 30.0
+
+# Test seam (chaos/unit torn-tail coverage): sleep this long on the
+# background save thread BETWEEN the host-buffer snapshot and the
+# orbax serialize/commit, widening the window a SIGKILL must land in.
+# Single-process only: on multi-process runs the orbax save is
+# dispatched on the step path (collective discipline — see
+# _save_async) and the commit timing belongs to orbax.
+_ASYNC_TEST_DELAY_ENV = "TPU_CKPT_ASYNC_TEST_DELAY_S"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: os.replace/os.rename alone is not
+    crash-durable on ext4 — the rename lives in the directory's
+    metadata, and a host loss right after the atomic commit can
+    resurrect the pre-rename state (the torn layout the quarantine
+    exists to clean up). Called by rank 0 after every namespace-level
+    rename (orbax's finalize, the quarantine). Best-effort: an fs that
+    refuses O_RDONLY on directories only loses durability it never
+    had."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        log.debug("directory fsync failed for %s", path, exc_info=True)
+    finally:
+        os.close(fd)
 
 
 def current_topology(mesh=None) -> dict:
@@ -105,28 +142,68 @@ class CheckpointManager:
     corruption the quarantine exists to clean up. In-process, `save`
     is additionally single-writer per directory: two concurrent saves
     into the same directory (two managers, or two threads on one)
-    raise instead of interleaving half-written step dirs."""
+    raise instead of interleaving half-written step dirs.
+
+    Asynchronous mode (`async_save=True`, ISSUE 14): `save` snapshots
+    the state into host-backed buffers ON the step path (bounded: at
+    most one snapshot is ever pinned, because the previous in-flight
+    save is awaited first) and runs the orbax serialize + rank-0
+    commit/fsync on a background thread under the same single-writer
+    registry. The step loop's only cost is the snapshot + join — the
+    `ckpt_async` goodput bucket — while the write overlaps productive
+    steps. The collective discipline is unchanged: every rank calls
+    `save` at the same step. On MULTI-PROCESS runs the orbax save is
+    additionally DISPATCHED on the step path (not the background
+    thread), because orbax's save issues device collectives that must
+    stay in main-thread program order with the step loop's gradient
+    psums — see _save_async for the full contract.
+    An in-flight save is awaited before the next save, before `wait`/
+    `close`, and — via the elastic pre-restart hook — before a
+    slice-loss execve (bounded by ASYNC_DRAIN_TIMEOUT_S; on timeout
+    the save is ABANDONED and the torn step dir is quarantined by the
+    restarted process's restore fallback)."""
 
     # In-process single-writer registry: absolute dir -> writer token.
     _inflight_lock = threading.Lock()
     _inflight: dict[str, int] = {}
 
     def __init__(self, directory: str, save_interval_steps: int = 100,
-                 max_to_keep: int = 3, process_index: int | None = None):
+                 max_to_keep: int = 3, process_index: int | None = None,
+                 async_save: bool = False):
         directory = os.path.abspath(directory)
         self._dir = directory
         if process_index is None:
             process_index = jax.process_index()
         self._rank = process_index
         self.last_restore_info: dict | None = None
+        self.async_save = bool(async_save)
+        self._save_interval = max(1, int(save_interval_steps))
+        self._async_thread: threading.Thread | None = None
+        self._async_step: int | None = None
+        self._async_error: Exception | None = None
+        self._unregister_hook = None
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 save_interval_steps=save_interval_steps,
                 max_to_keep=max_to_keep,
                 create=True,
+                # A rank SIGKILLed mid-save (preemption, elastic
+                # abandon) leaves an uncommitted tmp step dir that
+                # orbax would otherwise never touch again; sweep it at
+                # the next manager init so torn tails cannot accrete.
+                cleanup_tmp_directories=True,
             ),
         )
+        if self.async_save:
+            # A slice-loss execve would kill the writer thread mid-
+            # write; register a bounded drain so the elastic monitor
+            # awaits (or knowingly abandons) the in-flight save first.
+            from container_engine_accelerators_tpu.training import (
+                elastic,
+            )
+            self._unregister_hook = elastic.register_pre_restart_hook(
+                self._drain_for_restart)
 
     def save(self, step: int, state: TrainState, force: bool = False,
              layout: dict | None = None, cfg=None,
@@ -143,7 +220,39 @@ class CheckpointManager:
         restore.
 
         Collective + single-writer: see the class docstring. All ranks
-        call save; rank 0 owns every namespace-level rename."""
+        call save; rank 0 owns every namespace-level rename.
+
+        In async mode this returns as soon as the host-buffer snapshot
+        is taken and the background write is launched (True = a write
+        was launched; the interval/force decision is made up front).
+        The caller's timed region around this call IS the step-path
+        stall — charge it to `ckpt_async`, not `checkpoint`."""
+        if self.async_save:
+            return self._save_async(step, state, force=force,
+                                    layout=layout, cfg=cfg,
+                                    topology=topology)
+        self._acquire_inflight()
+        try:
+            saved = self._orbax_save(step, self._state_tree(state),
+                                     force=force, layout=layout,
+                                     cfg=cfg, topology=topology)
+            if saved:
+                # The manager backgrounds the write even here (it runs
+                # enable_async_checkpointing); sync mode's contract is
+                # that the commit has LANDED when save() returns, so
+                # await the finalize before fsyncing the rename.
+                self._mngr.wait_until_finished()
+                if self._rank == 0:
+                    # Orbax's finalize renamed the tmp step dir into
+                    # the numeric namespace; make the rename durable.
+                    _fsync_dir(self._dir)
+            return saved
+        finally:
+            self._release_inflight()
+
+    # ---------- save internals (shared sync/async) ----------
+
+    def _acquire_inflight(self) -> None:
         with CheckpointManager._inflight_lock:
             holder = CheckpointManager._inflight.get(self._dir)
             if holder is not None:
@@ -153,33 +262,218 @@ class CheckpointManager:
                     "save path is single-writer per directory — "
                     "serialize callers, don't race the atomic commit")
             CheckpointManager._inflight[self._dir] = id(self)
+
+    def _release_inflight(self) -> None:
+        with CheckpointManager._inflight_lock:
+            CheckpointManager._inflight.pop(self._dir, None)
+
+    @staticmethod
+    def _state_tree(state: TrainState) -> dict:
+        state_tree = state._asdict()
+        # dcn_ef is resident comm state (TrainState docstring): fit
+        # strips it before saving, and the dropped key keeps the
+        # on-disk tree identical to pre-overlap checkpoints.
+        if state_tree.get("dcn_ef") is None:
+            state_tree.pop("dcn_ef", None)
+        return state_tree
+
+    def _orbax_save(self, step: int, state_tree: dict, force: bool,
+                    layout: dict | None, cfg,
+                    topology: dict | None) -> bool:
+        items = {
+            "state": ocp.args.StandardSave(state_tree),
+            "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
+            "topology": ocp.args.JsonSave(
+                topology if topology is not None
+                else current_topology()),
+        }
+        if cfg is not None:
+            from container_engine_accelerators_tpu.models.llama import (
+                cfg_to_json_dict,
+            )
+            items["cfg"] = ocp.args.JsonSave(cfg_to_json_dict(cfg))
+        saved = self._mngr.save(step, args=ocp.args.Composite(**items),
+                                force=force)
+        return bool(saved)
+
+    # ---------- async mode ----------
+
+    def _should_save(self, step: int, force: bool) -> bool:
+        """The interval decision orbax would make inside `save`, made
+        BEFORE the snapshot so a skipped step costs nothing."""
+        if force:
+            return True
+        if hasattr(self._mngr, "should_save"):
+            return bool(self._mngr.should_save(step))
+        return step % self._save_interval == 0
+
+    @staticmethod
+    def _snapshot_tree(tree):
+        """Host-buffer snapshot of every array leaf: the training loop
+        DONATES the live state buffers to the next step's dispatch, so
+        a background writer must hold its own copies. Each leaf's
+        addressable shards are pulled to host and re-placed on their
+        devices, yielding an array with the ORIGINAL sharding (orbax's
+        each-host-writes-its-own-shards discipline keeps working in
+        multi-process runs) but buffers nothing else owns. Bounded:
+        save() awaits the previous in-flight save first, so at most one
+        snapshot is ever alive."""
+        import numpy as np
+
+        def snap(x):
+            if isinstance(x, jax.Array):
+                # tpulint: allow=TPL002(the snapshot IS the bounded step-path cost of the async save; it replaces a full synchronous serialize)
+                arrs = [jax.device_put(np.asarray(s.data), s.device)
+                        for s in x.addressable_shards]
+                return jax.make_array_from_single_device_arrays(
+                    x.shape, x.sharding, arrs)
+            return x
+
+        return jax.tree.map(snap, tree)
+
+    def _save_async(self, step: int, state: TrainState, force: bool,
+                    layout: dict | None, cfg,
+                    topology: dict | None) -> bool:
+        # Await the previous in-flight save: the single-writer
+        # discipline and the one-pinned-snapshot bound both hang off
+        # this join. Normally the background write finished many steps
+        # ago and this is a no-op.
+        self.wait_async()
+        if self._async_error is not None:
+            err, self._async_error = self._async_error, None
+            log.warning(
+                "previous async checkpoint save (step %s) failed and "
+                "was quarantined: %s: %s", self._async_step,
+                type(err).__name__, str(err)[:200])
+        if not self._should_save(step, force):
+            return False
+        snapshot = self._snapshot_tree(self._state_tree(state))
+        self._acquire_inflight()
+        self._async_step = step
+        if events.enabled():
+            events.instant("ckpt/async_save", "train",
+                           {"phase": "start", "step": step,
+                            "process": self._rank})
+        # THREADING CONTRACT: jax collectives must stay on the main
+        # thread, in program order. Orbax's save issues DEVICE
+        # collectives (sync_global_devices around tmp-dir creation);
+        # on a multi-process run, issuing those from a background
+        # thread interleaves them with the step loop's gradient psums
+        # on the same gloo pairs and corrupts the wire protocol
+        # (observed: gloo EnforceNotMet op.preamble.length <=
+        # op.nbytes). So on multi-process runs the orbax save is
+        # DISPATCHED here, on the step path — cheap, because the
+        # manager runs enable_async_checkpointing: its save() returns
+        # once the host copies are taken and finalizes on orbax's own
+        # thread via the coordination-service barrier, which is a gRPC
+        # call, not a device collective — and the background thread
+        # only awaits that finalize and fsyncs the commit. A
+        # single-process run has no cross-process collectives and
+        # keeps the fully-deferred write (which the torn-tail test
+        # seam's deterministic SIGKILL window depends on).
+        dispatched = False
+        if jax.process_count() > 1:
+            try:
+                self._orbax_save(step, snapshot, force=force,
+                                 layout=layout, cfg=cfg,
+                                 topology=topology)
+            except BaseException:
+                self._release_inflight()
+                raise
+            dispatched = True
+        self._async_thread = threading.Thread(
+            target=self._async_commit,
+            args=(step, snapshot, force, layout, cfg, topology,
+                  dispatched),
+            daemon=True, name=f"ckpt-async-save-{step}")
+        self._async_thread.start()
+        return True
+
+    def _async_commit(self, step: int, snapshot: dict, force: bool,
+                      layout: dict | None, cfg,
+                      topology: dict | None,
+                      dispatched: bool = False) -> None:
+        """Background half of an async save. Single-process: the whole
+        orbax serialize + commit runs here. Multi-process
+        (`dispatched`): the orbax save was already issued on the step
+        path (collective discipline — see _save_async) and this thread
+        only awaits orbax's finalize. Either way: rank-0 directory
+        fsync after the commit rename; failures are recorded for the
+        next save() to surface, and the partial step dir is
+        quarantined (rank 0) so the step stays re-saveable."""
         try:
-            state_tree = state._asdict()
-            # dcn_ef is resident comm state (TrainState docstring): fit
-            # strips it before saving, and the dropped key keeps the
-            # on-disk tree identical to pre-overlap checkpoints.
-            if state_tree.get("dcn_ef") is None:
-                state_tree.pop("dcn_ef", None)
-            items = {
-                "state": ocp.args.StandardSave(state_tree),
-                "layout": ocp.args.JsonSave(layout or _DEPTH_ORDER),
-                "topology": ocp.args.JsonSave(
-                    topology if topology is not None
-                    else current_topology()),
-            }
-            if cfg is not None:
-                from container_engine_accelerators_tpu.models.llama import (
-                    cfg_to_json_dict,
-                )
-                items["cfg"] = ocp.args.JsonSave(cfg_to_json_dict(cfg))
-            saved = self._mngr.save(step, args=ocp.args.Composite(**items),
-                                    force=force)
-            return bool(saved)
+            if not dispatched:
+                delay = float(
+                    os.environ.get(_ASYNC_TEST_DELAY_ENV, 0) or 0)
+                if delay > 0:
+                    time.sleep(delay)
+                self._orbax_save(step, snapshot, force=force,
+                                 layout=layout, cfg=cfg,
+                                 topology=topology)
+            self._mngr.wait_until_finished()
+            if self._rank == 0:
+                _fsync_dir(self._dir)
+            if events.enabled():
+                events.instant("ckpt/async_save", "train",
+                               {"phase": "end", "step": step,
+                                "process": self._rank, "ok": True})
+        # tpulint: allow=TPL009(background writer thread: any failure class must be recorded + quarantined, never left to kill the thread silently)
+        except Exception as e:
+            self._async_error = e
+            log.exception("async checkpoint save of step %d failed",
+                          step)
+            if events.enabled():
+                events.instant("ckpt/async_save", "train",
+                               {"phase": "end", "step": step,
+                                "process": self._rank, "ok": False,
+                                "error": str(e)[:200]})
+            try:
+                self._quarantine_step(step)
+            # tpulint: allow=TPL009(best-effort cleanup inside the failure path; the original error is already recorded)
+            except Exception:
+                log.exception("quarantine after failed async save of "
+                              "step %d failed", step)
         finally:
-            with CheckpointManager._inflight_lock:
-                CheckpointManager._inflight.pop(self._dir, None)
+            self._release_inflight()
+
+    def wait_async(self, timeout_s: float | None = None) -> bool:
+        """Join the in-flight async save thread (no-op in sync mode or
+        when nothing is in flight). Returns False only on a timeout —
+        the save is then ABANDONED: still running, still holding the
+        single-writer registry; the caller is about to exec/exit and
+        the torn step dir is the restore fallback's problem."""
+        t = self._async_thread
+        if t is None:
+            return True
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            return False
+        self._async_thread = None
+        return True
+
+    @property
+    def async_in_flight(self) -> bool:
+        t = self._async_thread
+        return t is not None and t.is_alive()
+
+    def _drain_for_restart(self) -> None:
+        """Elastic pre-restart hook: an execve is about to replace this
+        process. Await the in-flight async save (bounded); on timeout,
+        abandon it loudly — the restarted process's restore fallback
+        quarantines whatever torn step dir the killed writer left."""
+        if not self.wait_async(timeout_s=ASYNC_DRAIN_TIMEOUT_S):
+            log.warning(
+                "abandoning in-flight async checkpoint save of step %s "
+                "after %.0fs (elastic restart pending); the torn step "
+                "will be quarantined on restore", self._async_step,
+                ASYNC_DRAIN_TIMEOUT_S)
+            if events.enabled():
+                events.instant("ckpt/async_abandoned", "train",
+                               {"step": self._async_step,
+                                "process": self._rank})
 
     def wait(self):
+        self.wait_async()
         self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:
@@ -320,6 +614,7 @@ class CheckpointManager:
             dst = os.path.join(self._dir, f"{step}.corrupt.{i}")
         try:
             os.rename(src, dst)
+            _fsync_dir(self._dir)  # the rename must survive a crash too
             log.warning("quarantined torn checkpoint step %d -> %s",
                         step, dst)
         except OSError:
@@ -381,6 +676,10 @@ class CheckpointManager:
         return e
 
     def close(self):
+        self.wait_async()
+        if self._unregister_hook is not None:
+            self._unregister_hook()
+            self._unregister_hook = None
         self._mngr.close()
 
 

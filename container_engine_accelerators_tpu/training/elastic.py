@@ -87,12 +87,54 @@ log = logging.getLogger(__name__)
 RESUME_STATE_ENV = "TPU_ELASTIC_RESUME_STATE"
 RESTARTS_ENV = "TPU_ELASTIC_RESTARTS"
 
+# The FULL pre-shrink topology, stamped into the environment by the
+# first shrink's plan_restart_env (TPU_ELASTIC_ORIG_<var>) and carried
+# across every subsequent execve: scale-up rejoin (ISSUE 14) restores
+# the original JAX_* world from these — the coordinator address in
+# particular cannot be recomputed once a single survivor dropped the
+# distributed env.
+ORIG_ENV_PREFIX = "TPU_ELASTIC_ORIG_"
+
+# A resume-state file older than this is a leftover from a previous
+# run, not the restart we are in: consume_resume_state discards it
+# loudly instead of charging a phantom gap to this run's goodput.
+STALE_RESUME_MAX_AGE_S = 1800.0
+
 EXIT_COORDINATOR_LOST = 41
 EXIT_RESTART_BUDGET = 42
 
 _DISTRIBUTED_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_PORT",
                      "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
                      "JAX_NUM_SLICES", "MEGASCALE_NUM_SLICES")
+
+# Callables run on the monitor thread immediately before an elastic
+# execve (shrink or scale-up): the restart replaces the whole process,
+# so subsystems with in-flight background work (the async checkpoint
+# writer) register a bounded drain here rather than being killed
+# mid-commit.
+_PRE_RESTART_HOOKS: list = []
+
+
+def register_pre_restart_hook(fn):
+    """Register `fn` to run before an elastic execve; returns an
+    unregister callable (idempotent)."""
+    _PRE_RESTART_HOOKS.append(fn)
+
+    def unregister():
+        try:
+            _PRE_RESTART_HOOKS.remove(fn)
+        except ValueError:
+            pass
+    return unregister
+
+
+def _run_pre_restart_hooks() -> None:
+    for fn in list(_PRE_RESTART_HOOKS):
+        try:
+            fn()
+        # tpulint: allow=TPL009(a broken drain hook must not block the restart the whole mechanism exists for)
+        except Exception:
+            log.exception("pre-restart hook %r failed", fn)
 
 
 class Heartbeat(NamedTuple):
@@ -235,9 +277,19 @@ def plan_restart_env(env: dict, survivors: list[int],
     topology, or None when no in-place restart is possible (the
     coordinator rank was lost and >1 survivor remains — the coordinator
     address cannot be recomputed locally; the Job controller owns that
-    recovery). Pure: unit-tested without processes."""
+    recovery). Pure: unit-tested without processes.
+
+    Before anything shrinks, the FULL topology is stamped into
+    TPU_ELASTIC_ORIG_* (first shrink only — later shrinks must not
+    overwrite the true original with an already-reduced world): these
+    survive every execve and are what plan_scaleup_env restores when
+    the lost capacity returns."""
     new = dict(env)
     new.pop(RESUME_STATE_ENV, None)
+    for var in _DISTRIBUTED_VARS:
+        key = ORIG_ENV_PREFIX + var
+        if key not in new and var in env:
+            new[key] = env[var]
     survivors = sorted(survivors)
     if len(survivors) <= 1:
         for var in _DISTRIBUTED_VARS:
@@ -267,6 +319,80 @@ def plan_restart_env(env: dict, survivors: list[int],
     return new
 
 
+def original_topology(env: dict) -> tuple[int, int] | None:
+    """(num_processes, num_slices) of the pre-shrink world recorded in
+    TPU_ELASTIC_ORIG_*, or None when this run never shrank. Pure."""
+    procs = env.get(ORIG_ENV_PREFIX + "JAX_NUM_PROCESSES")
+    if not procs or not str(procs).isdigit():
+        return None
+    slices = (env.get(ORIG_ENV_PREFIX + "MEGASCALE_NUM_SLICES")
+              or env.get(ORIG_ENV_PREFIX + "JAX_NUM_SLICES") or "1")
+    if not str(slices).isdigit():
+        slices = "1"
+    return int(procs), max(1, int(slices))
+
+
+def plan_scaleup_env(env: dict) -> dict | None:
+    """The environment for a survivor's re-exec back into the FULL
+    original topology, or None when the originals were never recorded
+    (this run never shrank) or are too incomplete to re-form the
+    distributed job. The survivor's own original rank comes back from
+    TPU_ELASTIC_ORIG_JAX_PROCESS_ID — re-rank is deterministic because
+    every survivor restores the identity it held before the first
+    shrink, and returning ranks launch with their original env
+    untouched. Pure: unit-tested without processes."""
+    restored = {var: env[ORIG_ENV_PREFIX + var]
+                for var in _DISTRIBUTED_VARS
+                if ORIG_ENV_PREFIX + var in env}
+    if ("JAX_NUM_PROCESSES" not in restored
+            or "JAX_COORDINATOR_ADDRESS" not in restored):
+        return None
+    new = dict(env)
+    new.pop(RESUME_STATE_ENV, None)
+    for var in _DISTRIBUTED_VARS:
+        new.pop(var, None)
+    new.update(restored)
+    return new
+
+
+def announce_heartbeat(heartbeat_dir: str, process_id: int,
+                       interval_s: float = 2.0):
+    """Write this process's hb-<id> BEFORE jax.distributed init and
+    keep it fresh from a ticker thread; returns a stop() callable.
+
+    This is how a returning rank becomes visible: it must block in
+    initialize_from_env waiting for the coordinator (the survivors are
+    still running the shrunk job and will not re-exec until they SEE
+    it), so the heartbeat has to start ticking before the blocking
+    call, not from the TrainRecorder that only exists afterwards. The
+    file format matches TrainRecorder._touch_heartbeat so classify_peer
+    can verify the writer's identity (pid + /proc start ticks)."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    path = os.path.join(heartbeat_dir, f"hb-{process_id}")
+    ticks = proc_start_ticks(os.getpid()) or 0
+
+    def touch() -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(f"{os.getpid()} -1 {host_id()} {ticks}\n")
+            os.replace(tmp, path)
+        except OSError:
+            log.debug("heartbeat announce failed for %s", path,
+                      exc_info=True)
+
+    touch()
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            touch()
+
+    threading.Thread(target=loop, daemon=True,
+                     name="elastic-announce").start()
+    return stop.set
+
+
 class SliceLossMonitor:
     """One daemon thread per training process. `scan()` is the pure
     detection step (unit-testable); `start()` polls it and triggers the
@@ -281,7 +407,11 @@ class SliceLossMonitor:
                  max_restarts: int = 3,
                  restart_argv: list[str] | None = None,
                  dump_dir: str | None = None,
-                 on_loss=None):
+                 on_loss=None,
+                 orig_num_processes: int | None = None,
+                 orig_num_slices: int | None = None,
+                 rejoin_fresh_s: float | None = None,
+                 on_return=None):
         self.heartbeat_dir = heartbeat_dir
         self.process_id = process_id
         self.num_processes = num_processes
@@ -306,6 +436,25 @@ class SliceLossMonitor:
         # Test seam: called instead of the execve when set; returning
         # makes the monitor thread stop.
         self.on_loss = on_loss
+        # Scale-up watch (ISSUE 14): when this cohort is SMALLER than
+        # the original topology (TPU_ELASTIC_ORIG_*), scan_returned
+        # looks for fresh heartbeats from the missing original ranks
+        # and re-execs back into the FULL original world once every
+        # original rank is accounted for. Partial regrowth is not
+        # attempted — intermediate topologies would need a rendezvous
+        # protocol to agree on; full-world is decidable locally.
+        self.orig_num_processes = max(orig_num_processes or 0,
+                                      num_processes)
+        self.orig_num_slices = max(orig_num_slices or 0, self.num_slices)
+        # A returning rank's heartbeat must be this fresh to count as
+        # capacity (its announce ticker rewrites every ~2s); a stale
+        # file under a live-but-unverifiable pid is not evidence.
+        self.rejoin_fresh_s = (rejoin_fresh_s if rejoin_fresh_s is not None
+                               else max(10.0, 3 * self.interval_s))
+        self.on_return = on_return  # test seam, mirrors on_loss
+        self._scale_up_disabled = False
+        # tpulint: allow=TPL004(wall-vs-wall, compared against heartbeat file mtimes)
+        self._started_at = time.time()
         self._seen: dict[int, float] = {}
         self._finished: set[int] = set()
         self._stop = threading.Event()
@@ -371,6 +520,65 @@ class SliceLossMonitor:
             lost.discard(self.process_id)
         return lost
 
+    def current_rank_ids(self) -> set[int]:
+        """The heartbeat ids the CURRENT cohort writes under. A multi-
+        process cohort was densely re-ranked (plan_restart_env), so its
+        ids are exactly [0, num_processes); a single survivor keeps its
+        ORIGINAL rank as its identity (same function, single-survivor
+        branch), so its id is process_id."""
+        if self.num_processes > 1:
+            return set(range(self.num_processes))
+        return {self.process_id}
+
+    def scan_returned(self, now: float | None = None,
+                      heartbeats: dict | None = None) -> set[int]:
+        """One capacity-return pass; returns the ORIGINAL-rank ids of
+        returning processes when — and only when — the full original
+        cohort is accounted for (current + returned covers every
+        original rank, whole slices only). Otherwise the empty set.
+
+        A candidate counts as returned only when its heartbeat was
+        rewritten AFTER this monitor came up (a returning rank's
+        announce ticker rewrites its file every ~2s; every pre-shrink
+        leftover — including a SURVIVOR's own old rank's file, whose
+        pid is live because execve kept it — has an mtime frozen before
+        the shrunk world existed), is still fresh within
+        rejoin_fresh_s, and is not PEER_DEAD (the corpse of the loss
+        this cohort already shrank around)."""
+        if self._scale_up_disabled:
+            return set()
+        if self.orig_num_processes <= self.num_processes:
+            return set()
+        # tpulint: allow=TPL004(wall-vs-wall, ages come from file mtimes)
+        now = time.time() if now is None else now
+        if heartbeats is None:
+            heartbeats = read_heartbeats(self.heartbeat_dir)
+        current = self.current_rank_ids()
+        returned: set[int] = set()
+        for peer in range(self.orig_num_processes):
+            if peer in current:
+                continue
+            hb = heartbeats.get(peer)
+            if hb is None:
+                continue
+            if hb.mtime <= self._started_at:
+                continue            # pre-shrink leftover, not a return
+            if (now - hb.mtime) > self.rejoin_fresh_s:
+                continue            # announced once, then went away
+            if classify_peer(hb.pid, hb.host, hb.start_ticks) == PEER_DEAD:
+                continue
+            returned.add(peer)
+        # Whole slices only: a slice whose ICI domain is partially back
+        # cannot contribute dp shards, exactly as in the loss direction.
+        per = max(1, self.orig_num_processes // self.orig_num_slices)
+        complete = {s for s in range(self.orig_num_slices)
+                    if all(p in returned or p in current
+                           for p in range(s * per, (s + 1) * per))}
+        returned = {p for p in returned if p // per in complete}
+        if current | returned == set(range(self.orig_num_processes)):
+            return returned
+        return set()
+
     # ---------- the restart ----------
 
     def _trigger(self, lost: set[int]) -> None:
@@ -411,6 +619,7 @@ class SliceLossMonitor:
                     self.dump_dir,
                     f"trace-{os.getpid()}-pre{restarts}.json"))
         state = {
+            "kind": "shrink",
             "t_lost": t_lost,
             "t_detect": t_detect,
             "lost": sorted(lost),
@@ -418,13 +627,9 @@ class SliceLossMonitor:
             "prev_num_processes": self.num_processes,
             "prev_num_slices": self.num_slices,
             "restarts": restarts,
+            "pid": os.getpid(),   # execve keeps it; staleness check
         }
-        state_path = os.path.join(self.heartbeat_dir,
-                                  f"elastic-resume-{self.process_id}.json")
-        tmp = f"{state_path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, state_path)
+        state_path = self._write_state(state)
 
         if self.on_loss is not None:
             self.on_loss(state)
@@ -443,6 +648,92 @@ class SliceLossMonitor:
                 "re-form jax.distributed in place; exiting for the "
                 "outer controller to recreate the job", len(survivors))
             os._exit(EXIT_COORDINATOR_LOST)
+        self._exec_restart(env, state_path, restarts)
+
+    def _trigger_scale_up(self, returned: set[int]) -> None:
+        """Re-exec back into the FULL original topology: the missing
+        original ranks are heartbeating again (scan_returned), so every
+        survivor independently restores its pre-shrink identity from
+        TPU_ELASTIC_ORIG_* and the whole original cohort re-forms the
+        distributed job. Scale-up is deliberately OUTSIDE the restart
+        budget's fatal path: an exhausted budget just pins the cohort
+        at the current size — killing a healthy survivor because
+        capacity CAME BACK would be absurd."""
+        # tpulint: allow=TPL004(wall-vs-wall: compared against heartbeat file mtimes and read back across an execve)
+        t_detect = time.time()
+        heartbeats = read_heartbeats(self.heartbeat_dir)
+        t_return = min((heartbeats[p][0] for p in returned
+                        if p in heartbeats), default=t_detect)
+        restarts = int(os.environ.get(RESTARTS_ENV, "0")) + 1
+        if restarts > self.max_restarts and self.on_return is None:
+            log.warning(
+                "capacity returned (%s) but the restart budget is "
+                "exhausted (%d/%d); staying at %d process(es)",
+                sorted(returned), restarts - 1, self.max_restarts,
+                self.num_processes)
+            self._scale_up_disabled = True
+            return
+        env = plan_scaleup_env(dict(os.environ))
+        if env is None:
+            log.warning("capacity returned (%s) but the original "
+                        "topology was never recorded; staying at %d "
+                        "process(es)", sorted(returned),
+                        self.num_processes)
+            self._scale_up_disabled = True
+            return
+        log.warning(
+            "SLICE RETURN: original rank(s) %s heartbeating again "
+            "(first seen %.1fs ago); restarting into the full "
+            "original topology %d process(es)/%d slice(s) "
+            "(restart %d/%d)", sorted(returned), t_detect - t_return,
+            self.orig_num_processes, self.orig_num_slices, restarts,
+            self.max_restarts)
+        if events.enabled():
+            events.instant(
+                "elastic/slice_return", "train",
+                {"returned": sorted(returned),
+                 "target_processes": self.orig_num_processes,
+                 "target_slices": self.orig_num_slices,
+                 "detection_s": round(t_detect - t_return, 3)})
+            if self.dump_dir:
+                events.dump_now(os.path.join(
+                    self.dump_dir,
+                    f"trace-{os.getpid()}-pre{restarts}.json"))
+        state = {
+            "kind": "scale_up",
+            "t_lost": t_return,    # capacity became visible
+            "t_detect": t_detect,  # the monitor noticed
+            "returned": sorted(returned),
+            "survivors": sorted(self.current_rank_ids() | returned),
+            "prev_num_processes": self.num_processes,
+            "prev_num_slices": self.num_slices,
+            "target_num_processes": self.orig_num_processes,
+            "target_num_slices": self.orig_num_slices,
+            "restarts": restarts,
+            "pid": os.getpid(),
+        }
+        state_path = self._write_state(state)
+
+        if self.on_return is not None:
+            self.on_return(state)
+            self._scale_up_disabled = True
+            return
+
+        self._exec_restart(env, state_path, restarts)
+
+    # ---------- thread plumbing ----------
+
+    def _write_state(self, state: dict) -> str:
+        state_path = os.path.join(self.heartbeat_dir,
+                                  f"elastic-resume-{self.process_id}.json")
+        tmp = f"{state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+        return state_path
+
+    def _exec_restart(self, env: dict, state_path: str,
+                      restarts: int) -> None:
         env[RESUME_STATE_ENV] = state_path
         env[RESTARTS_ENV] = str(restarts)
         # The restarted interpreter must resolve this package from the
@@ -451,6 +742,9 @@ class SliceLossMonitor:
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
                              if env.get("PYTHONPATH") else repo)
+        # Drain in-flight background work (the async checkpoint
+        # writer's bounded wait) — the execve would kill it mid-commit.
+        _run_pre_restart_hooks()
         argv = self.restart_argv or [sys.argv[0]] + sys.argv[1:]
         log.warning("execve: %s %s", sys.executable, " ".join(argv))
         for h in logging.getLogger().handlers:
@@ -465,12 +759,14 @@ class SliceLossMonitor:
         # including the main thread wedged in the dead DCN collective.
         os.execve(sys.executable, [sys.executable] + argv, env)
 
-    # ---------- thread plumbing ----------
-
     def poll_once(self) -> set[int]:
         lost = self.scan()
         if lost:
             self._trigger(lost)
+            return lost
+        returned = self.scan_returned()
+        if returned:
+            self._trigger_scale_up(returned)
         return lost
 
     def _loop(self) -> None:
@@ -498,26 +794,30 @@ def reconcile_resume_topology(flag_slices: int | None, env_slices: int,
                               ) -> tuple[int, int, list[str]]:
     """Topology for a re-exec'd survivor (cli/train.py). The restart
     replays the original argv verbatim, so an explicit --dcn-slices
-    (and a --batch-size sized for it) describes the PRE-loss topology;
-    the JAX_NUM_SLICES the monitor computed (plan_restart_env) is
-    authoritative. Returns (slices, global_batch, notes): the env
-    slice count wins over a stale flag, and the global batch is kept
-    (dp only splits it — the post-resume trajectory must match) unless
-    it no longer divides into the surviving slices, where it rounds
-    down rather than dying on the divisibility check. Pure:
-    unit-tested without processes."""
+    (and a --batch-size sized for it) describes the PRE-restart
+    topology; the JAX_NUM_SLICES the monitor computed
+    (plan_restart_env shrinking, plan_scaleup_env growing) is
+    authoritative IN BOTH DIRECTIONS — a stale flag smaller than the
+    env means capacity came back. Returns (slices, global_batch,
+    notes): the env slice count wins over a stale flag, and the
+    global batch is kept (dp only splits it — the post-resume
+    trajectory must match) unless it no longer divides into the
+    current slices, where it rounds down rather than dying on the
+    divisibility check. Pure: unit-tested without processes."""
     notes: list[str] = []
     slices = flag_slices if flag_slices else env_slices
     if flag_slices and flag_slices != env_slices:
+        direction = ("pre-loss" if flag_slices > env_slices
+                     else "pre-scale-up")
         slices = env_slices
         notes.append(
-            f"--dcn-slices {flag_slices} is the pre-loss topology; "
+            f"--dcn-slices {flag_slices} is the {direction} topology; "
             f"using {env_slices} slice(s) from the environment")
     if slices > 1 and batch_size % slices:
         new_bs = max(slices, batch_size - batch_size % slices)
         notes.append(
             f"--batch-size {batch_size} does not divide into {slices} "
-            f"surviving slice(s); rounding down to {new_bs}")
+            f"current slice(s); rounding down to {new_bs}")
         batch_size = new_bs
     return slices, batch_size, notes
 
@@ -527,7 +827,17 @@ def consume_resume_state(recorder=None, log_fn=log.info) -> dict | None:
     wrote pre-exec, charge the `detection` and `restart` badput buckets
     on `recorder`, emit the `elastic/resumed` timeline instant, and
     return the state (None when this run is not an elastic resume).
-    Idempotent per process: the env var is consumed."""
+    Idempotent per process: the env var is consumed.
+
+    The state file is validated against THIS restart before anything
+    is charged: the writer's pid must be ours (execve keeps the pid —
+    a different pid means a leftover from another run sharing the
+    heartbeat dir), its restart counter must match RESTARTS_ENV (the
+    env var and the file are written by the same _trigger; a mismatch
+    means the file is from a different generation), and it must be
+    recent (STALE_RESUME_MAX_AGE_S). A stale file is discarded LOUDLY
+    — warning log + `elastic/stale_resume_state` instant — instead of
+    charging a phantom detection/restart gap to this run's goodput."""
     path = os.environ.pop(RESUME_STATE_ENV, None)
     if not path:
         return None
@@ -539,21 +849,57 @@ def consume_resume_state(recorder=None, log_fn=log.info) -> dict | None:
         return None
     # tpulint: allow=TPL004(wall-vs-wall: t_lost/t_detect are epoch stamps written by the PRE-exec process; monotonic does not survive execve)
     now = time.time()
+    stale = None
+    if state.get("pid") is not None and int(state["pid"]) != os.getpid():
+        stale = (f"written by pid {state['pid']}, this process is "
+                 f"{os.getpid()} (execve keeps the pid)")
+    env_restarts = os.environ.get(RESTARTS_ENV)
+    if (stale is None and env_restarts is not None
+            and state.get("restarts") is not None
+            and int(state["restarts"]) != int(env_restarts)):
+        stale = (f"restart counter {state['restarts']} != "
+                 f"{RESTARTS_ENV}={env_restarts}")
+    age_s = now - float(state.get("t_detect", now))
+    if stale is None and age_s > STALE_RESUME_MAX_AGE_S:
+        stale = (f"written {age_s:.0f}s ago "
+                 f"(> {STALE_RESUME_MAX_AGE_S:.0f}s bound)")
+    if stale:
+        log.warning(
+            "discarding stale elastic resume state %s: %s — its gap "
+            "belongs to a previous run, not this one's goodput", path,
+            stale)
+        if events.enabled():
+            events.instant("elastic/stale_resume_state", "train",
+                           {"path": path, "reason": stale})
+        return None
+    kind = state.get("kind", "shrink")
     detection_s = max(0.0, state["t_detect"] - state["t_lost"])
     restart_s = max(0.0, now - state["t_detect"])
     if recorder is not None:
-        recorder.record_badput("detection", detection_s,
-                               detail={"lost": state.get("lost")})
+        recorder.record_badput(
+            "detection", detection_s,
+            detail={"kind": kind, "lost": state.get("lost"),
+                    "returned": state.get("returned")})
         recorder.record_badput("restart", restart_s,
-                               detail={"restarts": state.get("restarts")})
+                               detail={"kind": kind,
+                                       "restarts": state.get("restarts")})
     if events.enabled():
         events.instant("elastic/resumed", "train",
-                       {"lost": state.get("lost"),
+                       {"kind": kind,
+                        "lost": state.get("lost"),
+                        "returned": state.get("returned"),
                         "survivors": state.get("survivors"),
                         "detection_s": round(detection_s, 3),
                         "restart_s": round(restart_s, 3)})
-    log_fn(f"elastic resume: lost {state.get('lost')}, "
-           f"now {len(state.get('survivors', []))} process(es); "
-           f"detection {detection_s:.1f}s + restart {restart_s:.1f}s "
-           "charged to badput")
+    if kind == "scale_up":
+        log_fn(f"elastic resume (scale-up): regained "
+               f"{state.get('returned')}, back to "
+               f"{state.get('target_num_processes')} process(es); "
+               f"detection {detection_s:.1f}s + restart "
+               f"{restart_s:.1f}s charged to badput")
+    else:
+        log_fn(f"elastic resume: lost {state.get('lost')}, "
+               f"now {len(state.get('survivors', []))} process(es); "
+               f"detection {detection_s:.1f}s + restart {restart_s:.1f}s "
+               "charged to badput")
     return state

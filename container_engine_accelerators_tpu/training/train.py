@@ -469,7 +469,7 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
         metrics_host: str = "", metrics_log: str | None = None,
         heartbeat_dir: str | None = None,
         watchdog_threshold_s: float = 300.0,
-        dcn_overlap=None):
+        dcn_overlap=None, ckpt_async: bool = False):
     """Train with checkpoint/auto-resume — the elastic-recovery loop
     (SURVEY.md §5: the reference's recovery is node-level repair; the
     workload-level half is resuming from the latest checkpoint after a
@@ -519,6 +519,12 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     first step — on EVERY rank, since its probes contain collectives —
     reporting overlap fraction and DCN busBW to the recorder and the
     flight recorder.
+
+    `ckpt_async=True` moves checkpoint serialization off the step
+    path (CheckpointManager async mode): the loop pays only the
+    host-buffer snapshot — charged to the `ckpt_async` badput bucket,
+    which should stay near zero — while serialize + rank-0 commit run
+    on a background thread overlapping the next steps.
     """
     import jax.random as jrandom
 
@@ -577,7 +583,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
     # here as a RESHARD, attributed to its own badput bucket.
     topology = current_topology(mesh)
     if ckpt_dir:
-        mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every)
+        mngr = CheckpointManager(ckpt_dir, save_interval_steps=save_every,
+                                 async_save=ckpt_async)
         t0 = time.perf_counter()
         restored = mngr.restore(state._replace(dcn_ef=None),
                                 layout=layout, topology=topology)
@@ -677,7 +684,8 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                     data_wait_s=t1 - t0, loss=loss,
                                     first=(i == 0))
                     if saved:
-                        rec.record_checkpoint_save(save_dt)
+                        rec.record_checkpoint_save(save_dt,
+                                                   async_mode=ckpt_async)
                 if (i == 0 and dcn_overlap is not None
                         and mesh.shape.get(dcn_overlap.axis, 1) > 1):
                     # One-shot exposed-comm attribution after the first
@@ -707,12 +715,16 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                    f"failed: {e}")
                 i += 1
         if mngr is not None:
+            # An in-flight async save must land before latest_step can
+            # answer whether the final step still needs saving.
+            mngr.wait_async()
             if mngr.latest_step() != cur:
                 ts = time.perf_counter()
                 mngr.save(cur, state._replace(dcn_ef=None), force=True,
                           layout=layout, cfg=cfg, topology=topology)
                 if rec is not None:
-                    rec.record_checkpoint_save(time.perf_counter() - ts)
+                    rec.record_checkpoint_save(time.perf_counter() - ts,
+                                               async_mode=ckpt_async)
             mngr.wait()
             mngr.close()
     finally:

@@ -72,12 +72,13 @@ def test_all_shipped_scenarios_validate():
         if fn.endswith(".json"):
             sc = chaos.load_scenario(os.path.join(chaos.SCENARIO_DIR, fn))
             names.add(sc["name"])
-    # The acceptance floor: a full matrix of at least eight scenarios,
+    # The acceptance floor: a full matrix of at least ten scenarios,
     # including the headline ones.
-    assert len(names) >= 8
+    assert len(names) >= 10
     assert {"worker-kill", "engine-hang", "hbm-exhaustion",
             "data-stall", "straggler", "health-storm",
-            "ckpt-kill", "slice-loss"} <= names
+            "ckpt-kill", "slice-loss", "prefill-pool-kill",
+            "preemption-schedule"} <= names
 
 
 def test_smoke_subset_is_bounded():
@@ -111,6 +112,9 @@ def test_scenario_schema_rejections(tmp_path):
     with pytest.raises(chaos.ScenarioError, match="loadgen_wait"):
         chaos.load_scenario(write(
             dict(base, phases=[{"action": "loadgen_wait", "id": "bg"}])))
+    with pytest.raises(chaos.ScenarioError, match="wait_log_record"):
+        chaos.load_scenario(write(
+            dict(base, phases=[{"action": "wait_log_record"}])))
 
 
 # ---------- assertion engine ----------
@@ -454,3 +458,53 @@ def test_e2e_kill_during_checkpoint_save_resumes(tmp_path):
     assert f"resumed from step {max(good)}" in out.stderr, \
         out.stderr[-2000:]
     assert any(".corrupt" in n for n in os.listdir(ckpt))
+
+
+# ---------- preemption-schedule assertion keys (ISSUE 14) ----------
+
+
+def test_check_train_async_budget_and_topology():
+    summary = {"final_step": 800,
+               "goodput": {"reshard": 0.2, "ckpt_async": 1.2,
+                           "goodput_fraction": 0.62},
+               "topology": {"processes": 2, "elastic_restarts": 4}}
+    spec = {"badput_max_s": {"ckpt_async": 2.0},
+            "final_processes": 2, "elastic_restarts_min": 4,
+            "goodput_fraction_min": 0.5, "resharded": True}
+    res = {r["name"]: r for r in chaos.check_train(summary, spec)}
+    assert res["train.badput_max.ckpt_async"]["ok"]
+    assert res["train.final_processes"]["ok"]
+    assert res["train.elastic_restarts"]["ok"]
+    assert res["train.goodput_fraction"]["ok"]
+    assert res["train.resharded"]["ok"]
+    # Over budget, shrunken cohort, and too few restarts all fail.
+    bad = {r["name"]: r for r in chaos.check_train(
+        {"final_step": 800,
+         "goodput": {"reshard": 0.2, "ckpt_async": 9.0,
+                     "goodput_fraction": 0.1},
+         "topology": {"processes": 1, "elastic_restarts": 1}}, spec)}
+    assert not bad["train.badput_max.ckpt_async"]["ok"]
+    assert not bad["train.final_processes"]["ok"]
+    assert not bad["train.elastic_restarts"]["ok"]
+    assert not bad["train.goodput_fraction"]["ok"]
+
+
+def test_check_ckpt_hygiene(tmp_path):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    (d / "10").mkdir()
+    (d / "20").mkdir()
+    spec = {"no_corrupt": True, "no_tmp": True, "steps_min": 2}
+    assert all(r["ok"] for r in chaos.check_ckpt(str(d), spec))
+    (d / "30.orbax-checkpoint-tmp-7").mkdir()
+    res = {r["name"]: r for r in chaos.check_ckpt(str(d), spec)}
+    assert not res["ckpt.no_tmp"]["ok"]
+    (d / "30.orbax-checkpoint-tmp-7").rmdir()
+    (d / "20.corrupt-123").mkdir()
+    res = {r["name"]: r for r in chaos.check_ckpt(str(d), spec)}
+    assert not res["ckpt.no_corrupt"]["ok"]
+    res = {r["name"]: r
+           for r in chaos.check_ckpt(str(d), {"steps_min": 3})}
+    assert not res["ckpt.steps"]["ok"]
+    missing = chaos.check_ckpt(str(tmp_path / "nope"), spec)
+    assert not missing[0]["ok"]

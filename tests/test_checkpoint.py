@@ -1,6 +1,8 @@
 """Checkpoint/resume: orbax roundtrip of a sharded TrainState, interval
 policy, resume-continues-training."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -308,4 +310,154 @@ def test_restore_explicit_step_still_fails_loudly(tmp_path, mesh8):
     _truncate_step_files(ckpt_dir, 2)
     with pytest.raises(Exception):
         mngr.restore(make_state(mesh8)[2], step=2)
+    mngr.close()
+
+
+# ---------- asynchronous checkpointing (ISSUE 14) ----------
+
+def test_async_save_is_donation_safe_and_restores(tmp_path, mesh8):
+    """Async mode: save() returns after the host-buffer snapshot; the
+    live state can then be DONATED to the next step without changing
+    what the background commit writes. Sequential saves serialize via
+    the in-flight join."""
+    cfg, opt, state = make_state(mesh8)
+    before = jax.device_get(state.params["layers"]["wq"])
+    mngr = CheckpointManager(str(tmp_path / "ckpt"),
+                             save_interval_steps=1, async_save=True)
+    assert mngr.save(1, state)
+    assert mngr.async_in_flight or mngr.latest_step() == 1
+    # Donate the live buffers while the background write is (possibly)
+    # still running against the snapshot.
+    step_fn = make_train_step(cfg, mesh8, opt)
+    batch = shard_batch(next(synthetic_batches(cfg.vocab_size, 8, 32)),
+                        mesh8)
+    state2, _ = step_fn(state, batch)
+    assert mngr.save(2, state2)          # joins save 1 first
+    assert mngr.wait_async()
+    mngr.wait()
+    assert mngr.latest_step() == 2
+    restored = mngr.restore(state2, step=1)
+    np.testing.assert_array_equal(
+        jax.device_get(restored.params["layers"]["wq"]), before)
+    mngr.close()
+
+
+def test_async_save_interval_policy_costs_nothing(tmp_path, mesh8):
+    """A skipped step must not snapshot or launch a thread."""
+    cfg, opt, state = make_state(mesh8)
+    mngr = CheckpointManager(str(tmp_path / "ckpt"),
+                             save_interval_steps=5, async_save=True)
+    # orbax's policy always takes the FIRST save; the interval applies
+    # from then on.
+    assert mngr.save(0, state)
+    mngr.wait()
+    assert not mngr.save(3, state)
+    assert not mngr.async_in_flight
+    assert mngr.save(5, state)
+    mngr.wait()
+    assert mngr.latest_step() == 5
+    mngr.close()
+
+
+_TORN_TAIL_CHILD = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+import jax
+from container_engine_accelerators_tpu.models import llama_tiny
+from container_engine_accelerators_tpu.parallel import MeshAxes, make_mesh
+from container_engine_accelerators_tpu.training import (
+    create_train_state, make_optimizer)
+from container_engine_accelerators_tpu.training.checkpoint import (
+    CheckpointManager)
+
+mesh = make_mesh(MeshAxes(dp=2, fsdp=2, sp=1, tp=2),
+                 devices=jax.devices())
+cfg = llama_tiny(vocab_size=64)
+opt = make_optimizer(warmup_steps=2, decay_steps=50)
+state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+mngr = CheckpointManager(sys.argv[1], save_interval_steps=1,
+                         async_save=True)
+assert mngr.save(1, state, force=True)
+assert mngr.wait_async()
+mngr.wait()
+assert mngr.latest_step() == 1
+# Widen the snapshot->commit window, then leave save 2 in flight.
+os.environ["TPU_CKPT_ASYNC_TEST_DELAY_S"] = "60"
+assert mngr.save(2, state, force=True)
+print("KILLME", flush=True)
+time.sleep(120)
+"""
+
+
+def test_async_torn_tail_sigkill_between_snapshot_and_commit(tmp_path):
+    """SIGKILL lands between the host-buffer snapshot and the orbax
+    commit (the TPU_CKPT_ASYNC_TEST_DELAY_S seam holds the background
+    writer there): restore falls back to the previous step, nothing is
+    torn or leaked, and the killed step is re-saveable."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    script = tmp_path / "child.py"
+    script.write_text(_TORN_TAIL_CHILD)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.Popen(
+        [_sys.executable, str(script), ckpt_dir, repo],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        line = ""
+        while "KILLME" not in line:
+            line = p.stdout.readline()
+            assert line, f"child died early (rc={p.poll()})"
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+    # Step 2 never committed; step 1 is intact; no wreckage.
+    names = set(os.listdir(ckpt_dir))
+    assert "1" in names and "2" not in names
+    assert not any(".corrupt" in n or "tmp" in n.lower() for n in names)
+
+    # The restarted run restores the previous step and can re-save the
+    # killed step.
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes, make_mesh,
+    )
+
+    mesh = make_mesh(MeshAxes(dp=2, fsdp=2, sp=1, tp=2),
+                     devices=jax.devices())
+    cfg, opt, state = make_state(mesh)
+    mngr = CheckpointManager(ckpt_dir, save_interval_steps=1)
+    assert mngr.latest_step() == 1
+    restored = mngr.restore(state)
+    assert restored is not None
+    np.testing.assert_array_equal(
+        jax.device_get(restored.params["layers"]["wq"]),
+        jax.device_get(state.params["layers"]["wq"]))
+    assert mngr.save(2, restored, force=True)
+    mngr.wait()
+    assert mngr.latest_step() == 2
+    mngr.close()
+
+
+def test_manager_init_sweeps_leaked_tmp_dirs(tmp_path, mesh8):
+    """A rank SIGKILLed mid-commit leaves an orbax tmp step dir; the
+    next manager init must sweep it (cleanup_tmp_directories) so torn
+    tails cannot accrete across preemptions."""
+    cfg, opt, state = make_state(mesh8)
+    ckpt = tmp_path / "ckpt"
+    mngr = CheckpointManager(str(ckpt), save_interval_steps=1)
+    assert mngr.save(1, state)
+    mngr.wait()
+    mngr.close()
+    leak = ckpt / "2.orbax-checkpoint-tmp-0"
+    leak.mkdir()
+    (leak / "junk").write_text("torn")
+    mngr = CheckpointManager(str(ckpt), save_interval_steps=1)
+    assert not leak.exists()
+    assert mngr.latest_step() == 1
     mngr.close()

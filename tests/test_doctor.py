@@ -832,3 +832,52 @@ def test_fault_listener_health_tail_runs_real_pipeline(tmp_path):
         assert _fl_wait(lambda: len(health_events()) >= 3)
     finally:
         listener.stop()
+
+
+def test_straggler_exempts_rank_with_async_save_in_flight(tmp_path):
+    """A watchdog stall on a rank whose newest ckpt/async_save instant
+    is an unmatched start is a background commit, not a straggler; the
+    exemption lifts once the end instant lands, and never applies to
+    elastic-sourced stalls (peer-DEATH evidence)."""
+    det = doctor.StragglerDetector()
+    inflight = [I("ckpt/async_save", 4.0, phase="start", step=7, process=3),
+                I("train/stalled", 5.0, process=3, age_s=42.0)]
+    assert det.check(sig(inflight, now=8.0)) == []
+    # The commit finished: the same stall is a straggler again.
+    done = inflight + [I("ckpt/async_save", 5.5, phase="end", step=7,
+                         process=3, ok=True),
+                       I("train/stalled", 6.0, process=3, age_s=43.0)]
+    found = det.check(sig(done, now=8.0))
+    assert classes(found) == ["straggler"]
+    assert found[0].subject == "process-3"
+    # Elastic-sourced stall: dead-pid evidence beats the exemption.
+    elastic_stall = [I("ckpt/async_save", 4.0, phase="start", step=7,
+                       process=3),
+                     I("train/stalled", 5.0, process=3, age_s=9.0,
+                       source="elastic")]
+    found = det.check(sig(elastic_stall, now=8.0))
+    assert classes(found) == ["straggler"]
+    assert found[0].subject == "process-3"
+
+
+def test_straggler_skew_suppressed_by_in_flight_save(tmp_path):
+    """Live heartbeat-skew naming is suppressed while the worst rank
+    has an async save in flight."""
+    det = doctor.StragglerDetector()
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    now = time.time()
+    for pid, age in ((0, 1.0), (1, 30.0)):
+        p = hb / f"hb-{pid}"
+        p.write_text(f"{pid} 7\n")
+        os.utime(p, (now - age, now - age))
+    evs = [I("ckpt/async_save", 9.0, phase="start", step=4, process=1)]
+    s = Signals(10.0, evs, small_cfg(), heartbeat_dir=str(hb), live=True)
+    assert det.check(s) == []
+    evs.append(I("ckpt/async_save", 9.5, phase="end", step=4, process=1,
+                 ok=True))
+    s = Signals(10.0, sorted(evs, key=lambda e: e["ts"]), small_cfg(),
+                heartbeat_dir=str(hb), live=True)
+    found = det.check(s)
+    assert classes(found) == ["straggler"]
+    assert found[0].subject == "process-1"

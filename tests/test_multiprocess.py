@@ -284,3 +284,154 @@ def test_two_process_elastic_resume(tmp_path):
     assert compared >= 10, (
         f"only {compared} post-resume steps compared against the "
         "reference trajectory")
+
+
+@pytest.mark.slow
+def test_two_process_elastic_scale_up(tmp_path):
+    """ISSUE 14 acceptance: after the shrink, CAPACITY RETURNS. rank1
+    is SIGKILLed; the survivor re-execs into the single-process
+    topology and reshards (as above). Then rank1 is relaunched with its
+    original environment: it announces its heartbeat before joining,
+    the survivor's scan_returned sees the original rank ticking again
+    and re-execs BACK into the full 2-process topology, resharding the
+    1-process checkpoint the other way. The run ends at the full step
+    target, at the FULL size, with two resharded restores on the log —
+    and the post-scale-up loss trajectory still matches a
+    single-process reference run (dp only split the batch)."""
+    steps = 600
+    out_dir = str(tmp_path)
+    port = free_port()
+
+    def spawn(rank):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", XLA_FLAGS="",
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(rank),
+                   JAX_NUM_SLICES="2", JAX_COORDINATOR_TIMEOUT_S="180")
+        log_path = os.path.join(out_dir, f"out{rank}.log")
+        return subprocess.Popen(
+            _train_argv(steps, out_dir, rank),
+            cwd=os.path.dirname(HERE), env=env,
+            stdout=open(log_path, "ab"), stderr=subprocess.STDOUT), \
+            log_path
+
+    ckpt = os.path.join(out_dir, "ckpt")
+
+    def ckpt_steps():
+        if not os.path.isdir(ckpt):
+            return []
+        return sorted(int(n) for n in os.listdir(ckpt) if n.isdigit())
+
+    def resharded_restores():
+        path = os.path.join(out_dir, "steps-0.jsonl")
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path, errors="replace") as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "restore" and rec.get("resharded"):
+                    out.append(rec)
+        return out
+
+    p0, log0 = spawn(0)
+    p1, _ = spawn(1)
+    procs = [p0, p1]
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not ckpt_steps():
+            assert p0.poll() is None, "rank0 died before ckpt"
+            time.sleep(0.5)
+        assert ckpt_steps(), "no checkpoint ever appeared"
+
+        # Preemption: rank1 goes away.
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not resharded_restores():
+            assert p0.poll() is None, "rank0 exited before reshard"
+            time.sleep(0.5)
+        assert resharded_restores(), "shrink reshard never logged"
+
+        # The shrunk world must COMMIT under its own topology tag
+        # before capacity returns, or the scale-up restore has nothing
+        # to reshard.
+        floor = max(ckpt_steps(), default=-1)
+        deadline = time.monotonic() + 240
+        while (time.monotonic() < deadline
+               and (not ckpt_steps() or max(ckpt_steps()) <= floor)):
+            assert p0.poll() is None, "rank0 exited before 1p commit"
+            time.sleep(0.5)
+        assert ckpt_steps() and max(ckpt_steps()) > floor
+
+        # Capacity returns: same rank id, same coordinator address.
+        p1, _ = spawn(1)
+        procs[1] = p1
+        rc1 = p1.wait(timeout=420)
+        rc0 = p0.wait(timeout=420)
+        assert rc1 == 0, open(
+            os.path.join(out_dir, "out1.log"),
+            errors="replace").read()[-2000:]
+        assert rc0 == 0, open(log0, errors="replace").read()[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    summary = _last_json_line(log0)
+    assert summary is not None, "no summary line from rank0"
+    assert summary["final_step"] == steps
+    # The whole point: the run ENDS at the full original size.
+    assert summary["topology"]["processes"] == 2
+    assert summary["topology"]["elastic_restarts"] == 2
+    g = summary["goodput"]
+    assert g["detection"] > 0 and g["restart"] > 0 and g["reshard"] > 0
+
+    restores = resharded_restores()
+    assert len(restores) >= 2, restores
+    resume_step = int(restores[-1]["step"])
+    assert resume_step < steps
+
+    # Post-scale-up trajectory vs a fresh single-process run: dp only
+    # split the batch, so the math must match across BOTH reshards.
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        read_metrics_jsonl,
+    )
+
+    records = read_metrics_jsonl(os.path.join(out_dir, "steps-0.jsonl"))
+    survivor_losses = {r["step"]: r["loss"] for r in records
+                       if r["kind"] == "step" and "loss" in r
+                       and r["step"] > resume_step}
+    assert survivor_losses, "no post-scale-up loss records"
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "JAX_NUM_SLICES",
+                "JAX_COORDINATOR_TIMEOUT_S"):
+        env.pop(var, None)
+    ref_log = str(ref_dir / "steps.jsonl")
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "container_engine_accelerators_tpu.cli.train",
+         "--steps", str(steps), "--batch-size", "8", "--seq-len", "64",
+         "--log-every", "1", "--metrics-log", ref_log],
+        cwd=os.path.dirname(HERE), env=env, capture_output=True,
+        text=True, timeout=360)
+    assert out.returncode == 0, out.stderr[-2000:]
+    ref_losses = {r["step"]: r["loss"]
+                  for r in read_metrics_jsonl(ref_log)
+                  if r["kind"] == "step" and "loss" in r}
+    compared = 0
+    for step, loss in survivor_losses.items():
+        if step in ref_losses:
+            assert loss == pytest.approx(ref_losses[step], rel=0.05), (
+                step, loss, ref_losses[step])
+            compared += 1
+    assert compared >= 10, (
+        f"only {compared} post-scale-up steps compared against the "
+        "reference trajectory")

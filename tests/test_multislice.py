@@ -667,3 +667,213 @@ def test_two_process_multislice_init_and_dp_sum(tmp_path):
         assert p.returncode == 0, f"worker failed:\n{out[-2000:]}"
     for out in outs:
         assert "total=28.0" in out, out[-500:]
+
+
+# ---------- elastic scale-up (ISSUE 14) ----------
+
+def test_plan_restart_env_stamps_original_topology_once():
+    """The FIRST shrink records the full topology in TPU_ELASTIC_ORIG_*;
+    a second shrink must not overwrite the true original with an
+    already-reduced world."""
+    base = {"JAX_COORDINATOR_ADDRESS": "127.0.0.1:8476",
+            "JAX_NUM_PROCESSES": "4", "JAX_PROCESS_ID": "1",
+            "JAX_NUM_SLICES": "2"}
+    env = elastic.plan_restart_env(dict(base), [0, 1], num_slices=2)
+    assert env["TPU_ELASTIC_ORIG_JAX_NUM_PROCESSES"] == "4"
+    assert env["TPU_ELASTIC_ORIG_JAX_NUM_SLICES"] == "2"
+    assert env["TPU_ELASTIC_ORIG_JAX_PROCESS_ID"] == "1"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    env2 = elastic.plan_restart_env(dict(env), [0], num_slices=1)
+    assert env2["TPU_ELASTIC_ORIG_JAX_NUM_PROCESSES"] == "4"
+    assert env2["TPU_ELASTIC_ORIG_JAX_NUM_SLICES"] == "2"
+    assert "JAX_NUM_PROCESSES" not in env2   # sole survivor
+
+
+def test_original_topology_and_plan_scaleup_env():
+    base = {"JAX_COORDINATOR_ADDRESS": "127.0.0.1:8476",
+            "JAX_NUM_PROCESSES": "4", "JAX_PROCESS_ID": "1",
+            "JAX_NUM_SLICES": "2", "OTHER": "kept"}
+    assert elastic.original_topology(base) is None   # never shrank
+    assert elastic.plan_scaleup_env(base) is None
+    shrunk = elastic.plan_restart_env(dict(base), [0, 1], num_slices=2)
+    shrunk[elastic.RESUME_STATE_ENV] = "/tmp/stale"
+    assert elastic.original_topology(shrunk) == (4, 2)
+    up = elastic.plan_scaleup_env(shrunk)
+    assert up["JAX_NUM_PROCESSES"] == "4"
+    assert up["JAX_NUM_SLICES"] == "2"
+    # The survivor restores the identity it held before the shrink.
+    assert up["JAX_PROCESS_ID"] == "1"
+    assert up["JAX_COORDINATOR_ADDRESS"] == "127.0.0.1:8476"
+    assert up["OTHER"] == "kept"
+    assert elastic.RESUME_STATE_ENV not in up
+    # Too incomplete to re-form the job: no coordinator address.
+    partial = {"TPU_ELASTIC_ORIG_JAX_NUM_PROCESSES": "4"}
+    assert elastic.plan_scaleup_env(partial) is None
+
+
+def test_reconcile_resume_topology_scale_up_direction():
+    """A stale --dcn-slices SMALLER than the env means capacity came
+    back; the env wins in both directions."""
+    slices, bs, notes = elastic.reconcile_resume_topology(1, 2, 8)
+    assert (slices, bs) == (2, 8)
+    assert len(notes) == 1 and "pre-scale-up" in notes[0]
+    slices, bs, notes = elastic.reconcile_resume_topology(3, 2, 8)
+    assert (slices, bs) == (2, 8) and "pre-loss" in notes[0]
+
+
+def test_scan_returned_counts_fresh_returner(tmp_path):
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own})
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0, num_processes=1,
+                                   threshold_s=30.0,
+                                   orig_num_processes=2,
+                                   orig_num_slices=2)
+    assert mon.scan_returned() == set()      # nothing announced yet
+    time.sleep(0.05)
+    _hb(tmp_path, {0: own, 1: own})          # fresh, post-monitor mtime
+    assert mon.scan_returned() == {1}
+
+
+def test_scan_returned_ignores_pre_shrink_leftovers(tmp_path):
+    """A survivor's own pre-shrink hb file has a LIVE pid (execve kept
+    it) but a frozen mtime — it must never count as returned
+    capacity."""
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own, 1: own})
+    old = time.time() - 5
+    os.utime(os.path.join(hb_dir, "hb-1"), (old, old))
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0, num_processes=1,
+                                   threshold_s=30.0,
+                                   orig_num_processes=2,
+                                   orig_num_slices=2)
+    assert mon.scan_returned() == set()
+
+
+def test_scan_returned_dead_writer_and_staleness(tmp_path):
+    own = os.getpid()
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    hb_dir = _hb(tmp_path, {0: own})
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0, num_processes=1,
+                                   threshold_s=30.0,
+                                   orig_num_processes=2,
+                                   orig_num_slices=2,
+                                   rejoin_fresh_s=10.0)
+    time.sleep(0.05)
+    # Fresh mtime but the writer is provably dead: the corpse of the
+    # loss this cohort already shrank around, not capacity.
+    _hb(tmp_path, {0: own, 1: p.pid})
+    assert mon.scan_returned() == set()
+    # Announced once then went away: post-monitor mtime but stale.
+    _hb(tmp_path, {0: own, 1: own})
+    mon._started_at = time.time() - 100
+    mid = time.time() - 50
+    os.utime(os.path.join(hb_dir, "hb-1"), (mid, mid))
+    assert mon.scan_returned() == set()
+
+
+def test_scan_returned_whole_slices_full_cohort_only(tmp_path):
+    """4 original ranks over 2 slices, shrunk to 2: one returning rank
+    of slice 1 is not capacity (its ICI domain is half-broken); both
+    back completes the original world and triggers."""
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own, 1: own})
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0, num_processes=2,
+                                   num_slices=1, threshold_s=30.0,
+                                   orig_num_processes=4,
+                                   orig_num_slices=2)
+    time.sleep(0.05)
+    _hb(tmp_path, {0: own, 1: own, 2: own})
+    assert mon.scan_returned() == set()
+    _hb(tmp_path, {0: own, 1: own, 2: own, 3: own})
+    assert mon.scan_returned() == {2, 3}
+
+
+def test_monitor_scale_up_trigger_via_on_return(tmp_path, monkeypatch):
+    """The on_return seam: a full-cohort return writes the scale-up
+    resume state (kind, targets, t_lost = when capacity became
+    visible) without exec'ing."""
+    monkeypatch.setenv("TPU_ELASTIC_ORIG_JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("TPU_ELASTIC_ORIG_JAX_NUM_SLICES", "2")
+    monkeypatch.setenv("TPU_ELASTIC_ORIG_JAX_COORDINATOR_ADDRESS",
+                       "127.0.0.1:9999")
+    own = os.getpid()
+    hb_dir = _hb(tmp_path, {0: own})
+    got = {}
+    mon = elastic.SliceLossMonitor(hb_dir, process_id=0, num_processes=1,
+                                   threshold_s=3600.0,
+                                   orig_num_processes=2,
+                                   orig_num_slices=2,
+                                   on_return=got.update)
+    time.sleep(0.05)
+    _hb(tmp_path, {0: own, 1: own})
+    assert mon.poll_once() == set()          # no loss; a return
+    assert got["kind"] == "scale_up"
+    assert got["returned"] == [1]
+    assert got["survivors"] == [0, 1]
+    assert got["target_num_processes"] == 2
+    assert got["target_num_slices"] == 2
+    assert got["pid"] == os.getpid()
+    assert mon._scale_up_disabled            # seam fires once
+    state_path = os.path.join(hb_dir, "elastic-resume-0.json")
+    assert json.load(open(state_path)) == got
+
+
+def test_consume_resume_state_discards_stale_files(tmp_path, monkeypatch):
+    """A resume-state file from another run (wrong pid), another
+    restart generation, or too old is discarded loudly and charges
+    NOTHING — its gap belongs to a previous run."""
+    from container_engine_accelerators_tpu.metrics.train_metrics import (
+        TrainRecorder,
+    )
+
+    now = time.time()
+    state = {"kind": "shrink", "t_lost": now - 3, "t_detect": now - 2,
+             "lost": [1], "survivors": [0], "prev_num_processes": 2,
+             "prev_num_slices": 2, "restarts": 1, "pid": os.getpid() + 1}
+    path = tmp_path / "resume.json"
+
+    def arm(**kw):
+        state.update(kw)
+        path.write_text(json.dumps(state))
+        monkeypatch.setenv(elastic.RESUME_STATE_ENV, str(path))
+
+    rec = TrainRecorder()
+    arm()
+    assert elastic.consume_resume_state(rec) is None      # wrong pid
+    arm(pid=os.getpid())
+    monkeypatch.setenv(elastic.RESTARTS_ENV, "2")
+    assert elastic.consume_resume_state(rec) is None      # wrong gen
+    arm(restarts=2,
+        t_detect=now - elastic.STALE_RESUME_MAX_AGE_S - 10)
+    assert elastic.consume_resume_state(rec) is None      # too old
+    g = rec.goodput()
+    assert g["detection"] == 0.0 and g["restart"] == 0.0
+    # All three checks lining up: consumed and charged.
+    arm(t_detect=now - 1, t_lost=now - 2)
+    got = elastic.consume_resume_state(rec)
+    assert got is not None and got["kind"] == "shrink"
+    assert rec.goodput()["detection"] > 0.0
+
+
+def test_pre_restart_hook_registry():
+    calls = []
+    un_a = elastic.register_pre_restart_hook(lambda: calls.append("a"))
+
+    def boom():
+        calls.append("boom")
+        raise RuntimeError("hook failure must not stop the sweep")
+
+    un_b = elastic.register_pre_restart_hook(boom)
+    un_c = elastic.register_pre_restart_hook(lambda: calls.append("c"))
+    try:
+        elastic._run_pre_restart_hooks()
+        assert calls == ["a", "boom", "c"]
+    finally:
+        un_a()
+        un_b()
+        un_c()
+        un_c()                              # double-unregister: no-op
+    calls.clear()
+    elastic._run_pre_restart_hooks()
+    assert calls == []

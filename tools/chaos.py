@@ -75,9 +75,9 @@ SCENARIO_DIR = os.path.join(_REPO, "chaos", "scenarios")
 _WORKLOAD_KINDS = ("serve", "train")
 _ACTIONS = ("sleep", "warmup", "loadgen", "loadgen_start", "loadgen_wait",
             "inject", "health_errors", "kill", "start", "wait_exit",
-            "wait_ckpt_steps", "corrupt_newest_ckpt")
+            "wait_ckpt_steps", "wait_log_record", "corrupt_newest_ckpt")
 _ASSERT_KEYS = ("doctor", "serve_gauges_baseline", "healthz",
-                "timeline_require", "train")
+                "timeline_require", "train", "ckpt")
 # Actions that mark the end of the clean phase: the first one to run
 # stamps fault_start, and the doctor assertion rejects any incident
 # diagnosed before it.
@@ -131,6 +131,10 @@ def load_scenario(path: str) -> dict:
             raise ScenarioError(
                 f"{sc['name']}: action {act} targets unknown workload "
                 f"{tgt!r}")
+        if act == "wait_log_record" and not ph.get("kind"):
+            raise ScenarioError(
+                f"{sc['name']}: wait_log_record needs a 'kind' (the "
+                "step-log record kind to wait for)")
         if act == "loadgen_start":
             lg_ids.add(ph.get("id", "bg"))
         if act == "loadgen_wait" and ph.get("id", "bg") not in lg_ids:
@@ -373,6 +377,28 @@ def check_train(summary: dict | None, spec: dict,
             frac >= float(spec["goodput_fraction_min"]),
             f"goodput_fraction={frac:.3f}, need >= "
             f"{spec['goodput_fraction_min']}"))
+    for bucket, max_s in spec.get("badput_max_s", {}).items():
+        got = float(g.get(bucket, 0.0))
+        out.append(_result(
+            f"{label}.badput_max.{bucket}", got <= float(max_s),
+            f"goodput[{bucket}]={got:.3f}s, need <= {max_s}s (this "
+            "bucket's cost must stay off the step path)"))
+    topo = summary.get("topology", {})
+    if "final_processes" in spec:
+        got = int(topo.get("processes", -1))
+        out.append(_result(
+            f"{label}.final_processes",
+            got == int(spec["final_processes"]),
+            f"topology.processes={got}, need "
+            f"{spec['final_processes']} (the cohort must END at the "
+            "full size — scale-up actually happened)"))
+    if "elastic_restarts_min" in spec:
+        got = int(topo.get("elastic_restarts", 0))
+        out.append(_result(
+            f"{label}.elastic_restarts",
+            got >= int(spec["elastic_restarts_min"]),
+            f"topology.elastic_restarts={got}, need >= "
+            f"{spec['elastic_restarts_min']}"))
     badput = {k: round(float(v), 3) for k, v in g.items()
               if k not in ("productive", "elapsed", "goodput_fraction")
               and isinstance(v, (int, float)) and v > 0}
@@ -380,6 +406,38 @@ def check_train(summary: dict | None, spec: dict,
         f"{label}.goodput_report", True,
         f"goodput_fraction={g.get('goodput_fraction')} "
         f"elapsed={g.get('elapsed')}s badput={badput}"))
+    return out
+
+
+def check_ckpt(ckpt_dir: str, spec: dict) -> list[dict]:
+    """(d) checkpoint hygiene after the whole schedule: zero torn or
+    leaked state. `no_corrupt` — no quarantined step dirs (*.corrupt*)
+    survived to the end (a restore that hit a torn save renames it
+    aside; finding one here means a save tore and nothing re-wrote the
+    step); `no_tmp` — no uncommitted orbax tmp dirs (a crash mid-save
+    leaves one; it must never be visible as state); `steps_min` — at
+    least N committed steps remain restorable."""
+    out = []
+    try:
+        names = sorted(os.listdir(ckpt_dir))
+    except OSError as e:
+        return [_result("ckpt.dir", False, f"{ckpt_dir}: {e}")]
+    if spec.get("no_corrupt"):
+        bad = [n for n in names if ".corrupt" in n]
+        out.append(_result(
+            "ckpt.no_corrupt", not bad,
+            f"quarantined checkpoint(s) left behind: {bad}" if bad
+            else "zero quarantined (torn) checkpoints"))
+    if spec.get("no_tmp"):
+        bad = [n for n in names if "tmp" in n.lower()]
+        out.append(_result(
+            "ckpt.no_tmp", not bad,
+            f"uncommitted tmp dir(s) left behind: {bad}" if bad
+            else "zero uncommitted tmp dirs"))
+    if "steps_min" in spec:
+        steps = [n for n in names if n.isdigit()]
+        out.append(_check_count("ckpt.steps", len(steps),
+                                {"min": int(spec["steps_min"])}))
     return out
 
 
@@ -821,17 +879,73 @@ class ScenarioRun:
             wl = self._wl(ph)
             need = int(ph.get("min_steps", 2))
             deadline = time.monotonic() + float(ph.get("timeout_s", 300))
+            # beyond_latest: wait for a checkpoint STRICTLY NEWER than
+            # whatever is committed right now.  A plain count can't
+            # express "the re-joined topology has saved under its own
+            # tag yet" because max_to_keep prunes old steps, so the
+            # directory count saturates.
+            floor = max(wl.ckpt_steps(), default=-1) \
+                if ph.get("beyond_latest") else None
             while time.monotonic() < deadline:
-                if len(wl.ckpt_steps()) >= need:
+                steps = wl.ckpt_steps()
+                if floor is not None:
+                    if steps and max(steps) > floor:
+                        return
+                elif len(steps) >= need:
                     return
                 if wl.proc.poll() is not None:
                     raise RuntimeError(
-                        f"{wl.id} exited before writing {need} "
-                        "checkpoints")
+                        f"{wl.id} exited before writing "
+                        + (f"a checkpoint past step {floor}"
+                           if floor is not None
+                           else f"{need} checkpoints"))
                 time.sleep(0.5)
             raise RuntimeError(
-                f"{wl.id}: {need} checkpoints never appeared "
-                f"(have {wl.ckpt_steps()})")
+                f"{wl.id}: "
+                + (f"no checkpoint past step {floor} ever appeared "
+                   if floor is not None else
+                   f"{need} checkpoints never appeared ")
+                + f"(have {wl.ckpt_steps()})")
+        elif act == "wait_log_record":
+            # Poll a train workload's step log (crash-safe JSONL that
+            # PERSISTS across elastic re-execs — same path, same pid)
+            # for records of a kind, e.g. a resharded restore. This is
+            # how the preemption schedule sequences on the SURVIVOR's
+            # progress: its Popen handle never exits (execve keeps the
+            # pid), so wait_exit can't sequence the middle of the run.
+            wl = self._wl(ph)
+            kind = ph["kind"]
+            where = ph.get("where", {})
+            need = int(ph.get("count", 1))
+            deadline = time.monotonic() + float(ph.get("timeout_s", 300))
+            while True:
+                got = 0
+                try:
+                    with open(wl.metrics_log) as f:
+                        for ln in f:
+                            try:
+                                rec = json.loads(ln)
+                            except json.JSONDecodeError:
+                                continue  # torn tail mid-write
+                            if rec.get("kind") != kind:
+                                continue
+                            if all(rec.get(k) == v
+                                   for k, v in where.items()):
+                                got += 1
+                except OSError:
+                    got = 0
+                if got >= need:
+                    return
+                if wl.proc is not None and wl.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{wl.id} exited (rc={wl.proc.returncode}) "
+                        f"before logging {need} {kind!r} record(s) "
+                        f"matching {where} (have {got})")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{wl.id}: {need} {kind!r} record(s) matching "
+                        f"{where} never appeared (have {got})")
+                time.sleep(0.5)
         elif act == "corrupt_newest_ckpt":
             corrupt_newest_checkpoint(self._wl(ph).ckpt_dir())
 
@@ -944,6 +1058,14 @@ class ScenarioRun:
         if "timeline_require" in asserts:
             self.results.extend(
                 check_timeline(timeline, asserts["timeline_require"]))
+        ckpt_spec = asserts.get("ckpt")
+        if ckpt_spec is not None:
+            seen = set()
+            for wl in self.workloads.values():
+                d = wl.ckpt_dir() if wl.kind == "train" else None
+                if d and d not in seen:  # ranks share one ckpt dir
+                    seen.add(d)
+                    self.results.extend(check_ckpt(d, ckpt_spec))
         doc_spec = asserts.get("doctor")
         if doc_spec is not None:
             inc_dir = os.path.join(self.out_dir, "incidents")

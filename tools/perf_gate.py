@@ -564,6 +564,61 @@ def _matmul_bench():
     return "matmul_scan_ms", measure, None
 
 
+def _ckpt_async_bench():
+    """('ckpt_async_stall_ms', ...): the STEP-PATH cost of an
+    asynchronous checkpoint save — the host-buffer snapshot plus the
+    bounded wait for the previous in-flight save. The serialize +
+    rank-0 commit run on the writer thread OUTSIDE the timed region
+    (drained between passes), exactly as they overlap productive steps
+    in the real loop. This is the number that must stay near zero for
+    async checkpointing to be a win; the sync path would put the whole
+    orbax save here instead."""
+    import tempfile
+
+    import jax
+
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes, make_mesh,
+    )
+    from container_engine_accelerators_tpu.training import (
+        create_train_state, make_optimizer,
+    )
+    from container_engine_accelerators_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    cfg = llama.llama_tiny()
+    mesh = make_mesh(MeshAxes(dp=1, fsdp=1, sp=1, tp=1),
+                     devices=jax.devices()[:1])
+    opt = make_optimizer(warmup_steps=2, decay_steps=100)
+    state = create_train_state(jax.random.key(0), cfg, mesh, opt)
+    tmpdir = tempfile.mkdtemp(prefix="perf_gate_ckpt_async_")
+    mngr = CheckpointManager(tmpdir, save_interval_steps=1,
+                             async_save=True)
+    step_box = [0]
+    # Warmup: the first save pays one-time orbax setup (metadata
+    # store, step-dir creation) that must not land in the window.
+    step_box[0] += 1
+    mngr.save(step_box[0], state, force=True)
+    mngr.wait_async()
+
+    def measure(n_steps: int):
+        times = []
+        for _ in range(n_steps):
+            step_box[0] += 1
+            t0 = time.perf_counter()
+            mngr.save(step_box[0], state, force=True)
+            times.append(time.perf_counter() - t0)
+            # The commit is OFF the step path by design: drain it
+            # outside the timed region so every pass measures the
+            # dispatch cost, not the previous pass's backlog.
+            mngr.wait_async()
+        return times, harness.pct_ms(times)
+
+    return "ckpt_async_stall_ms", measure, None
+
+
 def _multislice_env_enabled(default: bool) -> bool:
     raw = os.environ.get(MULTISLICE_ENV, "auto").strip().lower()
     if raw in ("1", "true", "yes", "on"):
@@ -681,7 +736,8 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
 
     benches = [_train_bench(), _decode_bench(paged=False),
                _decode_bench(paged=True), _matmul_bench(),
-               _prefill_cached_bench(), _decode_under_prefill_bench()]
+               _prefill_cached_bench(), _decode_under_prefill_bench(),
+               _ckpt_async_bench()]
     metrics: dict = {}
     results: list = []
     with harness.RecompileGuard() as guard:
