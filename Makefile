@@ -210,11 +210,22 @@ preemption-smoke:
 	    tests/test_multiprocess.py::test_two_process_elastic_scale_up \
 	    -q -m "slow or not slow"
 
+# Speculative decoding + weight/KV quantization smoke (ISSUE 15):
+# drafter/verifier unit contracts, the rollback invariant, greedy
+# token-identity of speculative generate() and both serving engines
+# against their non-speculative selves (incl. rejection-heavy prompts),
+# int8-weight fused-dequant exactness + perplexity bound, int4 KV
+# round-trip + kernel-vs-fallback parity, and the acceptance-rate
+# recorder plumbing. Fast tier-1.
+spec-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec_decode.py \
+	    tests/test_kv_quant.py -q
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
     serve-pools-smoke multislice-smoke dcn-overlap-smoke \
-    preemption-smoke chaos-smoke
+    preemption-smoke spec-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -229,4 +240,4 @@ clean:
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
-    dcn-overlap-smoke preemption-smoke smoke dryrun clean
+    dcn-overlap-smoke preemption-smoke spec-smoke smoke dryrun clean

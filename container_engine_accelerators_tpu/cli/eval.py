@@ -21,6 +21,12 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--batches", type=int, default=50)
+    p.add_argument("--weight-dtype", choices=["bf16", "int8"],
+                   default="bf16",
+                   help="int8: evaluate through the int8 quantize/"
+                        "dequantize round trip — the quality gate for "
+                        "serving with --weight-dtype int8 (the decode "
+                        "path fuses the identical dequant)")
     args = p.parse_args(argv)
 
     import jax
@@ -40,6 +46,13 @@ def main(argv=None) -> int:
 
     initialize_from_env()
     params, cfg = load_model(args.checkpoint)
+    if args.weight_dtype == "int8":
+        from container_engine_accelerators_tpu.ops.quant import (
+            dequantize_llama_params,
+            quantize_llama_params,
+        )
+        params = dequantize_llama_params(quantize_llama_params(params),
+                                         cfg.param_dtype)
     mesh = make_mesh()
     state = TrainState(step=jax.numpy.zeros((), jax.numpy.int32),
                        params=params, opt_state=None)
@@ -49,6 +62,7 @@ def main(argv=None) -> int:
         num_processes=jax.process_count(),
         num_batches=args.batches)
     report = evaluate(state, cfg, mesh, batches)
+    report["weight_dtype"] = args.weight_dtype
     print(json.dumps(report))
     return 0
 

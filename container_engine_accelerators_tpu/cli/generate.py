@@ -32,6 +32,20 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel ways over the local chips "
                         "(models/decode_tp.py)")
+    p.add_argument("--speculate", choices=["off", "ngram", "draft"],
+                   default="off",
+                   help="speculative decoding (greedy only; output is "
+                        "token-identical to off — models/spec.py)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per verify pass")
+    p.add_argument("--draft-layers", type=int, default=2,
+                   help="--speculate draft: layers in the truncated "
+                        "self-draft model")
+    p.add_argument("--weight-dtype", choices=["bf16", "int8"],
+                   default="bf16",
+                   help="int8: per-output-channel weight quantization "
+                        "with dequant fused into the decode matmuls "
+                        "(ops/quant.py)")
     args = p.parse_args(argv)
 
     import jax
@@ -62,6 +76,12 @@ def main(argv=None) -> int:
         ids = [1]
     prompt = jnp.asarray([ids], jnp.int32)
 
+    if args.weight_dtype == "int8":
+        from container_engine_accelerators_tpu.ops.quant import (
+            quantize_llama_params,
+        )
+        params = quantize_llama_params(params)
+
     mesh = None
     if args.tp > 1:
         from container_engine_accelerators_tpu.models import decode_tp
@@ -71,7 +91,9 @@ def main(argv=None) -> int:
     key = jax.random.key(args.seed) if args.temperature > 0 else None
     t0 = time.perf_counter()
     out = dec.generate(params, prompt, cfg, args.max_new_tokens,
-                       temperature=args.temperature, key=key, mesh=mesh)
+                       temperature=args.temperature, key=key, mesh=mesh,
+                       speculate=args.speculate, spec_k=args.spec_k,
+                       draft_layers=args.draft_layers)
     out_ids = [int(t) for t in out[0]]
     dt = time.perf_counter() - t0
     print("token ids:", out_ids)

@@ -157,13 +157,24 @@ def _maybe_injected_hang(engine):
 class BatchingEngine:
     def __init__(self, params, cfg, max_batch: int = 8,
                  window_ms: float = 5.0, max_prompt_len: int = 1024,
-                 mesh=None, recorder: RequestRecorder | None = None):
+                 mesh=None, recorder: RequestRecorder | None = None,
+                 speculate: str = "off", spec_k: int = 4,
+                 draft_layers: int = 2):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
         self.window = window_ms / 1000.0
         self.max_prompt_len = max_prompt_len
         self.mesh = _use_mesh(mesh)
+        # Speculative decoding (models/spec.py): the window engine
+        # delegates to generate()'s speculative loop per batch. Greedy
+        # batches only — a sampled batch falls back to the plain loop
+        # (the greedy-identity contract is the whole point) — and the
+        # tp speculative path lives in the continuous/paged engines, so
+        # a meshed window engine also falls back.
+        self.speculate = speculate
+        self.spec_k = spec_k
+        self.draft_layers = draft_layers
         # One recorder can be shared across engines/processes' registry;
         # by default each engine owns a private one.
         self.recorder = recorder if recorder is not None \
@@ -320,11 +331,23 @@ class BatchingEngine:
             try:
                 key_arr = (jax.random.key(int(time.time_ns()) & 0xFFFF)
                            if temp > 0 else None)
+                spec = (self.speculate
+                        if temp <= 0 and self.mesh is None else "off")
+                stats: dict = {}
                 with annotate("serve/decode_tick"):
                     out = generate(self.params, tokens, self.cfg, n_new,
                                    temperature=temp, key=key_arr,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh, speculate=spec,
+                                   spec_k=self.spec_k,
+                                   draft_layers=self.draft_layers,
+                                   spec_stats=stats)
                     out_host = [[int(t) for t in row] for row in out]
+                if stats:
+                    rec.observe_spec(
+                        drafted=stats.get("drafted", 0),
+                        accepted=stats.get("accepted", 0),
+                        verifies=stats.get("verifies", 0),
+                        committed=stats.get("committed", 0))
                 batch_dt = time.monotonic() - t_batch
                 for item, row in zip(batch, out_host):
                     rid = item[5]
@@ -440,12 +463,24 @@ class ContinuousEngine:
                  max_len: int = 2048, prompt_bucket: int = 64,
                  max_prompt_len: int = 1024, prefill_chunk: int = 0,
                  prefill_workers: int = 0, mesh=None,
-                 recorder: RequestRecorder | None = None):
+                 recorder: RequestRecorder | None = None,
+                 speculate: str = "off", spec_k: int = 4,
+                 draft_layers: int = 2):
         from container_engine_accelerators_tpu.models.decode import (
             _kernel_eligible,
         )
 
         self.params = params
+        # Speculative decoding (models/spec.py): a tick where every
+        # decoding slot is greedy and has k+1 positions of headroom
+        # drafts spec_k tokens per slot and scores them in ONE verify
+        # pass; anything else falls back to the plain one-token tick.
+        # Both executables stay warm, so mixed traffic never recompiles.
+        self.speculate = speculate
+        self.spec_k = spec_k
+        self.draft_layers = draft_layers
+        self.spec_ticks_run = 0
+        self._spec_tick = False
         self.recorder = recorder if recorder is not None \
             else RequestRecorder()
         self._rid = itertools.count(1)
@@ -591,23 +626,69 @@ class ContinuousEngine:
 
     # ---------- engine hooks (overridden by the paged engine) ----------
 
+    def _weights_quantized(self) -> bool:
+        from container_engine_accelerators_tpu.ops.quant import QuantWeight
+        return isinstance(self.params.get("lm_head"), QuantWeight)
+
     def _make_fns(self):
         from container_engine_accelerators_tpu.models.decode import (
             _jitted_decode_step_slots,
             _jitted_prefill_suffix_slot,
         )
 
+        qw = self._weights_quantized()
         if self.mesh is not None:
             from container_engine_accelerators_tpu.models import decode_tp
             self.params = decode_tp.shard_decode_params(
                 self.params, self.mesh, self.cfg)
             self._step_fn = decode_tp.jitted_decode_step_slots(
-                self.cfg, self.mesh)
+                self.cfg, self.mesh, quantized_weights=qw)
             self._chunk_fn = decode_tp.jitted_prefill_suffix_slot(
-                self.cfg, self.mesh)
+                self.cfg, self.mesh, quantized_weights=qw)
         else:
             self._step_fn = _jitted_decode_step_slots(self.cfg)
             self._chunk_fn = _jitted_prefill_suffix_slot(self.cfg)
+        self._make_spec_fns(paged=False)
+
+    def _make_spec_fns(self, paged: bool):
+        """Verify/commit executables for the speculative tick, plus the
+        truncated self-draft model when --speculate draft. The draft
+        cache is a plain SLOT cache even under the paged engine: the
+        drafter is tiny (draft_layers of the model), so a full
+        slots x max_len reservation for it is cheap and keeps the page
+        machinery single-tenant."""
+        if self.speculate == "off":
+            return
+        from container_engine_accelerators_tpu.models import decode
+
+        if self.mesh is not None:
+            from container_engine_accelerators_tpu.models import decode_tp
+            self._verify_fn = decode_tp.jitted_verify_step(
+                self.cfg, self.mesh, paged=paged,
+                quantized_weights=self._weights_quantized())
+        else:
+            self._verify_fn = decode._jitted_verify_step(self.cfg)
+        self._adv_fn = decode._jitted_advance_lengths()
+        if self.speculate != "draft":
+            return
+        import dataclasses
+
+        from container_engine_accelerators_tpu.models import spec as spec_mod
+        n_draft = max(1, min(self.draft_layers, self.cfg.n_layers - 1))
+        self._draft_cfg = dataclasses.replace(self.cfg, n_layers=n_draft)
+        self._draft_params = spec_mod.truncate_params(self.params, n_draft)
+        if self.mesh is not None:
+            from container_engine_accelerators_tpu.models import decode_tp
+            qw = self._weights_quantized()
+            self._draft_step_fn = decode_tp.jitted_decode_step_slots(
+                self._draft_cfg, self.mesh, quantized_weights=qw)
+            self._draft_chunk_fn = decode_tp.jitted_prefill_suffix_slot(
+                self._draft_cfg, self.mesh, quantized_weights=qw)
+        else:
+            self._draft_step_fn = decode._jitted_decode_step_slots(
+                self._draft_cfg)
+            self._draft_chunk_fn = decode._jitted_prefill_suffix_slot(
+                self._draft_cfg)
 
     def _fresh_state(self):
         from container_engine_accelerators_tpu.models.decode import (
@@ -622,6 +703,25 @@ class ContinuousEngine:
         else:
             self._cache = init_slot_cache(self.cfg, self.max_slots,
                                           self.max_len)
+        self._fresh_draft_state()
+
+    def _fresh_draft_state(self):
+        if self.speculate != "draft":
+            return
+        from container_engine_accelerators_tpu.models.decode import (
+            init_slot_cache,
+        )
+
+        def factory():
+            return init_slot_cache(self._draft_cfg, self.max_slots,
+                                   self.max_len)
+
+        if self.mesh is not None:
+            from container_engine_accelerators_tpu.models import decode_tp
+            self._draft_cache = decode_tp.init_sharded_cache(
+                factory, self.mesh)
+        else:
+            self._draft_cache = factory()
 
     def _admit_one(self, item, slot_idx) -> bool:
         """Register the request in a free slot (compute deferred to the
@@ -658,8 +758,29 @@ class ContinuousEngine:
         and the decode step that follows writes position len — which
         needs the next page allocated in this same iteration or the
         first generated token's KV lands in the trash row.
-        False = a device error was handled; skip the decode tick."""
+        False = a device error was handled; skip the decode tick.
+
+        Also decides whether the COMING tick speculates — the decision
+        must precede the tick so the paged override can allocate the
+        verify write window's pages before the verify runs."""
+        self._spec_tick = self._want_spec_tick()
         return True
+
+    def _want_spec_tick(self) -> bool:
+        """Speculate this tick iff every decoding slot is greedy and
+        has room for the verify's k+1 uncommitted writes, and at least
+        one slot still wants more than one token (a one-token tail is
+        cheaper on the plain tick)."""
+        if self.speculate == "off":
+            return False
+        k1 = self.spec_k + 1
+        dec = [sl for sl in self._slots
+               if sl is not None and not sl["pending"]]
+        if not dec:
+            return False
+        return (all(sl["temp"] <= 0 and sl["len"] + k1 <= self.max_len
+                    for sl in dec)
+                and any(sl["remaining"] > 1 for sl in dec))
 
     def _release_slot(self, slot_idx: int) -> None:
         pass
@@ -886,6 +1007,17 @@ class ContinuousEngine:
         t_chunk = time.monotonic()
         try:
             last_logits = self._run_chunk(i, padded, start, new_len)
+            if self.speculate == "draft":
+                # Mirror the chunk into the drafter's slot cache so its
+                # prefix matches the main cache position-for-position.
+                # On a paged prefix-cache hit the shared pages' tokens
+                # were never forwarded, so the draft cache keeps zeros
+                # there — drafts degrade, the verifier keeps the output
+                # exact (wrong drafts are rejected, never emitted).
+                _, self._draft_cache = self._draft_chunk_fn(
+                    self._draft_params, self._draft_cache, jnp.int32(i),
+                    jnp.asarray(padded, jnp.int32), jnp.int32(start),
+                    jnp.int32(new_len))
         except Exception as e:
             # OOM forensics bundle before recovery tears the pool down:
             # _reset frees/rebuilds the cache, destroying the evidence.
@@ -931,6 +1063,10 @@ class ContinuousEngine:
         import jax
         import jax.numpy as jnp
 
+        if self._spec_tick:
+            self._spec_tick = False
+            if self._spec_decode_tick():
+                return
         decoding = [sl is not None and not sl["pending"]
                     for sl in self._slots]
         if not any(decoding):
@@ -971,6 +1107,113 @@ class ContinuousEngine:
             _stream_event(sl["stream"], {"token": toks[i]}, sl["rid"])
             if sl["remaining"] <= 0:
                 self._finish(i)
+
+    def _spec_decode_tick(self) -> bool:
+        """One draft+verify+commit round over every decoding slot:
+        spec_k drafts per slot, ONE k+1-wide verify pass over the main
+        model, host-side greedy acceptance, one advance_lengths commit.
+        Returns False (having run nothing) when ngram drafting found no
+        candidate anywhere — the plain tick is strictly cheaper then.
+        The token stream is IDENTICAL to the plain tick: a draft token
+        is only emitted when it equals the verifier's argmax at its
+        position, and rejected writes sit beyond the committed lengths
+        where later writes overwrite them (rollback is free)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from container_engine_accelerators_tpu.models import spec as spec_mod
+
+        s = self.max_slots
+        k = self.spec_k
+        decoding = [sl is not None and not sl["pending"]
+                    for sl in self._slots]
+        drafts = np.zeros((s, k), np.int32)
+        if self.speculate == "ngram":
+            got = False
+            for i, sl in enumerate(self._slots):
+                if not decoding[i]:
+                    continue
+                d = spec_mod.ngram_draft(sl["out"], k)
+                drafts[i, :len(d)] = d
+                got = got or bool(d)
+            if not got:
+                return False  # no lookup hit anywhere: plain tick wins
+        active_arr = jnp.asarray(decoding, bool)
+        t_step = time.monotonic()
+        try:
+            if self.speculate == "draft":
+                cur = jnp.asarray(self._last_tok, jnp.int32)
+                for j in range(k):
+                    dlogits, self._draft_cache = self._draft_step_fn(
+                        self._draft_params, self._draft_cache, cur,
+                        active_arr)
+                    cur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                    drafts[:, j] = np.asarray(cur)
+            tokens = np.concatenate(
+                [np.asarray(self._last_tok, np.int32)[:, None], drafts],
+                axis=1)
+            logits, self._cache = self._verify_fn(
+                self.params, self._cache, jnp.asarray(tokens), active_arr)
+            greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        except Exception as e:
+            introspection.note_failure(e, "serve/decode_tick")
+            log.exception("speculative verify failed")
+            self._reset(e)
+            return True
+        counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+        # Draft mode never commits the bonus token: its K/V is absent
+        # from the draft cache (the drafter stepped only k times), so
+        # committing it would desync the caches — it is re-derived as
+        # the next round's first verify logit instead.
+        cap = k if self.speculate == "draft" else k + 1
+        commit = np.zeros(s, np.int32)
+        emitted: dict = {}
+        for i, sl in enumerate(self._slots):
+            if not decoding[i]:
+                continue
+            a = int(counts[i]) - 1
+            seq = [int(t) for t in tokens[i, 1:1 + a]] + [int(bonus[i])]
+            c = min(len(seq), cap, sl["remaining"])
+            commit[i] = c
+            emitted[i] = seq[:c]
+        try:
+            self._cache = self._adv_fn(self._cache, jnp.asarray(commit),
+                                       active_arr)
+            if self.speculate == "draft":
+                # Length IS the sync — the draft cache's prefix matches
+                # the main cache token-for-token. .copy() because the
+                # draft step donates its cache: a donated alias of the
+                # main cache's length buffer would delete it.
+                self._draft_cache = self._draft_cache._replace(
+                    length=self._cache.length.copy())
+        except Exception as e:
+            introspection.note_failure(e, "serve/decode_tick")
+            log.exception("speculative commit failed")
+            self._reset(e)
+            return True
+        t_tick = time.monotonic() - t_step
+        self.steps_run += 1
+        self.batches_run = self.steps_run
+        self.spec_ticks_run += 1
+        self.recorder.observe_decode_step(t_tick)
+        self._budget.note_decode(t_tick)
+        n_dec = sum(decoding)
+        self.recorder.observe_spec(
+            drafted=n_dec * k,
+            accepted=int(counts[np.asarray(decoding)].sum()) - n_dec,
+            verifies=n_dec, committed=int(commit.sum()))
+        for i in list(emitted):
+            sl = self._slots[i]
+            for tok in emitted[i]:
+                sl["out"].append(tok)
+                sl["len"] = min(sl["len"] + 1, self.max_len)
+                self._last_tok[i] = tok
+                sl["remaining"] -= 1
+                self.recorder.decode_token(sl["rid"])
+                _stream_event(sl["stream"], {"token": tok}, sl["rid"])
+            if sl["remaining"] <= 0:
+                self._finish(i)
+        return True
 
     def _finish(self, i: int):
         sl = self._slots[i]
@@ -1041,7 +1284,9 @@ class PagedContinuousEngine(ContinuousEngine):
                  max_prompt_len: int = 1024, prefix_cap: int = 256,
                  prefill_chunk: int = 0, prefill_workers: int = 0,
                  mesh=None,
-                 recorder: RequestRecorder | None = None):
+                 recorder: RequestRecorder | None = None,
+                 speculate: str = "off", spec_k: int = 4,
+                 draft_layers: int = 2):
         import math
 
         from container_engine_accelerators_tpu.models.decode import (
@@ -1087,7 +1332,8 @@ class PagedContinuousEngine(ContinuousEngine):
                          max_prompt_len=max_prompt_len,
                          prefill_chunk=prefill_chunk,
                          prefill_workers=prefill_workers, mesh=mesh,
-                         recorder=recorder)
+                         recorder=recorder, speculate=speculate,
+                         spec_k=spec_k, draft_layers=draft_layers)
         assert self.max_len == self.max_pages * self.page
 
     def submit(self, tokens, max_new_tokens, temperature, stream=None):
@@ -1137,14 +1383,15 @@ class PagedContinuousEngine(ContinuousEngine):
             _jitted_set_slot_pages,
         )
 
+        qw = self._weights_quantized()
         if self.mesh is not None:
             from container_engine_accelerators_tpu.models import decode_tp
             self.params = decode_tp.shard_decode_params(
                 self.params, self.mesh, self.cfg)
             self._step_fn = decode_tp.jitted_decode_step_paged(
-                self.cfg, self.mesh)
+                self.cfg, self.mesh, quantized_weights=qw)
             self._chunk_fn = decode_tp.jitted_prefill_suffix_paged(
-                self.cfg, self.mesh)
+                self.cfg, self.mesh, quantized_weights=qw)
         else:
             self._step_fn = _jitted_decode_step_paged(self.cfg)
             self._chunk_fn = _jitted_prefill_suffix_paged(self.cfg)
@@ -1152,6 +1399,7 @@ class PagedContinuousEngine(ContinuousEngine):
         # (pools pass through untouched, so GSPMD keeps their sharding).
         self._set_pages_fn = _jitted_set_slot_pages()
         self._assign_fn = _jitted_assign_pages()
+        self._make_spec_fns(paged=True)
 
     def _fresh_state(self):
         from container_engine_accelerators_tpu.models.decode import (
@@ -1172,6 +1420,7 @@ class PagedContinuousEngine(ContinuousEngine):
             self._cache = factory()
         self._alloc = PageAllocator(self.pool_pages)
         self._index = PrefixIndex(self._alloc, cap=self.prefix_cap)
+        self._fresh_draft_state()
 
     def _try_alloc(self, n):
         """alloc with prefix-index eviction under pressure: retained
@@ -1298,9 +1547,30 @@ class PagedContinuousEngine(ContinuousEngine):
             self._index.insert(sl["keys"][j], sl["rows"][j])
 
     def _pre_step(self) -> bool:
-        """Give every decoding slot whose next write crosses into an
-        unallocated page a fresh page (one masked scatter); preempts
-        on exhaustion. False = a device error was handled."""
+        """Give every decoding slot whose coming writes cross into
+        unallocated pages fresh pages (masked scatters); preempts on
+        exhaustion. False = a device error was handled.
+
+        A speculative tick writes positions [len, len + spec_k] BEFORE
+        committing, so the page lookahead must cover the whole verify
+        window — growth runs in rounds of at most one page per slot
+        until every decoding slot's window is backed (two rounds only
+        when spec_k spans a page boundary)."""
+        self._spec_tick = self._want_spec_tick()
+        lookahead = self.spec_k if self._spec_tick else 0
+        while True:
+            grew = self._grow_pages_round(lookahead)
+            if grew is None:
+                return False
+            if not grew:
+                return True
+
+    def _grow_pages_round(self, lookahead: int):
+        """One masked-scatter round of page growth: each decoding slot
+        whose write window [len, len + lookahead] extends past its
+        allocated pages gets ONE page. Returns True if a scatter ran
+        (caller loops), False when nothing was needed, None on a
+        handled device error."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -1312,11 +1582,13 @@ class PagedContinuousEngine(ContinuousEngine):
         for i, sl in enumerate(self._slots):
             if sl is None or sl["pending"]:
                 continue  # prefilling slots hold all their pages already
-            pg = sl["len"] // page
-            if pg < len(sl["rows"]):
-                continue  # current page still has room
-            if pg >= self.max_pages:
-                continue  # at logical capacity; write clamps
+            # Highest page index the window touches, clamped to logical
+            # capacity (writes past it clamp in-kernel).
+            target = min((sl["len"] + lookahead) // page,
+                         self.max_pages - 1)
+            pg = len(sl["rows"])  # next unallocated page index
+            if pg > target:
+                continue  # window already backed
             row = None
             while row is None and self._slots[i] is not None:
                 got = self._try_alloc(1)
@@ -1346,16 +1618,17 @@ class PagedContinuousEngine(ContinuousEngine):
             mask[i] = True
             pos[i] = pg
             rws[i] = row
-        if mask.any():
-            try:
-                self._cache = self._assign_fn(
-                    self._cache, jnp.asarray(pos), jnp.asarray(rws),
-                    jnp.asarray(mask))
-            except Exception as e:
-                introspection.note_failure(e, "serve/assign_pages")
-                log.exception("assign_pages failed")
-                self._reset(e)
-                return False
+        if not mask.any():
+            return False
+        try:
+            self._cache = self._assign_fn(
+                self._cache, jnp.asarray(pos), jnp.asarray(rws),
+                jnp.asarray(mask))
+        except Exception as e:
+            introspection.note_failure(e, "serve/assign_pages")
+            log.exception("assign_pages failed")
+            self._reset(e)
+            return None
         return True
 
 class EngineSupervisor:
@@ -1649,16 +1922,42 @@ def main(argv=None) -> int:
                         "(models/decode_tp.py): weights, KV cache and "
                         "per-layer compute shard over a 'tp' mesh axis")
     p.add_argument("--quantize-int8", action="store_true",
-                   help="serve int8-quantized weights (halves weight HBM "
-                        "traffic on the decode path)")
-    p.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16",
+                   help="deprecated alias for --weight-dtype int8")
+    p.add_argument("--weight-dtype", choices=("bf16", "int8"),
+                   default="bf16",
+                   help="int8: per-output-channel int8 weight storage "
+                        "with dequant FUSED into the projection matmuls "
+                        "(ops/quant.py int8_matmul) — halves weight HBM "
+                        "traffic on every decode step; works under "
+                        "--tp > 1 (scales shard with their weight "
+                        "shards)")
+    p.add_argument("--kv-dtype", choices=("bf16", "int8", "int4"),
+                   default="bf16",
                    help="KV-cache storage dtype for ALL engines: int8 "
                         "stores K/V as int8 with per-(token, head) f32 "
                         "scales and dequantizes inside the decode "
                         "kernels — roughly halves decode-step cache HBM "
                         "traffic and doubles the slots that fit "
-                        "(tools/hbm_plan.py prices it); orthogonal to "
-                        "--quantize-int8, which quantizes WEIGHTS")
+                        "(tools/hbm_plan.py prices it); int4 packs two "
+                        "4-bit values per byte (quarter traffic, lossier "
+                        "— run cli/eval before shipping); orthogonal to "
+                        "--weight-dtype, which quantizes WEIGHTS")
+    p.add_argument("--speculate", choices=("off", "ngram", "draft"),
+                   default="off",
+                   help="speculative decoding for greedy requests: "
+                        "draft spec_k tokens (ngram = prompt-lookup, no "
+                        "extra weights; draft = a --draft-layers "
+                        "truncation of the model), score them in ONE "
+                        "verify pass, emit the accepted prefix plus the "
+                        "verifier's bonus token. Token stream is "
+                        "IDENTICAL to off; only tokens-per-pass changes. "
+                        "Ticks with any sampled (temperature > 0) slot "
+                        "fall back to the plain step")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per verify pass (--speculate)")
+    p.add_argument("--draft-layers", type=int, default=2,
+                   help="--speculate draft: layers in the truncated "
+                        "self-draft model")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve request-lifecycle Prometheus metrics "
                         "(TTFT/TPOT/queue-wait histograms, slot and KV "
@@ -1753,18 +2052,26 @@ def main(argv=None) -> int:
         # cfg), and the tp cache specs all read it.
         import dataclasses
         cfg = dataclasses.replace(cfg, kv_cache_dtype=args.kv_dtype)
-        log.info("serving an int8 KV cache (fused in-kernel dequant)")
-    if args.quantize_int8:
-        if args.tp > 1:
-            p.error("--quantize-int8 is not supported with --tp > 1")
+        log.info("serving an %s KV cache (fused in-kernel dequant)",
+                 args.kv_dtype)
+    if args.quantize_int8:  # legacy alias
+        args.weight_dtype = "int8"
+    if args.weight_dtype == "int8":
         if cfg.n_experts:
-            p.error("--quantize-int8 is not supported for MoE models "
-                    "(expert weights have no int8 decode path yet)")
+            p.error("--weight-dtype int8 is not supported for MoE "
+                    "models (expert weights have no int8 decode path "
+                    "yet)")
         from container_engine_accelerators_tpu.ops.quant import (
             quantize_llama_params,
         )
         params = quantize_llama_params(params)
-        log.info("serving int8-quantized weights")
+        log.info("serving int8-quantized weights (dequant fused into "
+                 "the projection matmuls)")
+    if args.speculate != "off":
+        if args.spec_k < 1:
+            p.error("--spec-k must be >= 1")
+        log.info("speculative decoding on: %s drafting, k=%d",
+                 args.speculate, args.spec_k)
 
     mesh = None
     if args.tp > 1:
@@ -1773,6 +2080,8 @@ def main(argv=None) -> int:
         log.info("tensor-parallel over %d chips", args.tp)
 
     recorder = RequestRecorder()
+    spec_kw = dict(speculate=args.speculate, spec_k=args.spec_k,
+                   draft_layers=args.draft_layers)
     if args.engine == "paged":
         engine = PagedContinuousEngine(
             params, cfg, max_slots=args.max_batch, max_len=args.max_len,
@@ -1780,17 +2089,17 @@ def main(argv=None) -> int:
             prefix_cap=args.prefix_cache_cap,
             prefill_chunk=args.prefill_chunk,
             prefill_workers=args.prefill_workers, mesh=mesh,
-            recorder=recorder)
+            recorder=recorder, **spec_kw)
     elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len,
                                   prefill_chunk=args.prefill_chunk,
                                   prefill_workers=args.prefill_workers,
-                                  mesh=mesh, recorder=recorder)
+                                  mesh=mesh, recorder=recorder, **spec_kw)
     else:
         engine = BatchingEngine(params, cfg, max_batch=args.max_batch,
                                 window_ms=args.batch_window_ms, mesh=mesh,
-                                recorder=recorder)
+                                recorder=recorder, **spec_kw)
     # Runtime introspection (metrics/introspection.py): compile
     # tracking on — the engines' jitted step paths are watch()-wrapped
     # in models/decode*.py, so a steady-state recompile logs the shape
@@ -1810,7 +2119,8 @@ def main(argv=None) -> int:
             introspection.set_expected_hbm(plan_serving(
                 cfg, tp=args.tp, max_slots=args.max_batch,
                 max_len=args.max_len, pool_fraction=frac,
-                kv_dtype=args.kv_dtype, chip=_detect_chip()))
+                kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                chip=_detect_chip()))
         except Exception:
             log.debug("hbm_plan expectation unavailable", exc_info=True)
     if args.doctor:

@@ -195,6 +195,38 @@ class RequestRecorder:
             "Prefill-pool workers replaced by the supervisor after an "
             "unexpected death (serve --prefill-workers --supervise); "
             "partial recovery — no request fails", registry=reg)
+        # Speculative decoding (ISSUE 15): drafted/accepted counters
+        # plus the two derived gauges every acceptance dashboard wants.
+        # One "verify" = one slot scored in one verify pass (a batched
+        # pass over 4 slots counts 4), so tokens-per-verify is the
+        # per-request speedup factor, not a batch-size artifact.
+        self.spec_drafted = Counter(
+            "serve_spec_drafted_tokens",
+            "Draft tokens proposed to the verifier", registry=reg)
+        self.spec_accepted = Counter(
+            "serve_spec_accepted_tokens",
+            "Draft tokens accepted by greedy verification (excludes "
+            "the bonus token every verify pass yields)", registry=reg)
+        self.spec_verifies = Counter(
+            "serve_spec_verifies",
+            "Slot-verify passes (one per active slot per speculative "
+            "tick)", registry=reg)
+        self.spec_committed = Counter(
+            "serve_spec_committed_tokens",
+            "Tokens emitted by speculative ticks (accepted drafts plus "
+            "bonus tokens, after caps)", registry=reg)
+        self.spec_acceptance_rate = Gauge(
+            "serve_spec_acceptance_rate",
+            "accepted / drafted over this process's lifetime",
+            registry=reg)
+        self.spec_tokens_per_verify = Gauge(
+            "serve_spec_tokens_per_verify",
+            "committed tokens per verify pass (1.0 = speculation is "
+            "pure overhead; k+1 = every draft accepted)", registry=reg)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_verifies = 0
+        self._spec_committed = 0
         self._prefix_lookups = 0
         self._prefix_hits = 0
 
@@ -358,6 +390,32 @@ class RequestRecorder:
                 self.prefix_misses.inc()
             self.prefix_hit_rate.set(
                 self._prefix_hits / self._prefix_lookups)
+
+    def observe_spec(self, drafted: int, accepted: int, verifies: int,
+                     committed: int) -> None:
+        """One speculative verify tick: `drafted`/`accepted` draft
+        tokens over `verifies` slot-verify passes, emitting `committed`
+        tokens total. Counters and the derived gauges move together
+        under one lock so a scrape never sees a torn ratio."""
+        with self._lock:
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+            self._spec_verifies += verifies
+            self._spec_committed += committed
+            self.spec_drafted.inc(drafted)
+            self.spec_accepted.inc(accepted)
+            self.spec_verifies.inc(verifies)
+            self.spec_committed.inc(committed)
+            if self._spec_drafted:
+                self.spec_acceptance_rate.set(
+                    self._spec_accepted / self._spec_drafted)
+            if self._spec_verifies:
+                self.spec_tokens_per_verify.set(
+                    self._spec_committed / self._spec_verifies)
+            if events.enabled():
+                events.counter("serve/spec", {
+                    "drafted": self._spec_drafted,
+                    "accepted": self._spec_accepted})
 
     def observe_prefill_chunk(self, tokens: int) -> None:
         """One forwarded prompt chunk — the prefill pool's progress

@@ -23,8 +23,10 @@ from container_engine_accelerators_tpu.ops import rms_norm, rope_frequencies
 from container_engine_accelerators_tpu.ops.quant import (
     QuantWeight,
     dequantize_kv,
+    dequantize_kv_int4,
     int8_matmul,
     quantize_kv,
+    quantize_kv_int4,
 )
 from container_engine_accelerators_tpu.ops.rope import apply_rope
 
@@ -68,12 +70,14 @@ class PagedKVCache(NamedTuple):
 
 def _kv_dtype(cfg: LlamaConfig):
     """The cache storage dtype cfg asks for (decode-path gate for the
-    int8 KV mode; llama.py validates the field on the training path)."""
-    if cfg.kv_cache_dtype == "int8":
+    int8/int4 KV modes; llama.py validates the field on the training
+    path). Int4 also stores int8 — two nibbles per byte — so callers
+    that need the mode (not the storage dtype) use _storage_token."""
+    if cfg.kv_cache_dtype in ("int8", "int4"):
         return jnp.int8
     if cfg.kv_cache_dtype != "bf16":
         raise ValueError(
-            f"kv_cache_dtype must be 'bf16' or 'int8', got "
+            f"kv_cache_dtype must be 'bf16', 'int8' or 'int4', got "
             f"{cfg.kv_cache_dtype!r}")
     return cfg.dtype
 
@@ -82,15 +86,42 @@ def _is_int8(dtype) -> bool:
     return jnp.dtype(dtype) == jnp.int8
 
 
+def _storage_token(arr: jnp.ndarray, cfg: LlamaConfig):
+    """The dtype token describing how `arr` (a cache K/V array) stores
+    its payload: the literal string 'int4' for nibble-packed caches
+    (int8 storage at half head_dim — the shape IS the mode bit, so a
+    cache always carries its own truth), else the array dtype. Feeds
+    init_cache's dtype override so temp prefill caches match the slot
+    cache they scatter into."""
+    if _is_int8(arr.dtype) and arr.shape[-1] == cfg.head_dim // 2:
+        return "int4"
+    return arr.dtype
+
+
+def _storage_layout(cfg: LlamaConfig, dtype):
+    """(storage dtype, payload width) for a cache allocation. `dtype`
+    None defers to cfg.kv_cache_dtype; the literal string 'int4'
+    (a _storage_token) selects the nibble-packed layout explicitly."""
+    if dtype is None:
+        return _kv_dtype(cfg), (cfg.head_dim // 2
+                                if cfg.kv_cache_dtype == "int4"
+                                else cfg.head_dim)
+    if isinstance(dtype, str) and dtype == "int4":
+        return jnp.int8, cfg.head_dim // 2
+    return dtype, cfg.head_dim
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
                dtype=None, n_kv_heads: int | None = None) -> KVCache:
     """`n_kv_heads` overrides cfg's count — the tensor-parallel path
     allocates per-shard caches holding only the shard's local KV heads.
     `dtype` overrides cfg.kv_cache_dtype/cfg.dtype; int8 (explicit or
-    via cfg) allocates the per-(token, head) f32 scale planes too."""
-    dtype = dtype or _kv_dtype(cfg)
+    via cfg) allocates the per-(token, head) f32 scale planes too, and
+    the 'int4' token allocates the nibble-packed payload at half
+    head_dim (same scale planes)."""
+    dtype, d_store = _storage_layout(cfg, dtype)
     hkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
-    shape = (cfg.n_layers, batch, max_len, hkv, cfg.head_dim)
+    shape = (cfg.n_layers, batch, max_len, hkv, d_store)
     ks = vs = None
     if _is_int8(dtype):
         sshape = (cfg.n_layers, batch, hkv, max_len)
@@ -105,8 +136,8 @@ def init_paged_cache(cfg: LlamaConfig, slots: int, n_pages: int,
                      page: int, max_pages: int, dtype=None) -> PagedKVCache:
     """n_pages POOL pages (row 0 reserved as trash) shared by `slots`
     slots of logical capacity max_pages * page tokens each."""
-    dtype = dtype or _kv_dtype(cfg)
-    shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+    dtype, d_store = _storage_layout(cfg, dtype)
+    shape = (cfg.n_layers, n_pages, page, cfg.n_kv_heads, d_store)
     ks = vs = None
     if _is_int8(dtype):
         sshape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page)
@@ -132,7 +163,8 @@ def _kernel_eligible(cfg: LlamaConfig) -> bool:
 
 
 def _paged_attention(q, k_pool, v_pool, cache_len, tables,
-                     cfg: LlamaConfig, k_scales=None, v_scales=None):
+                     cfg: LlamaConfig, k_scales=None, v_scales=None,
+                     int4: bool = False):
     """Paged-path attention: q [slots, T, Hq, D]; pools
     [n_pages, page, Hkv, D]; tables [slots, max_pages]. The pallas paged
     kernel indirects pool rows through the table; off-TPU the pages are
@@ -141,7 +173,10 @@ def _paged_attention(q, k_pool, v_pool, cache_len, tables,
     only matters where the kernel runs anyway). k_scales/v_scales
     ([n_pages, Hkv, page] f32) switch on the int8 cache: the kernel
     dequantizes page tiles in VMEM, the fallback gathers the scale
-    pages through the same tables and dequantizes on read."""
+    pages through the same tables and dequantizes on read. int4 marks
+    nibble-packed pools (payload D//2) — gathering packed bytes through
+    the tables is layout-transparent, so the fallback just swaps in the
+    int4 unpack."""
     from container_engine_accelerators_tpu.ops import decode_attention as da
 
     if _kernel_eligible(cfg) and da.paged_supported(q, k_pool,
@@ -150,7 +185,7 @@ def _paged_attention(q, k_pool, v_pool, cache_len, tables,
         return da.paged_decode_attention(q, k_pool, v_pool, cache_len,
                                          tables, interpret=interpret,
                                          k_scales=k_scales,
-                                         v_scales=v_scales)
+                                         v_scales=v_scales, int4=int4)
     slots, max_pages = tables.shape
     n_pages, page, hkv, d = k_pool.shape
     k_c = k_pool[tables].reshape(slots, max_pages * page, hkv, d)
@@ -162,11 +197,11 @@ def _paged_attention(q, k_pool, v_pool, cache_len, tables,
         vs_c = v_scales[tables].transpose(0, 2, 1, 3).reshape(
             slots, hkv, max_pages * page)
     return _cached_attention(q, k_c, v_c, cache_len, cfg,
-                             k_scales=ks_c, v_scales=vs_c)
+                             k_scales=ks_c, v_scales=vs_c, int4=int4)
 
 
 def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig,
-                      k_scales=None, v_scales=None):
+                      k_scales=None, v_scales=None, int4: bool = False):
     """q: [B, T, Hq, D] for T new tokens at positions
     [cache_len, cache_len+T); caches: [B, max_len, Hkv, D].
 
@@ -178,17 +213,21 @@ def _cached_attention(q, k_cache, v_cache, cache_len, cfg: LlamaConfig,
     k_scales/v_scales ([B, Hkv, max_len] f32) mark an int8 cache. The
     kernel fuses the dequant into its VMEM loads; this fallback
     dequantizes on read with the SAME scale multiply, so kernel
-    eligibility can never change semantics — only speed."""
+    eligibility can never change semantics — only speed. int4 marks a
+    nibble-packed cache (payload D//2); the kernel fuses the SAME
+    unpack_int4 the fallback dequant uses."""
     from container_engine_accelerators_tpu.ops import decode_attention as da
 
     if _kernel_eligible(cfg) and da.supported(q, k_cache):
         interpret = jax.default_backend() != "tpu"
         return da.decode_attention(q, k_cache, v_cache, cache_len,
                                    interpret=interpret,
-                                   k_scales=k_scales, v_scales=v_scales)
+                                   k_scales=k_scales, v_scales=v_scales,
+                                   int4=int4)
     if k_scales is not None:
-        k_cache = dequantize_kv(k_cache, k_scales, q.dtype)
-        v_cache = dequantize_kv(v_cache, v_scales, q.dtype)
+        dq = dequantize_kv_int4 if int4 else dequantize_kv
+        k_cache = dq(k_cache, k_scales, q.dtype)
+        v_cache = dq(v_cache, v_scales, q.dtype)
     b, t, hq, d = q.shape
     max_len = k_cache.shape[1]
     n_rep = hq // k_cache.shape[2]
@@ -300,10 +339,19 @@ def _moe_ffn_decode(h2: jnp.ndarray, lp: dict, cfg: LlamaConfig,
 
 def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
                 cfg: LlamaConfig, active: jnp.ndarray | None = None,
-                tp_axis: str | None = None
+                tp_axis: str | None = None, advance: bool = True
                 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T new tokens ([B, T], T static — 1 for decode, prompt length for
     prefill). Returns (logits [B, T, vocab] float32, updated cache).
+
+    `advance=False` (static) is the speculative VERIFY mode: K/V for all
+    T positions are written and attended as usual, but lengths do NOT
+    move — the caller commits only the accepted prefix afterwards via
+    advance_lengths, which makes the un-advanced tail writes garbage by
+    construction (liveness is the length, and any position < the
+    committed length was written by this very call with the correct
+    token). Rejected positions need no erase: they sit beyond the live
+    length, masked by position, and the next append overwrites them.
 
     cache.length may be a scalar (classic batched path: every row at the
     same position) or a [B] vector (continuous-batching slots: every row
@@ -329,10 +377,14 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
     else:
         max_len = cache.k.shape[2]
     dt = cfg.dtype
-    # Int8 KV mode keys off the CACHE, not cfg: whoever allocated the
-    # cache (init_*_cache honoring cfg.kv_cache_dtype, or an explicit
-    # dtype override) decided, and a mismatch would corrupt silently.
-    quantized = _is_int8((cache.k_pool if paged else cache.k).dtype)
+    # Int8/int4 KV mode keys off the CACHE, not cfg: whoever allocated
+    # the cache (init_*_cache honoring cfg.kv_cache_dtype, or an
+    # explicit dtype override) decided, and a mismatch would corrupt
+    # silently. Int4 is int8 storage at half head_dim (_storage_token).
+    storage = cache.k_pool if paged else cache.k
+    quantized = _is_int8(storage.dtype)
+    int4 = quantized and storage.shape[-1] == cfg.head_dim // 2
+    quantize_new = quantize_kv_int4 if int4 else quantize_kv
     per_slot = jnp.ndim(cache.length) > 0
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
     if per_slot:
@@ -354,12 +406,17 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         outputs are partial sums under tensor parallelism."""
         n = h.shape[0] * h.shape[1]
         if isinstance(w, QuantWeight):
-            if tp_axis is not None:
-                raise NotImplementedError(
-                    "int8-quantized weights are not supported on the "
-                    "tensor-parallel decode path yet")
+            # Under tp the shard's QuantWeight is self-consistent:
+            # column-sharded weights carry their local output channels'
+            # scales, row-sharded weights carry the FULL (replicated)
+            # scales — per-output-channel scales are constant across
+            # contraction rows, so shard-dequant-then-psum is exact
+            # (decode_tp.decode_param_specs derives the scale specs).
             out = int8_matmul(h.reshape(n, -1), w, interpret=interpret)
-            return out.reshape(h.shape[0], h.shape[1], -1)
+            out = out.reshape(h.shape[0], h.shape[1], -1)
+            if reduce and tp_axis is not None:
+                out = jax.lax.psum(out, tp_axis)
+            return out
         out = h @ w.astype(h.dtype)
         if reduce and tp_axis is not None:
             out = jax.lax.psum(out, tp_axis)
@@ -391,9 +448,10 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             # Quantize the appended tokens and scatter values + scales
             # through the same (row, offset) pairs — inactive slots'
             # scales land in the trash row alongside their values.
-            q_vals, q_scales = quantize_kv(new)  # [B,T,h,d], [B,h,T]
+            # Int4 packs to d//2 here, matching the pool payload width.
+            q_vals, q_scales = quantize_new(new)  # [B,T,h,d*], [B,h,T]
             pool = pool.at[w_rows.reshape(-1), w_offs.reshape(-1)].set(
-                q_vals.reshape(b * t, *hkv_d))
+                q_vals.reshape(b * t, *q_vals.shape[2:]))
             spool = spool.at[w_rows.reshape(-1), :,
                              w_offs.reshape(-1)].set(
                 q_scales.transpose(0, 2, 1).reshape(b * t, -1))
@@ -403,7 +461,8 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             if quantized:
                 return _paged_attention(q, k_pool, v_pool, att_len,
                                         cache.tables, cfg,
-                                        k_scales=ks, v_scales=vs)
+                                        k_scales=ks, v_scales=vs,
+                                        int4=int4)
             return _paged_attention(q, k_pool.astype(dt),
                                     v_pool.astype(dt), att_len,
                                     cache.tables, cfg)
@@ -419,7 +478,7 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
                                 c, new, row_len), None
                 return jax.lax.dynamic_update_slice(
                     c, new.astype(c.dtype), (0, cache.length, 0, 0)), None
-            q_vals, q_scales = quantize_kv(new)  # [B,T,h,d], [B,h,T]
+            q_vals, q_scales = quantize_new(new)  # [B,T,h,d*], [B,h,T]
             if per_slot:
                 c = jax.vmap(
                     lambda cb, nb, st: jax.lax.dynamic_update_slice(
@@ -437,7 +496,8 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
         def attend(q, k_cache, v_cache, ks, vs):
             if quantized:
                 return _cached_attention(q, k_cache, v_cache, att_len,
-                                         cfg, k_scales=ks, v_scales=vs)
+                                         cfg, k_scales=ks, v_scales=vs,
+                                         int4=int4)
             return _cached_attention(q, k_cache.astype(dt),
                                      v_cache.astype(dt), att_len, cfg)
 
@@ -478,13 +538,16 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if isinstance(params["lm_head"], QuantWeight):
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "int8-quantized lm_head unsupported on the tp decode path")
         n = b * t
         logits = int8_matmul(
             x.reshape(n, -1).astype(jnp.float32), params["lm_head"],
             interpret=interpret).reshape(b, t, -1)
+        if tp_axis is not None:
+            # Vocab-column-sharded like the bf16 branch: the shard's
+            # scales cover its local vocab slice, so the gather below
+            # concatenates already-dequantized logits.
+            logits = jax.lax.all_gather(logits, tp_axis, axis=2,
+                                        tiled=True)
     else:
         logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                             params["lm_head"].astype(jnp.float32))
@@ -494,11 +557,14 @@ def decode_step(params: dict, cache: KVCache, tokens: jnp.ndarray,
             # this moves B*V floats — trivial next to the matmul.
             logits = jax.lax.all_gather(logits, tp_axis, axis=2,
                                         tiled=True)
-    new_len = cache.length + t
-    if per_slot:
-        new_len = jnp.minimum(cache.length + t, max_len)
-        if active is not None:
-            new_len = jnp.where(active, new_len, cache.length)
+    if advance:
+        new_len = cache.length + t
+        if per_slot:
+            new_len = jnp.minimum(cache.length + t, max_len)
+            if active is not None:
+                new_len = jnp.where(active, new_len, cache.length)
+    else:
+        new_len = cache.length
     if paged:
         new_cache = PagedKVCache(k_pool=new_k, v_pool=new_v,
                                  tables=cache.tables, length=new_len,
@@ -558,7 +624,7 @@ def prefill_slot(params: dict, cache: KVCache, slot: jnp.ndarray,
     # cache, so the same code serves the replicated, tp-sharded, and
     # int8-quantized paths (the temp cache quantizes its writes the
     # same way the slot cache does).
-    tmp = init_cache(cfg, 1, tp, dtype=cache.k.dtype,
+    tmp = init_cache(cfg, 1, tp, dtype=_storage_token(cache.k, cfg),
                      n_kv_heads=cache.k.shape[3])
     logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
                               tp_axis=tp_axis)
@@ -670,7 +736,7 @@ def prefill_slot_paged(params: dict, cache: PagedKVCache,
     page = cache.page
     n_pg = tp // page
     hkv = cache.k_pool.shape[3]   # local count under tp sharding
-    tmp = init_cache(cfg, 1, tp, dtype=cache.k_pool.dtype,
+    tmp = init_cache(cfg, 1, tp, dtype=_storage_token(cache.k_pool, cfg),
                      n_kv_heads=hkv)
     logits, tmp = decode_step(params, tmp, tokens[None, :], cfg,
                               tp_axis=tp_axis)
@@ -758,6 +824,68 @@ def assign_pages(cache: PagedKVCache, page_pos: jnp.ndarray,
     cur = cache.tables[idx, page_pos]
     new = jnp.where(mask, rows.astype(jnp.int32), cur)
     return cache._replace(tables=cache.tables.at[idx, page_pos].set(new))
+
+
+# ---------- speculative decoding (verify/commit) API ----------
+#
+# Draft-then-verify (Leviathan et al. 2023): the engine proposes k
+# tokens (models/spec.py drafters), verify_step scores all k+1
+# positions in ONE model pass, and advance_lengths commits only the
+# accepted prefix. The rollback invariant: liveness IS the per-slot
+# length — verify writes K/V for every candidate position, and
+# rejected positions simply stay beyond the committed length (masked
+# by position, overwritten by the next append), so rollback costs
+# nothing. Greedy verification makes the output token-identical to the
+# non-speculative engine. Acceptance count is TRACED (advance_lengths
+# takes it as data) and k is static, so accept/reject outcomes never
+# retrace anything.
+
+
+def verify_step(params: dict, cache, tokens: jnp.ndarray,
+                active: jnp.ndarray | None, cfg: LlamaConfig,
+                tp_axis: str | None = None):
+    """Score k+1 speculative candidates in one pass: tokens [B, K+1] =
+    [last committed-but-uncached token, draft_1..draft_k] per row.
+    Returns (logits [B, K+1, vocab] f32, cache with the candidates' K/V
+    WRITTEN but lengths UNCHANGED). Works on slot and paged caches
+    alike (paged: the engine must pre-assign pages covering
+    length + K + 1 before calling — same assign_pages plumbing as the
+    normal tick's lookahead). Commit the accepted prefix afterwards
+    with advance_lengths."""
+    return decode_step(params, cache, tokens, cfg, active=active,
+                       tp_axis=tp_axis, advance=False)
+
+
+def advance_lengths(cache, counts: jnp.ndarray,
+                    active: jnp.ndarray | None = None):
+    """Commit `counts` verified tokens per row ([B] int32, or a scalar
+    for the scalar-length cache): lengths advance, nothing else moves.
+    The pair (verify_step, advance_lengths) is two executables instead
+    of one so the acceptance count stays DATA — one compile covers
+    every accept/reject outcome (the perf gate asserts this)."""
+    paged = isinstance(cache, PagedKVCache)
+    if paged:
+        max_len = cache.tables.shape[1] * cache.page
+    else:
+        max_len = cache.k.shape[2]
+    new_len = jnp.minimum(cache.length + counts.astype(jnp.int32),
+                          max_len)
+    if active is not None:
+        new_len = jnp.where(active, new_len, cache.length)
+    return cache._replace(length=new_len)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_verify_step(cfg: LlamaConfig):
+    return _watched_jit(
+        jax.jit(functools.partial(verify_step, cfg=cfg),
+                donate_argnums=(1,)), "verify_step")
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_advance_lengths():
+    return _watched_jit(
+        jax.jit(advance_lengths, donate_argnums=(0,)), "advance_lengths")
 
 
 class PageAllocator:
@@ -1014,14 +1142,43 @@ def _jitted_decode_step(cfg: LlamaConfig):
 def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
              max_new_tokens: int, max_len: int | None = None,
              temperature: float = 0.0,
-             key: jax.Array | None = None, mesh=None) -> jnp.ndarray:
+             key: jax.Array | None = None, mesh=None,
+             speculate: str = "off", spec_k: int = 4,
+             draft_layers: int = 2,
+             spec_stats: dict | None = None) -> jnp.ndarray:
     """Greedy (temperature=0) or sampled generation. prompt: [B, T0].
     Returns [B, T0 + max_new_tokens]. With temperature > 0 and no `key`,
     a fixed default key is used (deterministic sampling).
 
     `mesh` (with a 'tp' axis > 1) runs every step tensor-parallel over
     the mesh — params must already be placed by
-    decode_tp.shard_decode_params (or arrive replicated; jit reshards)."""
+    decode_tp.shard_decode_params (or arrive replicated; jit reshards).
+
+    `speculate` ('ngram' or 'draft') turns on speculative decoding:
+    greedy verification makes the token stream IDENTICAL to
+    speculate='off' at temperature 0 — only the number of model passes
+    changes. 'ngram' drafts by prompt-lookup (models/spec.ngram_draft,
+    no extra weights); 'draft' runs a `draft_layers`-layer truncation
+    of the model itself as the proposer. Requires temperature 0 (the
+    greedy-identity contract is the point) and no tp mesh (the serving
+    engines own the tp speculative path)."""
+    if speculate not in ("off", "ngram", "draft"):
+        raise ValueError(f"speculate must be 'off', 'ngram' or 'draft', "
+                         f"got {speculate!r}")
+    if speculate != "off":
+        if temperature > 0.0:
+            raise ValueError(
+                "speculative decoding verifies greedily; it requires "
+                "temperature=0 (the output-identity contract)")
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            raise NotImplementedError(
+                "speculative generate() does not run tensor-parallel; "
+                "use the serving engines for tp speculative decode")
+        return _generate_speculative(params, prompt, cfg, max_new_tokens,
+                                     max_len=max_len, mode=speculate,
+                                     spec_k=spec_k,
+                                     draft_layers=draft_layers,
+                                     spec_stats=spec_stats)
     if temperature > 0.0 and key is None:
         key = jax.random.key(0)
     b, t0 = prompt.shape
@@ -1042,7 +1199,9 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
         from container_engine_accelerators_tpu.models import decode_tp
         cache = decode_tp.init_sharded_cache(
             lambda: init_cache(cfg, b, max_len), mesh)
-        step_fn = decode_tp.jitted_decode_step(cfg, mesh)
+        step_fn = decode_tp.jitted_decode_step(
+            cfg, mesh,
+            quantized_weights=isinstance(params["lm_head"], QuantWeight))
     else:
         cache = init_cache(cfg, b, max_len)
         step_fn = _jitted_decode_step(cfg)
@@ -1064,3 +1223,132 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: LlamaConfig,
         tok = pick(logits, keys[i] if key is not None else None)
         out.append(tok[:, None])
     return jnp.concatenate(out, axis=1)
+
+
+def _generate_speculative(params: dict, prompt: jnp.ndarray,
+                          cfg: LlamaConfig, max_new_tokens: int,
+                          max_len: int | None = None,
+                          mode: str = "ngram", spec_k: int = 4,
+                          draft_layers: int = 2,
+                          spec_stats: dict | None = None) -> jnp.ndarray:
+    """Speculative generate: same contract as generate(temperature=0),
+    fewer model passes. Uses a VECTOR-length cache even at batch > 1 —
+    per-row acceptance diverges, so rows sit at different positions
+    after the first verify. Two executables drive the whole loop
+    (verify_step at [B, T0] for prefill and [B, K+1] for decode, plus
+    advance_lengths); acceptance outcomes are data, never shapes.
+    `spec_stats` (a dict) accumulates drafted/accepted/verifies/
+    committed totals for the caller's acceptance-rate gauges."""
+    import numpy as np
+
+    from container_engine_accelerators_tpu.models import spec as spec_mod
+
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    b, t0 = prompt.shape
+    k1 = spec_k + 1
+    # Verify writes K/V at [len, len + k1) BEFORE committing, so the
+    # cache needs k1 slack past the last committed position — without
+    # it the per-slot write clamp would fold candidate writes onto
+    # committed rows near the end of generation.
+    max_len = max(max_len or 0, t0 + max_new_tokens) + k1
+    if max_len > 128 and _kernel_eligible(cfg):
+        max_len = -(-max_len // 128) * 128
+
+    cache = init_cache(cfg, b, max_len)._replace(
+        length=jnp.zeros((b,), jnp.int32))
+    verify_fn = _jitted_verify_step(cfg)
+    adv_fn = _jitted_advance_lengths()
+    all_on = jnp.ones((b,), bool)
+
+    # Prefill through the SAME verify executable (advance=False) + one
+    # commit — jit keeps a separate executable for the [B, T0] shape.
+    logits, cache = verify_fn(params, cache, prompt, all_on)
+    cache = adv_fn(cache, jnp.full((b,), t0, jnp.int32), all_on)
+    last = np.array(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+
+    draft_params = draft_cache = draft_fn = None
+    if mode == "draft":
+        import dataclasses
+        n_draft = max(1, min(draft_layers, cfg.n_layers - 1))
+        draft_cfg = dataclasses.replace(cfg, n_layers=n_draft)
+        draft_params = spec_mod.truncate_params(params, n_draft)
+        draft_cache = init_cache(draft_cfg, b, max_len)._replace(
+            length=jnp.zeros((b,), jnp.int32))
+        draft_fn = _jitted_decode_step(draft_cfg)
+        _, draft_cache = draft_fn(draft_params, draft_cache, prompt)
+
+    out = np.zeros((b, t0 + max_new_tokens), np.int32)
+    out[:, :t0] = np.asarray(prompt)
+    out[:, t0] = last
+    produced = np.ones((b,), np.int32)
+    # Draft mode caps the commit at k (never the bonus): on full
+    # acceptance the bonus token's K/V is missing from the draft cache
+    # (the drafter only stepped k times), so committing it would desync
+    # the caches. The bonus still becomes the next round's last token —
+    # nothing is recomputed, one commit is just deferred a round.
+    cap = spec_k if mode == "draft" else spec_k + 1
+
+    while (produced < max_new_tokens).any():
+        act = produced < max_new_tokens
+        if mode == "ngram":
+            drafts = np.zeros((b, spec_k), np.int32)
+            for i in range(b):
+                if not act[i]:
+                    continue
+                d = spec_mod.ngram_draft(out[i, :t0 + produced[i]],
+                                         spec_k)
+                drafts[i, :len(d)] = d
+        else:
+            tok = jnp.asarray(last)[:, None]
+            cols = []
+            for _ in range(spec_k):
+                dl, draft_cache = draft_fn(draft_params, draft_cache,
+                                           tok)
+                tok = jnp.argmax(dl[:, -1], axis=-1).astype(
+                    jnp.int32)[:, None]
+                cols.append(tok)
+            drafts = np.asarray(jnp.concatenate(cols, axis=1), np.int32)
+
+        tokens = np.concatenate([last[:, None], drafts], axis=1)
+        logits, cache = verify_fn(params, cache, jnp.asarray(tokens),
+                                  jnp.asarray(act))
+        greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+
+        commit = np.zeros((b,), np.int32)
+        for i in range(b):
+            if not act[i]:
+                continue
+            a = int(counts[i]) - 1          # accepted draft tokens
+            seq = list(tokens[i, 1:1 + a]) + [int(bonus[i])]
+            c = min(len(seq), cap, int(max_new_tokens - produced[i]))
+            out[i, t0 + produced[i]:t0 + produced[i] + c] = seq[:c]
+            last[i] = seq[c - 1]
+            produced[i] += c
+            commit[i] = c
+        cache = adv_fn(cache, jnp.asarray(commit), jnp.asarray(act))
+        if spec_stats is not None:
+            # act/counts/commit are host numpy — the one device fetch
+            # per verify is the argmax above, which speculation needs
+            # regardless of stats.
+            # tpulint: allow=TPL002(host numpy counters, no device value involved)
+            n_act = int(act.sum())
+            spec_stats["drafted"] = (spec_stats.get("drafted", 0)
+                                     + n_act * spec_k)
+            spec_stats["accepted"] = (spec_stats.get("accepted", 0)
+                                      # tpulint: allow=TPL002(host numpy counters, no device value involved)
+                                      + int(counts[act].sum()) - n_act)
+            spec_stats["verifies"] = spec_stats.get("verifies", 0) + n_act
+            spec_stats["committed"] = (spec_stats.get("committed", 0)
+                                       # tpulint: allow=TPL002(host numpy counters, no device value involved)
+                                       + int(commit.sum()))
+        if mode == "draft":
+            # Re-anchor the drafter to the committed frontier: its
+            # cached prefix [prompt, last, d_1..] matches the main
+            # cache's committed tokens position-for-position, so the
+            # length IS the sync (no K/V copying). .copy() because
+            # draft_fn donates its cache — aliasing the main cache's
+            # length buffer would let that donation delete it.
+            draft_cache = draft_cache._replace(length=cache.length.copy())
+    return jnp.asarray(out)

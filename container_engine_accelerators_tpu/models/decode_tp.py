@@ -42,8 +42,10 @@ from container_engine_accelerators_tpu.models.decode import (
     prefill_slot_paged,
     prefill_suffix_paged,
     prefill_suffix_slot,
+    verify_step,
 )
 from container_engine_accelerators_tpu.models.llama import LlamaConfig
+from container_engine_accelerators_tpu.ops.quant import QuantWeight
 
 TP_AXIS = "tp"
 
@@ -72,8 +74,15 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
             f"with moe_decode_ep=False)")
 
 
+def _kv_quantized(cfg: LlamaConfig) -> bool:
+    """Int8 AND int4 KV caches carry scale planes (int4 is int8 storage
+    at half head_dim — same scale layout, so one spec covers both)."""
+    return cfg.kv_cache_dtype in ("int8", "int4")
+
+
 def decode_param_specs(cfg: LlamaConfig | None = None,
-                       moe: bool = False) -> dict:
+                       moe: bool = False,
+                       quantized: bool = False) -> dict:
     """PartitionSpec tree matching models.llama.init_params.
 
     Unlike training's llama_param_specs, nothing shards over fsdp:
@@ -90,16 +99,37 @@ def decode_param_specs(cfg: LlamaConfig | None = None,
         axis (decode.py._moe_ffn_decode psums the partial combines) —
         expert HBM scales 1/tp.
     The router stays replicated either way (it is [d, E] — tiny — and
-    every rank needs every expert's gate weight for the combine)."""
-    col = P(None, None, TP_AXIS)   # stacked [L, d_model, heads*dh | ff]
-    row = P(None, TP_AXIS, None)   # stacked [L, heads*dh | ff, d_model]
+    every rank needs every expert's gate weight for the combine).
+
+    `quantized` describes int8 weights (quantize_llama_params): the
+    quantizable projections become QuantWeight nodes whose scales shard
+    WITH their values — the scale-sharding rule is that per-output-
+    channel scales follow the OUTPUT axis:
+      - column-sharded values [L, d, F] over tp -> scales [L, F] over
+        tp (each shard owns its channels' scales);
+      - row-sharded values [L, F, d] over tp -> scales [L, d]
+        REPLICATED (the output axis is unsharded; scales are constant
+        across contraction rows, so shard-dequant-then-psum is exact);
+      - lm_head values [d, V] over tp -> scales [V] over tp."""
+    has_moe = bool(cfg.n_experts) if cfg is not None else moe
+    if quantized and has_moe:
+        raise ValueError(
+            "int8-quantized weights are not supported for MoE decode "
+            "(decode.py runs dense expert einsums, not QuantWeight "
+            "matmuls)")
+
+    def qw(values: P, scales: P):
+        return QuantWeight(values=values, scales=scales) \
+            if quantized else values
+
+    col = qw(P(None, None, TP_AXIS), P(None, TP_AXIS))
+    row = qw(P(None, TP_AXIS, None), P(None, None))
     layers = {
         "attn_norm": P(None, None),
         "wq": col, "wk": col, "wv": col,
         "wo": row,
         "mlp_norm": P(None, None),
     }
-    has_moe = bool(cfg.n_experts) if cfg is not None else moe
     if has_moe:
         exp = (P(None, TP_AXIS, None, None)
                if cfg is not None and cfg.moe_decode_ep
@@ -112,7 +142,7 @@ def decode_param_specs(cfg: LlamaConfig | None = None,
         "embed": P(None, None),
         "layers": layers,
         "final_norm": P(None),
-        "lm_head": P(None, TP_AXIS),
+        "lm_head": qw(P(None, TP_AXIS), P(TP_AXIS)),
     }
 
 
@@ -141,7 +171,9 @@ def shard_decode_params(params: dict, mesh: Mesh,
     """Place params on the mesh in the decode TP layout. Pass `cfg` for
     MoE models so moe_decode_ep selects the expert placement; without
     one, MoE params (detected by their router) get replicated experts."""
-    specs = decode_param_specs(cfg, moe="w_router" in params["layers"])
+    specs = decode_param_specs(
+        cfg, moe="w_router" in params["layers"],
+        quantized=isinstance(params["lm_head"], QuantWeight))
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
@@ -199,13 +231,14 @@ def _watched_jit(fn, name: str):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
+def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh,
+                          quantized_weights: bool = False):
     """Classic scalar-length batched decode/prefill step over the mesh
     (generate()'s step): (params, cache, tokens[B,T]) -> (logits, cache)."""
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=False, scalar_len=True,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(decode_step, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -216,11 +249,12 @@ def jitted_decode_step(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
+def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh,
+                                quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=False,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(decode_step_slots, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -231,11 +265,12 @@ def jitted_decode_step_slots(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
+def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh,
+                           quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=False,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(prefill_slot, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -246,11 +281,12 @@ def jitted_prefill_slot(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
+def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh,
+                                  quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=False,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(prefill_suffix_slot, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -261,11 +297,12 @@ def jitted_prefill_suffix_slot(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
+def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh,
+                                quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=True,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(decode_step_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -276,11 +313,12 @@ def jitted_decode_step_paged(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
+def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh,
+                                 quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=True,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(prefill_slot_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -291,11 +329,12 @@ def jitted_prefill_slot_paged(cfg: LlamaConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
+def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh,
+                                   quantized_weights: bool = False):
     validate_tp(cfg, mesh.shape[TP_AXIS])
-    pspecs = decode_param_specs(cfg)
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
     cspecs = cache_specs(paged=True,
-                         quantized=cfg.kv_cache_dtype == "int8")
+                         quantized=_kv_quantized(cfg))
     fn = _smap(
         functools.partial(prefill_suffix_paged, cfg=cfg, tp_axis=TP_AXIS),
         mesh,
@@ -303,6 +342,27 @@ def jitted_prefill_suffix_paged(cfg: LlamaConfig, mesh: Mesh):
         out_specs=(P(None), cspecs))
     return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
                         'tp/prefill_suffix_paged')
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_verify_step(cfg: LlamaConfig, mesh: Mesh,
+                       paged: bool = False,
+                       quantized_weights: bool = False):
+    """Speculative verify over the mesh: (params, cache, tokens[B,K+1],
+    active[B]) -> (logits [B,K+1,V], cache with K/V written, lengths
+    UNCHANGED). One wrapper serves both cache layouts via `paged`;
+    commit with models/decode's advance_lengths (plain jit — it only
+    touches the replicated lengths, so it needs no shard_map)."""
+    validate_tp(cfg, mesh.shape[TP_AXIS])
+    pspecs = decode_param_specs(cfg, quantized=quantized_weights)
+    cspecs = cache_specs(paged=paged, quantized=_kv_quantized(cfg))
+    fn = _smap(
+        functools.partial(verify_step, cfg=cfg, tp_axis=TP_AXIS),
+        mesh,
+        in_specs=(pspecs, cspecs, P(None, None), P(None)),
+        out_specs=(P(None, None, None), cspecs))
+    return _watched_jit(jax.jit(fn, donate_argnums=(1,)),
+                        'tp/verify_step')
 
 
 def make_inference_mesh(tp: int | None = None,

@@ -60,8 +60,10 @@ class LlamaConfig:
     # 'bf16' stores the cache in cfg.dtype; 'int8' stores K/V as int8
     # with per-(token, head) f32 scales (ops/quant.quantize_kv) and
     # dequantizes inside the decode kernels — roughly halves the
-    # decode-step cache HBM traffic and doubles the slots that fit
-    # (--kv-dtype on cli/serve.py; tools/hbm_plan.py prices it).
+    # decode-step cache HBM traffic and doubles the slots that fit;
+    # 'int4' packs two nibbles per byte (ops/quant.quantize_kv_int4)
+    # for another 2x, unpacked fused in the same kernels
+    # (--kv-dtype on cli/serve.py; tools/hbm_plan.py prices all three).
     kv_cache_dtype: str = "bf16"
     # Sequence/context parallelism over the 'sp' mesh axis; enabled by
     # the training layer when the mesh has sp > 1. Mode 'ring' rotates
@@ -423,10 +425,14 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
         raise ValueError(
             f"flash_causal_grid must be 'rect' or 'tri', got "
             f"{cfg.flash_causal_grid!r}")
-    if cfg.kv_cache_dtype not in ("bf16", "int8"):
+    if cfg.kv_cache_dtype not in ("bf16", "int8", "int4"):
         raise ValueError(
-            f"kv_cache_dtype must be 'bf16' or 'int8', got "
+            f"kv_cache_dtype must be 'bf16', 'int8' or 'int4', got "
             f"{cfg.kv_cache_dtype!r}")
+    if cfg.kv_cache_dtype == "int4" and cfg.head_dim % 2:
+        raise ValueError(
+            f"kv_cache_dtype='int4' packs two nibbles per byte over "
+            f"head_dim; head_dim={cfg.head_dim} must be even")
     if (cfg.flash_causal_grid == "tri" and cfg.sequence_parallel
             and cfg.sequence_parallel_mode == "ring"):
         # Ring attention never reaches the flash causal grid (it runs

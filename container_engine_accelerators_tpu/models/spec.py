@@ -1,0 +1,102 @@
+"""Speculative-decoding drafters + acceptance math (host side).
+
+Draft-then-verify (Leviathan et al. 2023) splits a decode tick into a
+cheap PROPOSAL of k tokens and one batched model pass that scores all
+k+1 positions (models/decode.verify_step). Everything in this module
+runs on the HOST between device steps, mirroring how page allocation
+works: the device only ever sees static [B, k+1] verify shapes, and
+acceptance counts flow back in as data (advance_lengths), never as
+shapes.
+
+Two drafters:
+  ngram_draft      prompt-lookup — match the context's suffix against
+                   its own history and propose the continuation. Zero
+                   extra weights, zero device work; acceptance is high
+                   exactly when decoding is most repetitive (extraction,
+                   code, structured output).
+  truncate_params  self-draft — the first n layers of the SAME model
+                   (stacked-layer slice sharing embed/norm/lm_head) as
+                   a small proposer on the same mesh.
+
+The drafter contract: a drafter may propose ANY tokens (fewer than k
+is fine — callers pad). Greedy verification accepts the longest prefix
+matching the full model's argmax, then always emits one bonus token
+from the verify logits, so even an adversarial drafter only costs
+compute, never correctness: the token stream is identical to plain
+greedy decode by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ngram_draft", "greedy_verify", "truncate_params"]
+
+
+def ngram_draft(context, k: int, max_ngram: int = 3,
+                min_ngram: int = 1) -> list[int]:
+    """Propose up to `k` tokens by prompt lookup: find the most recent
+    earlier occurrence of the context's trailing n-gram (longest n
+    first, n in [min_ngram, max_ngram]) and return the tokens that
+    followed it. Returns [] when no n-gram recurs — the caller runs a
+    plain (or padded) tick.
+
+    context: 1-D int sequence (prompt + generated so far, INCLUDING
+    the latest emitted token). The scan is O(len * max_ngram) per call,
+    which at serving scale is nanoseconds next to a model pass."""
+    ctx = np.asarray(context, dtype=np.int64).ravel()
+    n = ctx.size
+    if k < 1 or n < min_ngram + 1:
+        return []
+    for g in range(min(max_ngram, n - 1), min_ngram - 1, -1):
+        suffix = ctx[n - g:]
+        # Most recent earlier occurrence wins: locality tracks the
+        # current phrase, not a stale one from the prompt's start.
+        for s in range(n - g - 1, -1, -1):
+            if np.array_equal(ctx[s:s + g], suffix):
+                cont = ctx[s + g:s + g + k]
+                if cont.size:
+                    return [int(t) for t in cont]
+    return []
+
+
+def greedy_verify(greedy: np.ndarray,
+                  tokens: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Acceptance math for one verify pass.
+
+    greedy: [B, k+1] argmax of verify_step's logits; tokens: [B, k+1]
+    the verified inputs [last, d_1..d_k]. Row i accepts the longest
+    draft prefix where greedy[i, j] == tokens[i, j+1] (the model,
+    given everything through d_j's predecessor, would itself have
+    emitted d_j). Returns (counts [B] = accepted + 1 tokens to commit,
+    bonus [B] = greedy[i, accepted] — the model's own next token at
+    the first disagreement, emitted for free)."""
+    greedy = np.asarray(greedy)
+    tokens = np.asarray(tokens)
+    b, k1 = tokens.shape
+    k = k1 - 1
+    if k:
+        matches = greedy[:, :k] == tokens[:, 1:]
+        a = np.where(matches.all(axis=1), k,
+                     np.argmin(matches, axis=1))
+    else:
+        a = np.zeros(b, dtype=np.int64)
+    counts = (a + 1).astype(np.int32)
+    bonus = greedy[np.arange(b), a].astype(np.int32)
+    return counts, bonus
+
+
+def truncate_params(params: dict, n_layers: int) -> dict:
+    """Self-draft proposer: the first `n_layers` of a stacked-layer
+    Llama param tree, SHARING embed / final_norm / lm_head with the
+    full model (views, not copies — the draft costs only the compute
+    of n layers, no extra HBM beyond its own KV cache). Works on
+    QuantWeight leaves too: the NamedTuple is a pytree, so values and
+    their per-layer scales slice together. Pair with
+    dataclasses.replace(cfg, n_layers=n_layers) for the draft config."""
+    import jax
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda x: x[:n_layers],
+                                 params["layers"])
+    return out

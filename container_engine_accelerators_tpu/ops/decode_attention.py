@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from container_engine_accelerators_tpu.ops.quant import unpack_int4
+
 NEG_INF = -1e30
 
 # 1024 measured fastest on v5e (49 GB/s effective cache bandwidth vs 45
@@ -109,7 +111,7 @@ def _pick_block(requested: int, s: int) -> int:
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
                    scale: float, block_k: int, t: int, g: int,
-                   hkv: int, quant: bool = False):
+                   hkv: int, quant: bool = False, int4: bool = False):
     if quant:
         # Int8 cache: two extra VMEM inputs carry the per-(token, head)
         # f32 scales, tiled head-major so positions ride the lane axis.
@@ -139,8 +141,18 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
             jnp.int32, (block_k, 1), 0)            # [bk, 1] absolute pos
         for h in range(hkv):                        # static unroll
             q = q_ref[0, h, :, :].astype(jnp.float32)    # [rows, d]
-            k = k_ref[0, :, h, :].astype(jnp.float32)    # [bk, d]
-            v = v_ref[0, :, h, :].astype(jnp.float32)
+            k = k_ref[0, :, h, :]                        # [bk, d | d/2]
+            v = v_ref[0, :, h, :]
+            if int4:
+                # Fused int4 unpack (ops/quant.unpack_int4's exact
+                # formula): the [bk, d/2] packed tile becomes [bk, d]
+                # via two nibble extractions + a lane concatenation —
+                # the split-half packing exists so this needs no
+                # lane-axis shuffle.
+                k = unpack_int4(k)
+                v = unpack_int4(v)
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
             if quant:
                 # Fused dequant: one f32 scale per cache position of
                 # this head, broadcast over D. Dead positions may hold
@@ -190,7 +202,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
 def decode_attention(q, k_cache, v_cache, cache_len,
                      block_k: int = DEFAULT_BLOCK_K,
                      interpret: bool = False,
-                     k_scales=None, v_scales=None):
+                     k_scales=None, v_scales=None, int4: bool = False):
     """q: [B, T, Hq, D] new-token queries at positions
     [cache_len, cache_len + T); k_cache/v_cache: [B, max_len, Hkv, D]
     with the new tokens already written. Returns [B, T, Hq, D].
@@ -201,17 +213,22 @@ def decode_attention(q, k_cache, v_cache, cache_len,
 
     k_scales/v_scales ([B, Hkv, max_len] f32, ops/quant.quantize_kv
     layout) switch on the int8 path: the caches stream as int8 and the
-    kernel dequantizes each tile in VMEM right after the DMA."""
+    kernel dequantizes each tile in VMEM right after the DMA. `int4`
+    (quantize_kv_int4 layout) marks the caches as nibble-packed
+    [B, max_len, Hkv, D/2] int8: the kernel unpacks after the dequant
+    load, so HBM streams a QUARTER of the bf16 bytes."""
     b, t, hq, d = q.shape
     max_len, hkv = k_cache.shape[1], k_cache.shape[2]
     g = hq // hkv
     quant = k_scales is not None
+    d_k = d // 2 if int4 else d    # stored payload width per position
     block_k = max(128, block_k // 128 * 128)  # lane-tile multiple
     # K + V tiles, double-buffered, must fit the scoped-VMEM budget:
     # 2 (k,v) x 2 (buffers) x block_k x hkv x d x itemsize — int8
-    # halves this, so the cap (and the elidable-DMA block) doubles.
+    # halves this (int4 packing halves again), so the cap (and the
+    # elidable-DMA block) grows to match.
     # The scale tiles add 2 x 2 x hkv x 4 f32 bytes per position.
-    per_row = 4 * hkv * d * k_cache.dtype.itemsize
+    per_row = 4 * hkv * d_k * k_cache.dtype.itemsize
     if quant:
         per_row += 16 * hkv
     cap = max(128, _VMEM_TILE_BUDGET // per_row // 128 * 128)
@@ -239,11 +256,11 @@ def decode_attention(q, k_cache, v_cache, cache_len,
         pl.BlockSpec((1, hkv, rows, d),
                      lambda bi, ki, len_ref: (bi, 0, 0, 0)),
         # K/V tiled in the cache's native layout: the trailing
-        # (hkv, d) block dims equal the array dims, which satisfies
+        # (hkv, d_k) block dims equal the array dims, which satisfies
         # Mosaic's last-two-dims tiling rule without transposing the
-        # cache.
-        pl.BlockSpec((1, block_k, hkv, d), kv_map),
-        pl.BlockSpec((1, block_k, hkv, d), kv_map),
+        # cache (d_k = d/2 when the payload is nibble-packed).
+        pl.BlockSpec((1, block_k, hkv, d_k), kv_map),
+        pl.BlockSpec((1, block_k, hkv, d_k), kv_map),
     ]
     args = [len_arr, qg, k_cache, v_cache]
     if quant:
@@ -265,7 +282,7 @@ def decode_attention(q, k_cache, v_cache, cache_len,
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=d ** -0.5,
                           block_k=block_k, t=t, g=g, hkv=hkv,
-                          quant=quant),
+                          quant=quant, int4=int4),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         interpret=interpret,
@@ -285,7 +302,8 @@ def paged_supported(q, k_pool, page: int) -> bool:
 
 def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
                            interpret: bool = False,
-                           k_scales=None, v_scales=None):
+                           k_scales=None, v_scales=None,
+                           int4: bool = False):
     """Paged variant: the cache lives in a shared page pool and each
     slot's logical sequence is scattered across pool rows by its block
     table (vLLM-style paging, done the TPU way: the table is a second
@@ -304,13 +322,17 @@ def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
     k_scales/v_scales ([n_pages, Hkv, page] f32) switch on the int8
     path: scales live in their own pool indexed by the SAME tables, so
     the page indirection covers them for free and the kernel dequantizes
-    each page tile in VMEM.
+    each page tile in VMEM. `int4` marks nibble-packed pools
+    ([n_pages, page, Hkv, D/2] int8, quantize_kv_int4 layout); the
+    kernel unpacks in VMEM with the same formula as the contiguous
+    path.
     """
     b, t, hq, d = q.shape
     n_pages, page, hkv, _ = k_pool.shape
     max_pages = tables.shape[1]
     g = hq // hkv
     quant = k_scales is not None
+    d_k = d // 2 if int4 else d
     rows = _query_rows(t, g)
     qg = _group_queries(q, hkv, g, rows)
 
@@ -337,8 +359,8 @@ def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
     in_specs = [
         pl.BlockSpec((1, hkv, rows, d),
                      lambda bi, ki, len_ref, tab_ref: (bi, 0, 0, 0)),
-        pl.BlockSpec((1, page, hkv, d), kv_map),
-        pl.BlockSpec((1, page, hkv, d), kv_map),
+        pl.BlockSpec((1, page, hkv, d_k), kv_map),
+        pl.BlockSpec((1, page, hkv, d_k), kv_map),
     ]
     args = [len_arr, tab_arr, qg, k_pool, v_pool]
     if quant:
@@ -364,7 +386,7 @@ def paged_decode_attention(q, k_pool, v_pool, lengths, tables,
         # from, which the index map above fully encapsulates.
         _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
                        scale=d ** -0.5, block_k=page,
-                       t=t, g=g, hkv=hkv, quant=quant)
+                       t=t, g=g, hkv=hkv, quant=quant, int4=int4)
 
     out = pl.pallas_call(
         paged_kernel,

@@ -16,6 +16,12 @@ KV cache (per token per KV head; consumed by ops/decode_attention's
 fused-dequant path and models/decode's quantized cache writes):
   q, scales = quantize_kv(kv)               # [...,T,H,D] -> int8 + f32
   kv = dequantize_kv(q, scales)             # exact inverse structure
+
+Int4 KV (two nibbles per byte, split-half layout, scale = absmax/7;
+the kernels unpack in VMEM right after the DMA — 2x more resident
+sequences per HBM byte than int8):
+  p, scales = quantize_kv_int4(kv)          # [...,T,H,D] -> int8 [...,D/2]
+  kv = dequantize_kv_int4(p, scales)        # exact inverse
 """
 
 from __future__ import annotations
@@ -78,6 +84,62 @@ def dequantize_kv(q: jnp.ndarray, scales: jnp.ndarray,
     XLA-fallback dequant-on-read; the pallas decode kernels apply the
     same scale multiply in VMEM instead."""
     return (q.astype(jnp.float32)
+            * jnp.swapaxes(scales, -1, -2)[..., None]).astype(dtype)
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack int4 values (int32/int8 in [-8, 7]) two-per-byte along the
+    LAST axis, split-half layout: byte j of the packed array holds
+    element j in its low nibble and element j + D/2 in its high nibble.
+    Split-half (not interleaved) so the unpack is a concatenation of two
+    contiguous lane slices — the only layout the pallas decode kernels
+    can reassemble without a lane-axis shuffle. [-..., D] -> int8
+    [..., D//2]."""
+    d = q.shape[-1]
+    assert d % 2 == 0, d
+    qi = q.astype(jnp.int32)
+    lo, hi = qi[..., :d // 2], qi[..., d // 2:]
+    # (hi << 4) sets bits above 7 for negative nibbles; the int8 cast
+    # truncates to the low byte, leaving exactly (hi_nibble<<4)|lo_nibble.
+    return ((lo & 0xF) | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of pack_int4: int8 [..., D//2] -> int32 [..., D] with
+    sign-extended nibbles. This exact formula runs in BOTH the XLA
+    fallback and the pallas decode kernels (fused after the VMEM load),
+    so kernel eligibility can never change int4 semantics."""
+    bi = packed.astype(jnp.int32)
+    lo = (bi << 28) >> 28          # low nibble, sign-extended
+    hi = bi >> 4                   # arithmetic shift sign-extends
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def quantize_kv_int4(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-(token, KV-head) int4 for KV-cache tiles: the
+    quantize_kv contract at half the payload bytes.
+
+    x: [..., T, Hkv, D] -> (packed int8 [..., T, Hkv, D//2],
+                            f32 scales [..., Hkv, T] — head-major,
+                            identical layout to quantize_kv's).
+
+    scale = absmax/7 (15 signed levels); the scale planes are unchanged
+    from int8, so the paged table indirection and the tp KV-head
+    sharding cover int4 with zero new plumbing — only the payload axis
+    shrinks."""
+    x_f = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x_f), axis=-1)            # [..., T, Hkv]
+    scales = jnp.maximum(absmax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(x_f / scales[..., None]), -7, 7)
+    return pack_int4(q), jnp.swapaxes(scales, -1, -2)
+
+
+def dequantize_kv_int4(packed: jnp.ndarray, scales: jnp.ndarray,
+                       dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of quantize_kv_int4: packed [..., T, Hkv, D//2] int8 +
+    head-major scales [..., Hkv, T] -> [..., T, Hkv, D] in `dtype`."""
+    vals = unpack_int4(packed).astype(jnp.float32)
+    return (vals
             * jnp.swapaxes(scales, -1, -2)[..., None]).astype(dtype)
 
 
@@ -179,6 +241,27 @@ def quantize_llama_params(params: dict) -> dict:
                 out[key] = walk(leaf)
             elif key in quant_keys:
                 out[key] = quantize_weights(leaf)
+            else:
+                out[key] = leaf
+        return out
+
+    return walk(params)
+
+
+def dequantize_llama_params(params: dict, dtype=jnp.bfloat16) -> dict:
+    """Inverse of quantize_llama_params: expand every QuantWeight back
+    to a dense array in `dtype`. This is the round-trip the eval
+    quality gate measures (perplexity of dequantized-int8 weights vs
+    the originals through the training forward — the decode path fuses
+    the very same dequant, so the eval delta bounds serving quality)."""
+
+    def walk(tree: dict) -> dict:
+        out = {}
+        for key, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[key] = walk(leaf)
+            elif isinstance(leaf, QuantWeight):
+                out[key] = dequantize(leaf, dtype)
             else:
                 out[key] = leaf
         return out

@@ -1,9 +1,14 @@
-"""Int8 KV cache: quantize_kv round-trip bounds, fused-dequant kernel
-parity against the dequantized reference (contiguous AND paged), greedy
-token-identity bf16-vs-int8 across the generate/slot/paged engines, and
-a bounded logit error for long prompts."""
+"""KV-cache and weight quantization: quantize_kv / quantize_kv_int4
+round-trip bounds, fused-dequant kernel parity against the dequantized
+reference (contiguous AND paged, int8 AND nibble-packed int4), greedy
+token-identity bf16-vs-int8-KV across the generate/slot/paged engines,
+bounded logit error for long prompts, int8-WEIGHT decode parity (fused
+int8_matmul vs dequantize-then-dense, exact argmax identity at the
+pinned seed), and the cli/eval perplexity delta bound for int8
+weights."""
 
 import dataclasses
+import json
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +33,18 @@ from container_engine_accelerators_tpu.ops.decode_attention import (
 )
 from container_engine_accelerators_tpu.ops.quant import (
     dequantize_kv,
+    dequantize_kv_int4,
+    dequantize_llama_params,
+    pack_int4,
     quantize_kv,
+    quantize_kv_int4,
+    quantize_llama_params,
+    unpack_int4,
 )
 
 CFG = llama_tiny(dtype=jnp.float32, n_layers=2)
 CFG_INT8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+CFG_INT4 = dataclasses.replace(CFG, kv_cache_dtype="int4")
 
 
 # ---------- quantize_kv round trip ----------
@@ -63,6 +75,35 @@ def test_quantize_kv_per_token_scales_are_independent():
     back = dequantize_kv(*quantize_kv(x))
     np.testing.assert_allclose(np.asarray(back[0, 0]), 1.0, rtol=0.01)
     np.testing.assert_allclose(np.asarray(back[0, 1]), 1000.0, rtol=0.01)
+
+
+# ---------- int4 KV round trip ----------
+
+def test_pack_unpack_int4_exact_inverse():
+    vals = jnp.arange(-8, 8, dtype=jnp.int32).reshape(1, 16)
+    packed = pack_int4(vals)
+    assert packed.dtype == jnp.int8 and packed.shape == (1, 8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(vals))
+
+
+def test_quantize_kv_int4_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.key(1), (2, 16, 4, 32)) * 3.0
+    q, s = quantize_kv_int4(x)
+    # Nibble-packed payload: half the bytes, same head-major scale plane.
+    assert q.dtype == jnp.int8 and q.shape == (2, 16, 4, 16)
+    assert s.dtype == jnp.float32 and s.shape == (2, 4, 16)
+    back = dequantize_kv_int4(q, s)
+    # Symmetric absmax/7: error <= scale/2 per entry, per (tok, head).
+    bound = np.swapaxes(np.asarray(s), -1, -2)[..., None] * 0.51
+    assert np.all(np.abs(np.asarray(back) - np.asarray(x)) <= bound)
+
+
+def test_quantize_kv_int4_per_token_scales_are_independent():
+    x = jnp.ones((1, 2, 1, 8)).at[0, 1].mul(1000.0)
+    back = dequantize_kv_int4(*quantize_kv_int4(x))
+    np.testing.assert_allclose(np.asarray(back[0, 0]), 1.0, rtol=0.08)
+    np.testing.assert_allclose(np.asarray(back[0, 1]), 1000.0, rtol=0.08)
 
 
 # ---------- fused-dequant kernel parity ----------
@@ -143,6 +184,68 @@ def test_paged_kernel_fused_dequant_matches_contiguous():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("t,cache_len", [(1, 0), (1, 100), (5, 249)])
+def test_int4_kernel_fused_dequant_matches_dequantized_reference(
+        t, cache_len):
+    b, hq, hkv, d, max_len = 2, 8, 2, 128, 256
+    kq, kk, kv = jax.random.split(jax.random.key(40 + cache_len + t), 3)
+    q = jax.random.normal(kq, (b, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (b, max_len, hkv, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (b, max_len, hkv, d), jnp.float32)
+    qk, sk = quantize_kv_int4(k_cache)
+    qv, sv = quantize_kv_int4(v_cache)
+
+    got = decode_attention(q, qk, qv, jnp.int32(cache_len),
+                           interpret=True, k_scales=sk, v_scales=sv,
+                           int4=True)
+    # Fallback = unpack + dequant then attend; the kernel fuses the
+    # IDENTICAL unpack_int4 formula after the VMEM load, so the
+    # tolerance covers only accumulation order, not quantization.
+    want = _reference(q, dequantize_kv_int4(qk, sk),
+                      dequantize_kv_int4(qv, sv), jnp.int32(cache_len))
+    np.testing.assert_allclose(jax.device_get(got), jax.device_get(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_int4_paged_kernel_fused_dequant_matches_contiguous():
+    slots, t, hq, hkv, d = 2, 1, 8, 2, 128
+    page, n_pages, max_pages = 128, 9, 4
+    kq, kk, kv = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(kq, (slots, t, hq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (slots, max_pages * page, hkv, d),
+                                jnp.float32)
+    v_cache = jax.random.normal(kv, (slots, max_pages * page, hkv, d),
+                                jnp.float32)
+    qk, sk = quantize_kv_int4(k_cache)
+    qv, sv = quantize_kv_int4(v_cache)
+    lengths = jnp.asarray([130, 250], jnp.int32)
+
+    tables = np.full((slots, max_pages), 7, np.int32)
+    k_pool = np.zeros((n_pages, page, hkv, d // 2), np.int8)
+    v_pool = np.zeros((n_pages, page, hkv, d // 2), np.int8)
+    ks_pool = np.zeros((n_pages, hkv, page), np.float32)
+    vs_pool = np.zeros((n_pages, hkv, page), np.float32)
+    free = list(range(1, n_pages))
+    for s in range(slots):
+        for p in range(-(-int(lengths[s] + t) // page)):
+            tables[s, p] = free.pop()
+            sl = slice(p * page, (p + 1) * page)
+            k_pool[tables[s, p]] = np.asarray(qk)[s, sl]
+            v_pool[tables[s, p]] = np.asarray(qv)[s, sl]
+            ks_pool[tables[s, p]] = np.asarray(sk)[s, :, sl]
+            vs_pool[tables[s, p]] = np.asarray(sv)[s, :, sl]
+
+    ref = decode_attention(q, qk, qv, lengths, interpret=True,
+                           k_scales=sk, v_scales=sv, int4=True)
+    got = paged_decode_attention(
+        q, jnp.asarray(k_pool), jnp.asarray(v_pool), lengths,
+        jnp.asarray(tables), interpret=True,
+        k_scales=jnp.asarray(ks_pool), v_scales=jnp.asarray(vs_pool),
+        int4=True)
+    np.testing.assert_allclose(jax.device_get(got), jax.device_get(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 # ---------- engine-level parity ----------
 
 @pytest.fixture(scope="module")
@@ -219,3 +322,76 @@ def test_long_prompt_logit_error_bounded(model):
     mse = float(jnp.mean((logits_bf - logits_i8) ** 2))
     ref = float(jnp.mean(logits_bf ** 2))
     assert mse < 1e-3 * max(ref, 1.0), (mse, ref)
+
+
+def test_int4_kv_logit_error_bounded(model):
+    """Int4 KV (absmax/7, 15 levels) trades more drift for half the
+    cache bytes: the contract is a bounded relative logit error, two
+    orders looser than int8's (measured ~4e-2 on this model; the pin
+    leaves 2x headroom)."""
+    prompt = jax.random.randint(jax.random.key(6), (1, 96), 0,
+                                CFG.vocab_size)
+    logits_bf, _ = decode_step(model, init_cache(CFG, 1, 128), prompt,
+                               CFG)
+    logits_i4, _ = decode_step(model, init_cache(CFG_INT4, 1, 128),
+                               prompt, CFG_INT4)
+    mse = float(jnp.mean((logits_bf - logits_i4) ** 2))
+    ref = float(jnp.mean(logits_bf ** 2))
+    assert mse < 1e-1 * max(ref, 1.0), (mse, ref)
+
+
+# ---------- int8 WEIGHTS (fused-dequant matmul path) ----------
+
+def test_int8_weight_fused_matches_dequant_reference_exactly(model):
+    """The decode path's fused int8 matmul (QuantWeight leaves) against
+    generate() over the explicitly dequantized tree: same quantization
+    error by construction, so the greedy streams must agree token for
+    token at the pinned seed — any divergence is a fused-path bug, not
+    quantization noise."""
+    qp = quantize_llama_params(model)
+    dq = dequantize_llama_params(qp, jnp.float32)
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    out_fused = generate(qp, prompt, CFG, max_new_tokens=8)
+    out_dense = generate(dq, prompt, CFG, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_fused),
+                                  np.asarray(out_dense))
+
+
+def test_int8_weight_logit_error_bounded_vs_bf16(model):
+    """Per-output-channel absmax/127 weights: the decode logits drift
+    from the unquantized model within a small relative bound (the
+    serving-quality claim cli/eval measures as a perplexity delta)."""
+    prompt = jax.random.randint(jax.random.key(7), (1, 64), 0,
+                                CFG.vocab_size)
+    qp = quantize_llama_params(model)
+    logits_bf, _ = decode_step(model, init_cache(CFG, 1, 128), prompt,
+                               CFG)
+    logits_q, _ = decode_step(qp, init_cache(CFG, 1, 128), prompt, CFG)
+    mse = float(jnp.mean((logits_bf - logits_q) ** 2))
+    ref = float(jnp.mean(logits_bf ** 2))
+    assert mse < 1e-3 * max(ref, 1.0), (mse, ref)
+
+
+def test_eval_cli_int8_weight_perplexity_delta_bounded(tmp_path,
+                                                      capsys):
+    """cli/eval --weight-dtype int8: the documented quality bound for
+    int8-weight serving — perplexity within 2% of bf16 on the same
+    corpus (DESIGN.md). Both runs share the deterministic tiny model,
+    so the delta isolates the quantization round trip."""
+    from container_engine_accelerators_tpu.cli import eval as eval_cli
+    from container_engine_accelerators_tpu.training.dataset import (
+        write_token_file,
+    )
+
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "corpus.bin")
+    write_token_file(rng.integers(0, 512, size=8192), path, 512)
+    common = ["--data", path, "--batch-size", "2", "--seq-len", "32",
+              "--batches", "2"]
+    assert eval_cli.main(common) == 0
+    bf16 = json.loads(capsys.readouterr().out)
+    assert eval_cli.main(common + ["--weight-dtype", "int8"]) == 0
+    int8 = json.loads(capsys.readouterr().out)
+    assert int8["weight_dtype"] == "int8"
+    assert int8["perplexity"] == pytest.approx(bf16["perplexity"],
+                                               rel=0.02)

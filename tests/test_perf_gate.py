@@ -297,7 +297,8 @@ def test_tier_is_hermetic_schema_complete_and_clean(tier):
     assert set(tier["metrics"]) == {
         "train_step_ms", "decode_step_slots_ms", "decode_step_paged_ms",
         "matmul_scan_ms", "prefill_cached_ms",
-        "decode_tick_under_prefill_ms", "ckpt_async_stall_ms"}
+        "decode_tick_under_prefill_ms", "ckpt_async_stall_ms",
+        "decode_spec_tpot_ms", "decode_w8_step_ms"}
     for result in tier["results"]:
         assert harness.validate_result(result) == [], result["metric"]
         assert result["status"] == "ok"

@@ -1,0 +1,253 @@
+"""Speculative decoding (models/spec.py + decode.verify_step/
+advance_lengths): drafter and verifier unit contracts, the rollback
+invariant (rejected verify writes are invisible), greedy token-identity
+of speculative generate() and the continuous/paged serving engines
+against their non-speculative selves — including rejection-heavy
+prompts — and the acceptance-rate recorder plumbing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models import spec as spec_mod
+from container_engine_accelerators_tpu.models.decode import (
+    _jitted_advance_lengths,
+    _jitted_verify_step,
+    decode_step_slots,
+    generate,
+    init_slot_cache,
+    prefill_slot,
+)
+
+CFG = llama_tiny(dtype=jnp.float32, n_layers=2)
+
+REPETITIVE = [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9]
+RANDOM = [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+# ---------- drafter / verifier units ----------
+
+def test_ngram_draft_finds_continuation():
+    assert spec_mod.ngram_draft([10, 11, 12, 13, 10, 11], 2) == [12, 13]
+
+
+def test_ngram_draft_most_recent_occurrence_wins():
+    # Trailing [2, 3] occurs twice earlier; the drafter must continue
+    # from the LATER one (locality tracks the current phrase).
+    assert spec_mod.ngram_draft([1, 2, 3, 7, 2, 3, 8, 2, 3], 1) == [8]
+
+
+def test_ngram_draft_no_recurrence_returns_empty():
+    assert spec_mod.ngram_draft([1, 2, 3, 4, 5], 4) == []
+    assert spec_mod.ngram_draft([], 4) == []
+
+
+def test_ngram_draft_clips_to_k():
+    ctx = [1, 2, 3, 4, 5, 6, 1, 2]  # trailing [1, 2] recurs at the start
+    assert spec_mod.ngram_draft(ctx, 3) == [3, 4, 5]
+    assert spec_mod.ngram_draft(ctx, 2) == [3, 4]
+    # Continuation shorter than k: return what exists, never pad.
+    assert spec_mod.ngram_draft([4, 4], 3) == [4]
+
+
+def test_greedy_verify_counts_and_bonus():
+    # greedy[i, j] = model's argmax after consuming tokens[i, :j+1].
+    tokens = np.array([[7, 3, 4, 5]])
+    greedy = np.array([[3, 4, 9, 2]])  # accepts 3, 4; rejects 5
+    counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+    assert counts.tolist() == [3]
+    assert bonus.tolist() == [9]  # model's own token at the break
+
+
+def test_greedy_verify_rejection_heavy_still_commits_one():
+    tokens = np.array([[7, 1, 1, 1], [2, 8, 8, 8]])
+    greedy = np.array([[5, 6, 7, 8], [8, 8, 8, 4]])
+    counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+    # Row 0: zero drafts accepted -> commit 1 (the bonus). Row 1: all
+    # accepted -> commit k+1 with the free next token.
+    assert counts.tolist() == [1, 4]
+    assert bonus.tolist() == [5, 4]
+
+
+# ---------- verify_step rollback invariant ----------
+
+@pytest.fixture(scope="module")
+def model():
+    return init_params(jax.random.key(0), CFG)
+
+
+def _prefilled(model, prompt):
+    cache = init_slot_cache(CFG, 1, 64)
+    padded = prompt + [0] * (8 - len(prompt))
+    last, cache = prefill_slot(model, cache, jnp.int32(0),
+                               jnp.asarray(padded, jnp.int32),
+                               jnp.int32(len(prompt)), CFG)
+    return int(jnp.argmax(last)), cache
+
+
+def test_rejected_verify_writes_are_invisible(model):
+    """A verify pass writes K/V for all k+1 candidates but commits only
+    the accepted prefix; the rejected positions sit beyond the live
+    length and the next tick overwrites them. Forcing a 1-token commit
+    after a garbage-draft verify must leave the stream identical to a
+    never-speculated run."""
+    active = jnp.asarray([True])
+
+    tok, cache = _prefilled(model, RANDOM[:4])
+    ref = []
+    cur = tok
+    for _ in range(4):
+        lg, cache = decode_step_slots(model, cache,
+                                      jnp.asarray([cur], jnp.int32),
+                                      active, CFG)
+        cur = int(jnp.argmax(lg[0]))
+        ref.append(cur)
+
+    tok2, cache = _prefilled(model, RANDOM[:4])
+    assert tok2 == tok
+    verify = _jitted_verify_step(CFG)
+    adv = _jitted_advance_lengths()
+    # Garbage drafts: the verify writes their K/V at len+1..len+3.
+    tokens = jnp.asarray([[tok, 99, 98, 97]], jnp.int32)
+    logits, cache = verify(model, cache, tokens, active)
+    got = [int(jnp.argmax(logits[0, 0]))]
+    cache = adv(cache, jnp.asarray([1], jnp.int32), active)
+    cur = got[0]
+    for _ in range(3):
+        lg, cache = decode_step_slots(model, cache,
+                                      jnp.asarray([cur], jnp.int32),
+                                      active, CFG)
+        cur = int(jnp.argmax(lg[0]))
+        got.append(cur)
+    assert got == ref
+
+
+# ---------- speculative generate() identity ----------
+
+@pytest.mark.parametrize("mode", ["ngram", "draft"])
+@pytest.mark.parametrize("prompt", [REPETITIVE, RANDOM],
+                         ids=["repetitive", "rejection_heavy"])
+@pytest.mark.parametrize("spec_k", [1, 4])
+def test_generate_token_identity(model, mode, prompt, spec_k):
+    p = jnp.asarray([prompt], jnp.int32)
+    ref = generate(model, p, CFG, max_new_tokens=12)
+    stats = {}
+    got = generate(model, p, CFG, max_new_tokens=12, speculate=mode,
+                   spec_k=spec_k, draft_layers=1, spec_stats=stats)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    if mode == "draft":
+        # The draft model always proposes, so verifies must have run;
+        # ngram may legitimately fall back on a dry context.
+        assert stats.get("verifies", 0) > 0
+    if stats:
+        assert stats["committed"] >= stats["verifies"]
+        assert 0 <= stats["accepted"] <= stats["drafted"]
+
+
+def test_generate_spec_batch_rows_diverge(model):
+    """Per-row acceptance diverges (repetitive row accepts, random row
+    rejects) — the vector-length cache must keep both rows exact."""
+    p = jnp.asarray([REPETITIVE[:8], RANDOM], jnp.int32)
+    ref = generate(model, p, CFG, max_new_tokens=10)
+    got = generate(model, p, CFG, max_new_tokens=10, speculate="ngram",
+                   spec_k=3)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_generate_spec_rejects_sampling():
+    with pytest.raises(ValueError):
+        generate({}, jnp.ones((1, 4), jnp.int32), CFG,
+                 max_new_tokens=4, temperature=0.7, speculate="ngram")
+
+
+# ---------- serving engines: token identity, fewer ticks ----------
+
+def _run_engine(engine_cls, params, speculate, spec_k=4, n_new=16,
+                prompts=None, **kw):
+    eng = engine_cls(dict(params), CFG, max_slots=4, max_len=256,
+                     speculate=speculate, spec_k=spec_k,
+                     draft_layers=1, **kw)
+    try:
+        futs = [eng.submit(p, n_new, 0.0)
+                for p in (prompts or [REPETITIVE, RANDOM])]
+        outs = [f.result(timeout=180) for f in futs]
+    finally:
+        eng.stop()
+    return outs, eng.spec_ticks_run, eng.steps_run
+
+
+def _engine_cases():
+    from container_engine_accelerators_tpu.cli.serve import (
+        ContinuousEngine,
+        PagedContinuousEngine,
+    )
+    return [(ContinuousEngine, {}),
+            (PagedContinuousEngine, {"page": 64})]
+
+
+@pytest.mark.parametrize("case", [0, 1], ids=["slot", "paged"])
+def test_engine_token_identity_all_modes(model, case):
+    engine_cls, kw = _engine_cases()[case]
+    ref, _, ref_steps = _run_engine(engine_cls, model, "off", **kw)
+    for mode in ("ngram", "draft"):
+        got, sticks, steps = _run_engine(engine_cls, model, mode, **kw)
+        assert got == ref, mode
+        assert sticks > 0, mode
+        # A spec tick commits at least as much as a plain tick, so the
+        # tick count can only shrink.
+        assert steps <= ref_steps, mode
+
+
+@pytest.mark.parametrize("spec_k", [1, 6])
+def test_engine_spec_k_sweep_stays_identical(model, spec_k):
+    from container_engine_accelerators_tpu.cli.serve import (
+        ContinuousEngine,
+    )
+    ref, _, _ = _run_engine(ContinuousEngine, model, "off")
+    got, sticks, _ = _run_engine(ContinuousEngine, model, "ngram",
+                                 spec_k=spec_k)
+    assert got == ref
+    assert sticks > 0
+
+
+def test_engine_rejection_heavy_draft_stays_identical(model):
+    """All-random prompts: drafts are mostly wrong, every verify falls
+    back to its bonus token — output must still be byte-identical."""
+    from container_engine_accelerators_tpu.cli.serve import (
+        PagedContinuousEngine,
+    )
+    prompts = [RANDOM, [2, 7, 1, 8, 2, 8, 1, 8]]
+    ref, _, _ = _run_engine(PagedContinuousEngine, model, "off",
+                            prompts=prompts, page=64)
+    got, sticks, _ = _run_engine(PagedContinuousEngine, model, "draft",
+                                 prompts=prompts, page=64)
+    assert got == ref
+    assert sticks > 0
+
+
+# ---------- acceptance-rate recorder ----------
+
+def test_recorder_observe_spec_counters_and_gauges():
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+
+    rec = RequestRecorder()
+    rec.observe_spec(drafted=8, accepted=4, verifies=2, committed=6)
+    rec.observe_spec(drafted=8, accepted=0, verifies=2, committed=2)
+
+    def sample(name):
+        for metric in rec.registry.collect():
+            for s in metric.samples:
+                if s.name == name:
+                    return s.value
+        raise AssertionError(f"{name} not exported")
+
+    assert sample("serve_spec_drafted_tokens_total") == 16
+    assert sample("serve_spec_accepted_tokens_total") == 4
+    assert sample("serve_spec_verifies_total") == 4
+    assert sample("serve_spec_committed_tokens_total") == 8
+    assert sample("serve_spec_acceptance_rate") == pytest.approx(0.25)
+    assert sample("serve_spec_tokens_per_verify") == pytest.approx(2.0)

@@ -93,7 +93,9 @@ def test_serve_batcher_stress(monkeypatch):
     calls = {"n": 0, "lock": threading.Lock()}
 
     def fake_generate(params, tokens, cfg, max_new_tokens,
-                      temperature=0.0, key=None, mesh=None):
+                      temperature=0.0, key=None, mesh=None,
+                      speculate="off", spec_k=4, draft_layers=2,
+                      spec_stats=None):
         # Uniform-bucket invariant: one batch = one shape + one config.
         arr = np.asarray(tokens)
         assert arr.ndim == 2

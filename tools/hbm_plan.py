@@ -114,7 +114,7 @@ def plan_training(cfg, *, dp=1, fsdp=1, pp=1, tp=1, sp=1, ep=1,
 
 def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
                  pool_fraction=0.5, weight_bytes=2, kv_dtype="bf16",
-                 chip="v5p") -> dict:
+                 weight_dtype="bf16", chip="v5p") -> dict:
     """Per-chip HBM for the paged serving deployment (cli/serve.py
     defaults: pool = half the full slots x max_len reservation).
 
@@ -122,32 +122,54 @@ def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
     byte per element plus one f32 scale per (token, head) for each of
     K and V (ops/quant.quantize_kv) — ~0.52x the bf16 cache at
     head_dim 128, which is what lets the same pool hold ~2x the
-    slots."""
+    slots. kv_dtype='int4' packs two elements per byte (same scale
+    plane): ~0.28x bf16.
+
+    weight_dtype='int8' prices --weight-dtype int8: the projection and
+    lm_head tensors store one byte per element plus one f32 scale per
+    OUTPUT channel (ops/quant.quantize_weights) — the per-channel
+    scale overhead is ~4/d_model relative, so the quantized set costs
+    ~0.51x its bf16 bytes. Embedding and norms stay bf16
+    (quantize_llama_params leaves them out).
+
+    `resident_slots` answers the capacity question directly: how many
+    FULLY-BACKED max_len slots fit in the HBM left after weights —
+    the number --kv-dtype/--weight-dtype exist to raise."""
     attn, mlp, moe = _layer_param_elems(cfg)
     L = cfg.n_layers
     embed = cfg.vocab_size * cfg.d_model          # replicated (decode)
     lm_head = cfg.vocab_size * cfg.d_model / tp
     moe_div = tp if (cfg.n_experts and cfg.moe_decode_ep) else 1
     layers = L * ((attn + mlp) / tp + moe / moe_div)
-    weights = (embed + lm_head + layers + cfg.d_model) * weight_bytes
+    if weight_dtype == "int8":
+        q_bytes = 1 + 4 / cfg.d_model  # payload + per-out-channel f32
+        weights = (embed * weight_bytes + (lm_head + layers) * q_bytes
+                   + cfg.d_model * weight_bytes)
+    else:
+        weights = (embed + lm_head + layers + cfg.d_model) * weight_bytes
 
     hd = cfg.head_dim
     # Bytes per (token, head) of ONE of K or V: payload + scale plane.
-    kv_tok_bytes = (hd * 1 + 4 if kv_dtype == "int8"
-                    else hd * weight_bytes)
-    kv_full = (L * max_slots * max_len * 2
-               * (cfg.n_kv_heads / tp) * kv_tok_bytes)
+    if kv_dtype == "int8":
+        kv_tok_bytes = hd * 1 + 4
+    elif kv_dtype == "int4":
+        kv_tok_bytes = hd * 0.5 + 4
+    else:
+        kv_tok_bytes = hd * weight_bytes
+    slot_kv = L * max_len * 2 * (cfg.n_kv_heads / tp) * kv_tok_bytes
+    kv_full = max_slots * slot_kv
     kv = kv_full * pool_fraction
     total = weights + kv
     cap = CHIP_HBM[chip]
     return {
         "kind": "serve", "chip": chip, "hbm_gb": round(cap / GB, 1),
         "tp": tp, "slots": max_slots, "max_len": max_len,
-        "kv_dtype": kv_dtype,
+        "kv_dtype": kv_dtype, "weight_dtype": weight_dtype,
         "weights_gb": round(weights / GB, 2),
         "kv_pool_gb": round(kv / GB, 2),
         "total_gb": round(total / GB, 2),
         "headroom_gb": round((cap - total) / GB, 2),
+        "resident_slots": int(max(cap - weights, 0) // slot_kv),
         "fits": bool(total < cap),
     }
 
@@ -174,6 +196,11 @@ def shipped_plans() -> list[dict]:
         # slots in ~the same cache bytes (README serving section).
         plan_serving(cfg8b, tp=4, max_slots=16, max_len=4096,
                      chip="v5e", kv_dtype="int8"),
+        # The full quantized stack (--kv-dtype int4 --weight-dtype
+        # int8): QUADRUPLE the v5e node's slots — int4 KV is ~0.28x
+        # bf16 per token and int8 weights free ~2 GB more for cache.
+        plan_serving(cfg8b, tp=4, max_slots=32, max_len=4096,
+                     chip="v5e", kv_dtype="int4", weight_dtype="int8"),
         # Calibration pair: the bench config on the one real v5e chip —
         # batch 5 fits (measured), batch 8 does not (measured compile
         # failure). If a model change flips either, re-fit the model.

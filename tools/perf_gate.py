@@ -27,6 +27,15 @@ environment's TPU plugin), tiny shapes, fixed seeds:
                          one decode tick with a budget-bounded prefill
                          chunk interleaved before it — the two-pool
                          scheduler's TPOT invariant (RequestRecorder)
+  decode_spec_tpot_ms    per-token latency of NGRAM-speculative decode
+                         (verify_step + advance_lengths over the slot
+                         cache, prompt-lookup drafts at pinned high
+                         acceptance) — must sit BELOW
+                         decode_step_slots_ms, or speculation stopped
+                         paying for its verify pass
+  decode_w8_step_ms      slot decode step over int8-quantized weights
+                         (fused-dequant matmuls) — the --weight-dtype
+                         int8 serving hot path
   multislice_step_ms     dp=2 train step across TWO real OS processes
                          joined by jax.distributed over gloo — the
                          hermetic stand-in for the DCN gradient psum
@@ -389,6 +398,166 @@ def _decode_bench(paged: bool):
     return name, measure, perturb
 
 
+def _decode_spec_bench():
+    """('decode_spec_tpot_ms'): per-token latency of ngram-speculative
+    decode on the slot cache — the serving engines' spec tick reduced
+    to its two executables (verify_step at [4, k+1] + advance_lengths).
+
+    Acceptance is pinned high and deterministic: setup records the
+    plain greedy chain once, then every measure pass resets lengths and
+    drafts through the REAL spec.ngram_draft over a context that
+    contains the recorded chain (the copy-a-passage workload), so
+    prompt lookup proposes the true continuation and each verify
+    commits ~k+1 tokens. Each pass replays the identical trajectory —
+    determinism over realism, like the other decode benches. The
+    per-pass sample is the p50 of per-token times (iter wall *
+    n_slots / committed), directly comparable to
+    decode_step_slots_ms: speculation only earns its keep while this
+    metric sits below that one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models import spec as spec_mod
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_advance_lengths,
+        _jitted_decode_step_slots,
+        _jitted_verify_step,
+        init_slot_cache,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_slots, max_len, spec_k = 4, 128, 4
+    k1 = spec_k + 1
+    start = max_len // 4
+    step = _jitted_decode_step_slots(cfg)  # shared with _decode_bench
+    verify = _jitted_verify_step(cfg)
+    adv = _jitted_advance_lengths()
+    active = jnp.ones((n_slots,), bool)
+
+    def fresh_len():
+        return jnp.full((n_slots,), start, jnp.int32)
+
+    # Record the plain greedy chain ONCE (setup: compiles + content
+    # both land outside the guarded window).
+    max_iters = (max_len - start - k1) // k1
+    cache = init_slot_cache(cfg, n_slots, max_len)
+    cache = cache._replace(length=fresh_len())
+    toks = jnp.ones((n_slots,), jnp.int32)
+    chain = [[] for _ in range(n_slots)]
+    for _ in range((max_iters + 1) * k1):
+        lg, cache = step(params, cache, toks, active)
+        toks = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        t_host = np.asarray(toks)
+        for s in range(n_slots):
+            chain[s].append(int(t_host[s]))
+    # Drafter context: chain + [start_tok] + emitted-so-far — the
+    # trailing n-gram recurs inside the first copy and what followed it
+    # there is the future (see tools/serve_bench.spec_throughput_window).
+    base_hist = [[1] + chain[s] + [1] for s in range(n_slots)]
+    # Warm the verify/advance executables at the measured shapes.
+    warm = jnp.ones((n_slots, k1), jnp.int32)
+    _, cache = verify(params, cache, warm, active)
+    cache = adv(cache, jnp.zeros((n_slots,), jnp.int32), active)
+    float(jnp.sum(cache.length))
+    box = [cache]
+
+    def measure(n_steps: int):
+        box[0] = box[0]._replace(length=fresh_len())
+        hist = [list(h) for h in base_hist]
+        last = np.full((n_slots,), 1, dtype=np.int32)
+        times = []
+        for _ in range(min(n_steps, max_iters)):
+            t0 = time.monotonic()
+            drafts = np.empty((n_slots, spec_k), dtype=np.int32)
+            for s in range(n_slots):
+                d = spec_mod.ngram_draft(hist[s], spec_k)
+                d = (d + [int(last[s])] * spec_k)[:spec_k]
+                drafts[s] = d
+            tokens = np.concatenate([last[:, None], drafts], axis=1)
+            logits, box[0] = verify(params, box[0],
+                                    jnp.asarray(tokens), active)
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))
+            counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+            counts = np.minimum(counts, k1).astype(np.int32)
+            box[0] = adv(box[0], jnp.asarray(counts), active)
+            committed = int(counts.sum())
+            for s in range(n_slots):
+                c = int(counts[s])
+                emitted = ([int(t) for t in tokens[s, 1:c]]
+                           + [int(bonus[s])])
+                hist[s].extend(emitted)
+                last[s] = emitted[-1]
+            dt = time.monotonic() - t0
+            # Per-token, per-slot: comparable to a plain step's wall.
+            times.append(dt * n_slots / max(committed, 1))
+        return times, harness.pct_ms(times)
+
+    return "decode_spec_tpot_ms", measure, None
+
+
+def _decode_w8_bench():
+    """('decode_w8_step_ms'): the slot decode step over int8-quantized
+    weights (ops/quant.quantize_llama_params; dequant fused into every
+    projection matmul). Same shapes as decode_step_slots_ms so the pair
+    reads as 'what did --weight-dtype int8 do to the step'; a separate
+    executable (the QuantWeight pytree changes the jit signature), so
+    it warms here and is recompile-guarded like the rest. Constructed
+    before the plain decode bench so the float signature — not this
+    one — is the fn's last compile going into the guarded window (the
+    recompile-injection diff must read as a shape change)."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_slots,
+        init_slot_cache,
+    )
+    from container_engine_accelerators_tpu.ops.quant import (
+        quantize_llama_params,
+    )
+
+    cfg = llama.llama_tiny()
+    params = quantize_llama_params(
+        llama.init_params(jax.random.key(0), cfg))
+    n_slots, max_len = 4, 128
+    cache = init_slot_cache(cfg, n_slots, max_len)
+    step = _jitted_decode_step_slots(cfg)
+
+    def fresh_len():
+        return jnp.full((n_slots,), max_len // 4, jnp.int32)
+
+    cache = cache._replace(length=fresh_len())
+    toks = jnp.ones((n_slots,), jnp.int32)
+    active = jnp.ones((n_slots,), bool)
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        logits, cache = step(params, cache, toks, active)
+        float(jnp.sum(logits))
+    box = [cache, toks]
+
+    def measure(n_steps: int):
+        box[0] = box[0]._replace(length=fresh_len())
+        rec = RequestRecorder()
+        times = []
+        for _ in range(n_steps):
+            t0 = time.monotonic()
+            last, box[0] = step(params, box[0], box[1], active)
+            box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            float(jnp.sum(last))
+            dt = time.monotonic() - t0
+            times.append(dt)
+            rec.observe_decode_step(dt)
+        return times, rec.pct_ms("decode_step")
+
+    return "decode_w8_step_ms", measure, None
+
+
 def _paged_prefill_setup():
     """Shared setup for the two disaggregated-serving benches: a paged
     cache whose pool rows 1..3 hold the KV of a real 96-token prefix
@@ -734,10 +903,16 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
                 "recompiles": [], "k": k, "steps": steps,
                 "wall_s": round(time.monotonic() - t_start, 2)}
 
-    benches = [_train_bench(), _decode_bench(paged=False),
-               _decode_bench(paged=True), _matmul_bench(),
-               _prefill_cached_bench(), _decode_under_prefill_bench(),
-               _ckpt_async_bench()]
+    # The w8 bench is constructed FIRST: its warmup compiles the
+    # QuantWeight signature of decode_step_slots, and the plain decode
+    # bench's warmup then leaves the float signature as the fn's most
+    # recent compile — so the injected off-shape perturb() attributes
+    # as a dimension diff (4 -> 7), not a pytree-structure diff.
+    benches = [_decode_w8_bench(), _train_bench(),
+               _decode_bench(paged=False), _decode_bench(paged=True),
+               _matmul_bench(), _prefill_cached_bench(),
+               _decode_under_prefill_bench(), _ckpt_async_bench(),
+               _decode_spec_bench()]
     metrics: dict = {}
     results: list = []
     with harness.RecompileGuard() as guard:

@@ -27,8 +27,18 @@ in-process init that can hang (BENCH_r03's failure mode), and a failed
 probe emits a structured `status: no_signal` line instead of a
 traceback.
 
+The quant-config matrix (ISSUE 15) extends the sweep along two more
+axes: --weight-dtypes adds int8-weight lines (fused-dequant matmuls,
+ops/quant.int8_matmul) and --speculate adds ngram speculative-decoding
+lines driven through the real verify_step/advance_lengths executables,
+with acceptance_rate and tokens_per_verify columns — so the whole
+latency-floor story (cache bytes x weight bytes x tokens-per-pass)
+reads off one JSON stream.
+
 Usage:  python tools/serve_bench.py [--slots 8,16,32] [--steps 64]
-                                    [--kv-dtypes bf16,int8]
+                                    [--kv-dtypes bf16,int8,int4]
+                                    [--weight-dtypes bf16,int8]
+                                    [--speculate off,ngram]
 """
 
 from __future__ import annotations
@@ -94,6 +104,113 @@ def latency_percentile_phase(params, cache, step, toks, active,
     return rec
 
 
+def spec_throughput_window(params, cache, cfg, step, active, n_slots,
+                           max_len, n_steps, spec_k):
+    """Ngram-speculative analog of the throughput window: each
+    iteration drafts spec_k tokens per slot by prompt lookup, scores
+    them in ONE verify_step pass, and commits the accepted prefix with
+    advance_lengths — the exact executables the serving engines run.
+
+    Acceptance regime: an UNTIMED record phase first runs the plain
+    greedy chain, then lengths reset and the recorded chain is placed
+    in the drafter's context — prompt lookup now finds the true
+    continuation (the copy-a-passage workload, where speculation
+    shines), so the timed window prices the verify mechanics at high
+    acceptance through the real ngram_draft. Real acceptance is
+    workload-dependent; serve.py /metrics reports the workload's.
+
+    Speculation is inherently host-synced per verify (the drafter
+    reads the argmax), so unlike the plain window this one fences
+    every iteration; that cost is part of the number, not an artifact.
+    Returns (committed_tokens_per_s, spec_columns dict, percentile
+    columns)."""
+    import numpy as np
+
+    from container_engine_accelerators_tpu.models import spec as spec_mod
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_advance_lengths,
+        _jitted_verify_step,
+    )
+
+    verify = _jitted_verify_step(cfg)
+    adv = _jitted_advance_lengths()
+    k1 = spec_k + 1
+    # Cap iterations so length + k1 never crosses max_len (the verify
+    # writes k+1 positions ahead of the live length).
+    start = max_len // 2
+    budget = max_len - start - k1
+    n_iters = max(1, min(n_steps, budget // k1))
+
+    # Warmup (compile verify/advance) + fence, then reset lengths.
+    import jax.numpy as jnp
+    warm = jnp.ones((n_slots, k1), jnp.int32)
+    _, cache = verify(params, cache, warm, active)
+    cache = adv(cache, jnp.zeros((n_slots,), jnp.int32), active)
+    float(jnp.sum(cache.length))
+    cache = cache._replace(
+        length=jnp.full((n_slots,), start, jnp.int32))
+
+    # Record phase (untimed): the plain greedy chain from this exact
+    # cache state. Deterministic model => the replayed verify passes
+    # reproduce it token for token.
+    chain = [[] for _ in range(n_slots)]
+    toks = jnp.ones((n_slots,), jnp.int32)
+    for _ in range((n_iters + 1) * k1):
+        lg, cache = step(params, cache, toks, active)
+        toks = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        t_host = np.asarray(toks)
+        for s in range(n_slots):
+            chain[s].append(int(t_host[s]))
+    cache = cache._replace(
+        length=jnp.full((n_slots,), start, jnp.int32))
+    # Drafter context = [start_tok] + chain + [start_tok] + emitted:
+    # the trailing n-gram of (start_tok + emitted-so-far) recurs in
+    # the first copy, and what followed it there is the future.
+    hist = [[1] + chain[s] + [1] for s in range(n_slots)]
+    last = np.full((n_slots,), 1, dtype=np.int32)
+
+    drafted = accepted = committed = verifies = 0
+    iter_s, tpot_s = [], []
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        ti = time.perf_counter()
+        drafts = np.empty((n_slots, spec_k), dtype=np.int32)
+        for s in range(n_slots):
+            d = spec_mod.ngram_draft(hist[s], spec_k)
+            d = (d + [d[-1] if d else int(last[s])] * spec_k)[:spec_k]
+            drafts[s] = d
+        tokens = np.concatenate([last[:, None], drafts], axis=1)
+        logits, cache = verify(params, cache, jnp.asarray(tokens),
+                               active)
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # host sync
+        counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+        counts = np.minimum(counts, k1).astype(np.int32)
+        cache = adv(cache, jnp.asarray(counts), active)
+        for s in range(n_slots):
+            c = int(counts[s])
+            emitted = [int(t) for t in tokens[s, 1:c]] + [int(bonus[s])]
+            hist[s].extend(emitted)
+            last[s] = emitted[-1]
+        drafted += n_slots * spec_k
+        accepted += int(counts.sum()) - n_slots
+        committed += int(counts.sum())
+        verifies += n_slots
+        di = time.perf_counter() - ti
+        iter_s.append(di)
+        # Spec TPOT: wall time per committed token per slot this pass.
+        tpot_s.append(di * n_slots / max(int(counts.sum()), 1))
+    dt = time.perf_counter() - t0
+    cols = {
+        "speculate": "ngram", "spec_k": spec_k,
+        "spec_verifies": verifies,
+        "acceptance_rate": round(accepted / max(drafted, 1), 4),
+        "tokens_per_verify": round(committed / max(verifies, 1), 3),
+    }
+    pcts = {"tpot_ms": harness.pct_ms(tpot_s),
+            "verify_ms": harness.pct_ms(iter_s)}
+    return committed / dt, cols, pcts
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", default="8,16,32")
@@ -102,7 +219,23 @@ def main():
     ap.add_argument("--page", type=int, default=128)
     ap.add_argument("--kv-dtypes", default="bf16,int8",
                     help="comma list of KV-cache dtypes to sweep "
-                         "(bf16, int8)")
+                         "(bf16, int8, int4)")
+    ap.add_argument("--weight-dtypes", default="bf16",
+                    help="comma list of weight dtypes to sweep (bf16, "
+                         "int8 — int8 quantizes once per dtype via "
+                         "ops/quant.quantize_llama_params and the "
+                         "fused-dequant matmul path prices itself on "
+                         "its own JSON lines)")
+    ap.add_argument("--speculate", default="off",
+                    help="comma list from {off,ngram}: ngram adds "
+                         "speculative-decoding lines (verify_step + "
+                         "advance_lengths, prompt-lookup drafts) with "
+                         "acceptance_rate / tokens_per_verify columns. "
+                         "Draft-model speculation is an engine policy "
+                         "(cli/serve.py --speculate draft), not a "
+                         "kernel shape — bench it through serve "
+                         "itself.")
+    ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--tiny", action="store_true",
                     help="llama_tiny on the CPU backend — a smoke test "
                          "of the harness, not a measurement")
@@ -153,9 +286,32 @@ def main():
     params = llama.init_params(jax.random.key(0), base_cfg)
     max_len = 256 if args.tiny else args.max_len
 
+    # Quantize ONCE per weight dtype, outside the sweep loops: the
+    # int8 pytree is reused by every (engine, slots, kv, spec) line.
+    weight_dtypes = args.weight_dtypes.split(",")
+    spec_modes = args.speculate.split(",")
+    params_by_wd = {}
+    for wd in weight_dtypes:
+        if wd == "bf16":
+            params_by_wd[wd] = params
+        elif wd == "int8":
+            from container_engine_accelerators_tpu.ops.quant import (
+                quantize_llama_params,
+            )
+            params_by_wd[wd] = quantize_llama_params(params)
+        else:
+            raise SystemExit(f"unknown weight dtype {wd!r}")
+    for sm in spec_modes:
+        if sm not in ("off", "ngram"):
+            raise SystemExit(f"unknown --speculate mode {sm!r} "
+                             "(serve_bench sweeps off/ngram)")
+
     for n_slots in [int(s) for s in args.slots.split(",")]:
         for engine in ("slot", "paged"):
-            for kv_dtype in args.kv_dtypes.split(","):
+            for kv_dtype, wd, spec_mode in [
+                    (k, w, s) for k in args.kv_dtypes.split(",")
+                    for w in weight_dtypes for s in spec_modes]:
+                run_params = params_by_wd[wd]
                 cfg = dataclasses.replace(base_cfg,
                                           kv_cache_dtype=kv_dtype)
                 if engine == "slot":
@@ -180,8 +336,27 @@ def main():
                 toks = jnp.ones((n_slots,), jnp.int32)
                 active = jnp.ones((n_slots,), bool)
 
+                if spec_mode == "ngram":
+                    # Speculative line: the verify/advance pair IS the
+                    # hot path; the plain step never runs.
+                    tps, spec_cols, pcts = spec_throughput_window(
+                        run_params, cache, cfg, step, active, n_slots,
+                        max_len, args.steps, args.spec_k)
+                    line = harness.make_result(
+                        METRIC, round(tps, 1), UNIT,
+                        percentiles=pcts, backend_probe=probe,
+                        status="ok", engine=engine, slots=n_slots,
+                        kv_dtype=kv_dtype, weight_dtype=wd,
+                        max_len=max_len, tokens_per_s=round(tps, 1),
+                        **spec_cols)
+                    harness.attach_peak_hbm(line,
+                                            context="serve_bench")
+                    print(json.dumps(harness.check_result(line)),
+                          flush=True)
+                    continue
+
                 # Warmup (compile) + fence.
-                logits, cache = step(params, cache, toks, active)
+                logits, cache = step(run_params, cache, toks, active)
                 float(jnp.sum(logits))
                 cache = cache._replace(
                     length=jnp.full((n_slots,), max_len // 2, jnp.int32))
@@ -193,7 +368,8 @@ def main():
                     t0 = time.perf_counter()
                     last = None
                     for _ in range(args.steps):
-                        last, cache = step(params, cache, toks, active)
+                        last, cache = step(run_params, cache, toks,
+                                           active)
                         # Chain tokens through the cache dependency;
                         # greedy pick on-device keeps the loop
                         # fence-free.
@@ -207,7 +383,7 @@ def main():
                         {f"slots{n_slots}": round(n_slots / dt, 1)})
 
                 rec = latency_percentile_phase(
-                    params, cache, step, toks, active, n_slots,
+                    run_params, cache, step, toks, active, n_slots,
                     max_len, min(args.steps, 32))
                 # Recorder-derived percentile columns (ms). TTFT here =
                 # first fenced decode step (no prefill/queue in this
@@ -220,6 +396,7 @@ def main():
                     METRIC, round(n_slots / dt, 1), UNIT,
                     percentiles=pcts, backend_probe=probe, status="ok",
                     engine=engine, slots=n_slots, kv_dtype=kv_dtype,
+                    weight_dtype=wd, speculate="off",
                     step_ms=round(dt * 1e3, 3), max_len=max_len,
                     tokens_per_s=round(n_slots / dt, 1), **pcts)
                 # Process-lifetime allocator high-water mark at
