@@ -221,11 +221,20 @@ spec-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec_decode.py \
 	    tests/test_kv_quant.py -q
 
+# Async double-buffered engine core smoke (ISSUE 16): greedy
+# token-identity async-vs-sync for the window/slot/paged/speculative
+# paths, FIFO-within-bucket under the deque partition, supervised
+# recovery with a pipelined in-flight tick (zero leaked pages), and the
+# recorder's host-gap accounting. CPU-hermetic; the host_gap_fraction
+# perf check itself rides in `make perf-gate`.
+async-core-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_async_core.py -q
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
     serve-pools-smoke multislice-smoke dcn-overlap-smoke \
-    preemption-smoke spec-smoke chaos-smoke
+    preemption-smoke spec-smoke async-core-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -240,4 +249,5 @@ clean:
     train-obs-smoke trace-smoke introspect-smoke doctor-smoke \
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
-    dcn-overlap-smoke preemption-smoke spec-smoke smoke dryrun clean
+    dcn-overlap-smoke preemption-smoke spec-smoke async-core-smoke \
+    smoke dryrun clean

@@ -38,7 +38,9 @@ timeline.
 from __future__ import annotations
 
 import argparse
+import collections
 import concurrent.futures
+import contextlib
 import itertools
 import json
 import logging
@@ -154,12 +156,70 @@ def _maybe_injected_hang(engine):
         time.sleep(s)
 
 
+class _PhaseClock:
+    """Per-tick host-phase stopwatch (ISSUE 16). Each slice of engine
+    host work is attributed to a named phase (admit/schedule/sample/
+    stream/fetch) and flagged `hidden` when it ran entirely under a
+    dispatched-but-unfetched device tick that was still executing —
+    host time that cost no device idleness. The exposed remainder over
+    the tick's wall time is the recorder's `host_gap_fraction`.
+
+    Hidden is decided by a `busy_probe` at phase END: the engine probes
+    jax.Array.is_ready() on the newest in-flight tick, so a phase only
+    counts hidden when the device was provably still busy when the
+    phase closed. If the device finished mid-phase (or nothing was in
+    flight), the phase is exposed — the device sat idle for at least
+    part of it. Two forced cases bypass the probe via `exposed=`:
+      - the fetch fence is never exposure (exposed=False): the host is
+        waiting on device work there, which is device time — it still
+        contributes a phase SAMPLE for attribution;
+      - work known to run under a dispatch the probe cannot see (the
+        spec-decode commit runs under the un-fenced advance_lengths
+        call, which is not tracked in _inflight) passes exposed=False.
+    """
+
+    __slots__ = ("rec", "_busy", "_tick_t0", "_exposed")
+
+    def __init__(self, recorder, busy_probe=None):
+        self.rec = recorder
+        self._busy = busy_probe if busy_probe is not None else (
+            lambda: False)
+        self._tick_t0 = None
+        self._exposed = 0.0
+
+    def start_tick(self) -> None:
+        self._tick_t0 = time.monotonic()
+        self._exposed = 0.0
+
+    @contextlib.contextmanager
+    def phase(self, name: str, exposed: bool | None = None):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            dt = time.monotonic() - t0
+            hidden = self._busy() if exposed is None else not exposed
+            self.rec.observe_host_phase(name, dt, hidden)
+            if not hidden:
+                self._exposed += dt
+
+    def commit_tick(self) -> None:
+        """Close one tick's exposure accounting; no-op unless
+        start_tick ran (idle loop iterations never commit, so parked
+        waits don't dilute the fraction)."""
+        if self._tick_t0 is None:
+            return
+        self.rec.observe_host_tick(
+            self._exposed, time.monotonic() - self._tick_t0)
+        self._tick_t0 = None
+
+
 class BatchingEngine:
     def __init__(self, params, cfg, max_batch: int = 8,
                  window_ms: float = 5.0, max_prompt_len: int = 1024,
                  mesh=None, recorder: RequestRecorder | None = None,
                  speculate: str = "off", spec_k: int = 4,
-                 draft_layers: int = 2):
+                 draft_layers: int = 2, engine_core: str = "async"):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -197,11 +257,22 @@ class BatchingEngine:
         # the recovery path under test).
         self.fault_hang_s = 0.0
         self.fault_kill = False
+        # Async double-buffered core (ISSUE 16): "async" dispatches
+        # batch t+1's generate() while batch t's output array is still
+        # materializing on device (JAX async dispatch), fetching batch
+        # t one batch behind; "sync" fetches immediately — the
+        # token-identity reference path.
+        self.engine_core = engine_core
         # In-flight state lives on the ENGINE, not in worker locals:
         # after a worker death the supervisor must be able to find and
-        # fail every request the dead thread was holding.
-        self._pending: list = []
+        # fail every request the dead thread was holding. _pending is a
+        # deque: the gather loop partitions it in one pass instead of
+        # the old O(n*m) pop(0)/pop(i) shuffle.
+        self._pending: collections.deque = collections.deque()
         self._batch: list = []
+        # Dispatched-but-unfetched batches (at most one): each entry is
+        # {"batch": items, "out": device array, "stats", "t0"}.
+        self._inflight: list = []
         self.worker_restarts = 0
         self._stop = threading.Event()
         self._start_worker()
@@ -239,10 +310,13 @@ class BatchingEngine:
         queued — with structured errors, and zero the occupancy gauges.
         Called by the EngineSupervisor BEFORE it restarts the worker;
         clients see `{"error": ...}` instead of a silent stream hang."""
-        for item in self._batch + self._pending:
+        inflight = [item for rec in self._inflight
+                    for item in rec["batch"]]
+        for item in inflight + self._batch + list(self._pending):
             _fail(item[3], item[4], err, item[5], self.recorder)
+        self._inflight = []
         self._batch = []
-        self._pending = []
+        self._pending.clear()
         while True:
             try:
                 item = self.queue.get_nowait()
@@ -272,9 +346,10 @@ class BatchingEngine:
             self.params = decode_tp.shard_decode_params(
                 self.params, self.mesh, self.cfg)
 
+        clock = _PhaseClock(self.recorder, self._device_busy)
         # Parked/in-flight items live on the engine (self._pending /
-        # self._batch) so the supervisor can fail them after a worker
-        # death instead of leaking their futures.
+        # self._batch / self._inflight) so the supervisor can fail them
+        # after a worker death instead of leaking their futures.
         pending = self._pending
         while not self._stop.is_set():
             _maybe_injected_hang(self)
@@ -285,25 +360,35 @@ class BatchingEngine:
                 # Park on the Event, then drain non-blocking: no timed
                 # queue-get anywhere (see __init__ on the lost-wakeup
                 # race); a missed set costs one 0.1 s wake at most.
-                self._work.wait(0.1)
+                # With a batch in flight, skip the park entirely: its
+                # results must land now, not 0.1 s from now.
+                if not self._inflight:
+                    self._work.wait(0.1)
                 self._work.clear()
                 try:
                     pending.append(self.queue.get_nowait())
                 except queue.Empty:
+                    if self._inflight:
+                        clock.start_tick()
+                        self._drain_batches(clock)
+                        clock.commit_tick()
                     continue
             # Gather same-bucket requests for one window.
             deadline = time.monotonic() + self.window
             key = self._bucket_key(pending[0])
-            batch = self._batch = [pending.pop(0)]
-            # Drain previously-parked same-bucket requests first: mixed
-            # traffic parks items here, and without this sweep each one
-            # would get its own single-request generate() call.
-            i = 0
-            while i < len(pending) and len(batch) < self.max_batch:
-                if self._bucket_key(pending[i]) == key:
-                    batch.append(pending.pop(i))
+            batch = self._batch = [pending.popleft()]
+            # Single-pass partition of previously-parked requests:
+            # same-bucket items join the batch, everything else rotates
+            # back — both sides keep their arrival order, so FIFO holds
+            # WITHIN each bucket under mixed traffic (the old
+            # pop(0)/pop(i) list shuffle was O(n*m) in parked items).
+            for _ in range(len(pending)):
+                item = pending.popleft()
+                if (len(batch) < self.max_batch
+                        and self._bucket_key(item) == key):
+                    batch.append(item)
                 else:
-                    i += 1
+                    pending.append(item)
             while len(batch) < self.max_batch:
                 try:
                     item = self.queue.get_nowait()
@@ -322,9 +407,11 @@ class BatchingEngine:
                     pending.append(item)
 
             rec = self.recorder
-            for item in batch:
-                rec.admit(item[5])
-            rec.set_slots(active=len(batch), total=self.max_batch)
+            clock.start_tick()
+            with clock.phase("admit"):
+                for item in batch:
+                    rec.admit(item[5])
+                rec.set_slots(active=len(batch), total=self.max_batch)
             tokens = jnp.asarray([item[0] for item in batch], jnp.int32)
             n_new, temp = batch[0][1], batch[0][2]
             t_batch = time.monotonic()
@@ -334,41 +421,19 @@ class BatchingEngine:
                 spec = (self.speculate
                         if temp <= 0 and self.mesh is None else "off")
                 stats: dict = {}
-                with annotate("serve/decode_tick"):
+                # Dispatch only: generate()'s plain path never fences,
+                # so `out` is a lazy device array and the host is free
+                # to gather/dispatch the NEXT batch while it computes
+                # (speculative generate fences internally; the deferred
+                # fetch still overlaps its final conversion).
+                with annotate("serve/decode_tick"), \
+                        clock.phase("schedule"):
                     out = generate(self.params, tokens, self.cfg, n_new,
                                    temperature=temp, key=key_arr,
                                    mesh=self.mesh, speculate=spec,
                                    spec_k=self.spec_k,
                                    draft_layers=self.draft_layers,
                                    spec_stats=stats)
-                    out_host = [[int(t) for t in row] for row in out]
-                if stats:
-                    rec.observe_spec(
-                        drafted=stats.get("drafted", 0),
-                        accepted=stats.get("accepted", 0),
-                        verifies=stats.get("verifies", 0),
-                        committed=stats.get("committed", 0))
-                batch_dt = time.monotonic() - t_batch
-                for item, row in zip(batch, out_host):
-                    rid = item[5]
-                    item[3].set_result(row)
-                    # Window batching has no incremental tokens: the
-                    # stream degenerates to generated-tokens + done, the
-                    # client's real TTFT is batch completion, and TPOT
-                    # amortizes the batch time over the generated
-                    # tokens (keeps observation counts engine-uniform).
-                    rec.first_token(rid)
-                    n_gen = len(row) - len(item[0])
-                    for _ in range(n_gen - 1):
-                        rec.observe_tpot(batch_dt / max(n_gen, 1))
-                    if item[4] is not None:
-                        for t in row[len(item[0]):]:
-                            _stream_event(item[4], {"token": t}, rid)
-                        _stream_event(item[4],
-                                      {"done": True, "tokens": row}, rid)
-                    rec.finish(rid)
-                self.batches_run += 1
-                self.requests_served += len(batch)
             except Exception as e:
                 # RESOURCE_EXHAUSTED leaves an atomic post-mortem bundle
                 # (per-device memory, live-array census, compile cache,
@@ -377,8 +442,85 @@ class BatchingEngine:
                 log.exception("batch failed")
                 for item in batch:
                     _fail(item[3], item[4], e, item[5], rec)
+                self._batch = []
+                rec.set_slots(active=0, total=self.max_batch)
+                continue
+            self._inflight.append({"batch": batch, "out": out,
+                                   "stats": stats, "t0": t_batch})
             self._batch = []
-            rec.set_slots(active=0, total=self.max_batch)
+            # Async core: fetch ONE batch behind — batch t's results
+            # land while batch t+1 executes. Sync fetches immediately.
+            keep = 1 if self.engine_core == "async" else 0
+            self._drain_batches(clock, keep=keep)
+            clock.commit_tick()
+
+    def _device_busy(self) -> bool:
+        """True while the newest dispatched-but-unfetched batch is
+        still executing on device (host work right now is hidden under
+        it). Non-blocking probe via jax.Array.is_ready()."""
+        if not self._inflight:
+            return False
+        out = self._inflight[-1]["out"]
+        try:
+            return not out.is_ready()
+        except AttributeError:
+            # Already materialized (speculative generate fences
+            # internally and returns host data): device is idle.
+            return False
+
+    def _drain_batches(self, clock, keep: int = 0) -> None:
+        """Fetch outstanding dispatched batches until at most `keep`
+        remain; zeroes the slot gauge once nothing is in flight."""
+        while len(self._inflight) > keep:
+            self._fetch_batch(clock)
+        if not self._inflight:
+            self.recorder.set_slots(active=0, total=self.max_batch)
+
+    def _fetch_batch(self, clock) -> None:
+        """Materialize the OLDEST dispatched batch (the engine's only
+        host fence) and deliver its results/streams."""
+        rec = self.recorder
+        fl = self._inflight.pop(0)
+        batch, out, stats = fl["batch"], fl["out"], fl["stats"]
+        try:
+            with clock.phase("fetch", exposed=False):
+                out_host = [[int(t) for t in row] for row in out]
+        except Exception as e:
+            # Async dispatch defers device errors to materialization:
+            # they surface HERE, one batch after dispatch.
+            introspection.note_failure(e, "serve/window_batch")
+            log.exception("batch failed")
+            for item in batch:
+                _fail(item[3], item[4], e, item[5], rec)
+            return
+        if stats:
+            rec.observe_spec(
+                drafted=stats.get("drafted", 0),
+                accepted=stats.get("accepted", 0),
+                verifies=stats.get("verifies", 0),
+                committed=stats.get("committed", 0))
+        batch_dt = time.monotonic() - fl["t0"]
+        with clock.phase("stream"):
+            for item, row in zip(batch, out_host):
+                rid = item[5]
+                item[3].set_result(row)
+                # Window batching has no incremental tokens: the
+                # stream degenerates to generated-tokens + done, the
+                # client's real TTFT is batch completion, and TPOT
+                # amortizes the batch time over the generated
+                # tokens (keeps observation counts engine-uniform).
+                rec.first_token(rid)
+                n_gen = len(row) - len(item[0])
+                for _ in range(n_gen - 1):
+                    rec.observe_tpot(batch_dt / max(n_gen, 1))
+                if item[4] is not None:
+                    for t in row[len(item[0]):]:
+                        _stream_event(item[4], {"token": t}, rid)
+                    _stream_event(item[4],
+                                  {"done": True, "tokens": row}, rid)
+                rec.finish(rid)
+        self.batches_run += 1
+        self.requests_served += len(batch)
 
 
 class PrefillBudget:
@@ -465,7 +607,7 @@ class ContinuousEngine:
                  prefill_workers: int = 0, mesh=None,
                  recorder: RequestRecorder | None = None,
                  speculate: str = "off", spec_k: int = 4,
-                 draft_layers: int = 2):
+                 draft_layers: int = 2, engine_core: str = "async"):
         from container_engine_accelerators_tpu.models.decode import (
             _kernel_eligible,
         )
@@ -510,6 +652,32 @@ class ContinuousEngine:
         self.prefill_workers = max(int(prefill_workers), 0)
         self._budget = PrefillBudget(self.prompt_bucket,
                                      self.prefill_chunk)
+        # Async double-buffered core (ISSUE 16): tick t+1's
+        # static-shaped inputs are dispatched while tick t executes on
+        # device; admission, bucket/page work and stream fan-out run in
+        # the gap, and the result fetch — the only host fence — trails
+        # one tick behind. "sync" is the fetch-immediately reference
+        # path the token-identity tests compare against. Pools mode
+        # stays synchronous: the decode tick and prefill chunks already
+        # interleave under _mu from different threads, and a trailing
+        # fetch would hold slot bookkeeping stale across lock handoffs.
+        if self.prefill_workers:
+            engine_core = "sync"
+        self.engine_core = engine_core
+        # Dispatched-but-unfetched decode ticks, oldest first (at most
+        # one between loop iterations, briefly two inside the tick).
+        # Lives on the ENGINE: after a worker death the supervisor
+        # reclaims these alongside the slots they reference.
+        self._inflight: list = []
+        # Device-resident last-token vector: pick_tokens output feeds
+        # the next step device-to-device; the host mirror
+        # (self._last_tok) trails one tick behind, updated at fetch.
+        self._dev_tok = None
+        # Host-known token injections for the next dispatch (slot ->
+        # token): freshly prefilled slots sample their first token on
+        # the host, merged into _dev_tok via merge_tokens.
+        self._tok_overrides: dict = {}
+        self._clock = _PhaseClock(self.recorder, self._device_busy)
         # Engine lock: in pools mode the decode tick and the prefill
         # chunks mutate the same slot table and DONATED cache from
         # different threads, so both hold _mu across their device call
@@ -607,6 +775,15 @@ class ContinuousEngine:
         under _mu: in pools mode live prefill workers share this
         state and must never see it half-recovered."""
         with self._mu:
+            # Pipelined core: the dead worker can leave up to TWO
+            # outstanding ticks — the dispatched-but-unfetched one and
+            # the one it was forming. Both reference slots still in
+            # self._slots, so dropping the in-flight records here and
+            # failing the slots below reclaims everything (the paged
+            # override frees their pages first).
+            self._inflight = []
+            self._dev_tok = None
+            self._tok_overrides = {}
             for sl in getattr(self, "_slots", []):
                 if sl is not None:
                     _fail(sl["fut"], sl["stream"], err, sl["rid"],
@@ -803,20 +980,32 @@ class ContinuousEngine:
         if self.prefill_workers:
             return self._decode_pool_loop()
 
+        # Pipelined loop (engine_core="async"): while tick t is in
+        # flight on device, this iteration's admit/prefill/page-growth
+        # host work runs in the gap, tick t+1 dispatches behind it, and
+        # only then is tick t fetched — inside _decode_tick, one tick
+        # behind the dispatch. The _PhaseClock attributes each host
+        # slice and flags it hidden when a tick was outstanding.
+        clock = self._clock
         while not self._stop.is_set():
             _maybe_injected_hang(self)
             self._pump_queue()
-            with annotate("serve/admit"):
+            clock.start_tick()
+            with annotate("serve/admit"), clock.phase("admit"):
                 self._admit_phase()
             self._record_occupancy()
             if all(sl is None for sl in self._slots):
                 continue
-            with annotate("serve/prefill_chunk"):
+            with annotate("serve/prefill_chunk"), \
+                    clock.phase("schedule"):
                 self._prefill_tick()
-            if not self._pre_step():
+            with clock.phase("schedule"):
+                ok = self._pre_step()
+            if not ok:
                 continue
             with annotate("serve/decode_tick"):
-                self._decode_tick()
+                if self._decode_tick():
+                    clock.commit_tick()
 
     # ---------- disaggregated pools (--prefill-workers > 0) ----------
 
@@ -1039,12 +1228,17 @@ class ContinuousEngine:
         self.prefills_run += 1
         key = jax.random.fold_in(self._base_key,
                                  self.prefills_run & 0xFFFFFFF)
+        # Deliberate fence: the first token must be host-known to
+        # stream TTFT; it merges into the device token vector via
+        # merge_tokens at the next dispatch.
+        # tpulint: allow=TPL010(first token streams TTFT, host-known)
         tok = int(self._pick_fn(
             last_logits[None, :], jnp.asarray([sl["temp"]], jnp.float32),
             key)[0])
         sl["out"].append(tok)
         sl["remaining"] -= 1
         self._last_tok[i] = tok
+        self._tok_overrides[i] = tok
         self.recorder.first_token(sl["rid"])
         _stream_event(sl["stream"], {"token": tok}, sl["rid"])
         if sl["remaining"] <= 0:
@@ -1055,58 +1249,163 @@ class ContinuousEngine:
             self._work.set()
         return True
 
-    def _decode_tick(self):
-        """One decode step over every DECODING slot (prefilling slots
-        stay inactive: their lengths hold and their garbage writes land
-        in positions the next chunk overwrites — or the trash page on
-        the paged path)."""
+    def _decode_tick(self) -> bool:
+        """Dispatch one decode step over every DECODING slot (prefilling
+        slots stay inactive: their lengths hold and their garbage writes
+        land in positions the next chunk overwrites — or the trash page
+        on the paged path). Async core: step and pick_tokens dispatch
+        WITHOUT a fence; count-based bookkeeping (lengths, remaining
+        budgets) moves at dispatch so the next iteration's masks and
+        page lookahead see post-tick state, while token VALUES land one
+        tick later in _fetch_tick. The sync core fetches immediately.
+        Returns True iff a tick dispatched or an outstanding one was
+        fetched (the caller commits host-gap accounting then)."""
         import jax
         import jax.numpy as jnp
 
         if self._spec_tick:
             self._spec_tick = False
+            # Speculative rounds fence internally (host accept/reject)
+            # and draft from host-side history, so the pipeline drains
+            # first: _last_tok and out must be current.
+            self._drain_inflight()
             if self._spec_decode_tick():
-                return
+                return True
         decoding = [sl is not None and not sl["pending"]
+                    and sl["remaining"] > 0
                     for sl in self._slots]
         if not any(decoding):
+            # Nothing to dispatch: land whatever is still in flight
+            # (slots whose budget drained finish inside the fetch).
+            fetched = bool(self._inflight)
+            self._drain_inflight()
+            return fetched
+        with self._clock.phase("schedule"):
+            # Input tokens stay device-resident across ticks: the
+            # previous pick_tokens output feeds this step directly,
+            # with host-sampled first tokens (fresh prefills) merged
+            # in. The host-mirror path serves the sync core and the
+            # first tick after a reset/spec round.
+            if self._dev_tok is None:
+                tokens_arr = jnp.asarray(self._last_tok, jnp.int32)
+            elif self._tok_overrides:
+                ov = [self._tok_overrides.get(i, 0)
+                      for i in range(self.max_slots)]
+                mk = [i in self._tok_overrides
+                      for i in range(self.max_slots)]
+                tokens_arr = self._merge_fn(
+                    self._dev_tok, jnp.asarray(ov, jnp.int32),
+                    jnp.asarray(mk, bool))
+            else:
+                tokens_arr = self._dev_tok
+            self._tok_overrides = {}
+            active_arr = jnp.asarray(decoding, bool)
+            temps_arr = jnp.asarray(self._temps, jnp.float32)
+            t_step = time.monotonic()
+            try:
+                logits, self._cache = self._step_fn(
+                    self.params, self._cache, tokens_arr, active_arr)
+                self.steps_run += 1
+                self.batches_run = self.steps_run
+                key = jax.random.fold_in(self._base_key,
+                                         (self.steps_run & 0xFFFFFFF)
+                                         | (1 << 28))
+                toks_dev = self._pick_fn(logits, temps_arr, key)
+            except Exception as e:
+                # Bundle FIRST: _reset rebuilds the pool, and the
+                # census must capture what was resident at death.
+                introspection.note_failure(e, "serve/decode_tick")
+                log.exception("decode step failed")
+                self._reset(e)
+                return False
+        with self._clock.phase("sample"):
+            if self.engine_core == "async":
+                self._dev_tok = toks_dev
+            # Count-based bookkeeping at dispatch, mirroring the
+            # device-side length advance the step queued. The slot
+            # stays OCCUPIED (and its pages held) until its token
+            # values are fetched. Whether THIS tick is a slot's last
+            # is pinned here: by fetch time a later dispatch may have
+            # already decremented `remaining` past this tick's view.
+            ticked = []
+            for i, sl in enumerate(self._slots):
+                if not decoding[i]:
+                    continue
+                sl["len"] = min(sl["len"] + 1, self.max_len)
+                sl["remaining"] -= 1
+                ticked.append((i, sl["remaining"] <= 0))
+            self._inflight.append(
+                {"toks": toks_dev, "slots": ticked, "t0": t_step})
+        # Fetch one tick behind (async) or immediately (sync).
+        keep = 1 if self.engine_core == "async" else 0
+        while len(self._inflight) > keep:
+            self._fetch_tick()
+        return True
+
+    def _fetch_tick(self) -> None:
+        """Materialize the OLDEST outstanding decode tick — the async
+        core's only host fence — and run its value bookkeeping: output
+        lists, the host token mirror, stream fan-out, recorder edges,
+        slot release. In steady state this runs with tick t+1 already
+        in flight, so the fan-out is hidden under device execution."""
+        import numpy as np
+
+        if not self._inflight:
             return
-        tokens_arr = jnp.asarray(self._last_tok, jnp.int32)
-        active_arr = jnp.asarray(decoding, bool)
-        temps_arr = jnp.asarray(self._temps, jnp.float32)
-        t_step = time.monotonic()
+        fl = self._inflight.pop(0)
         try:
-            logits, self._cache = self._step_fn(
-                self.params, self._cache, tokens_arr, active_arr)
-            self.steps_run += 1
-            self.batches_run = self.steps_run
-            key = jax.random.fold_in(self._base_key,
-                                     (self.steps_run & 0xFFFFFFF)
-                                     | (1 << 28))
-            # The int() conversions fence the step, so the observed
-            # latency covers the device round trip, not just dispatch.
-            toks = [int(t) for t in self._pick_fn(logits, temps_arr, key)]
+            with self._clock.phase("fetch", exposed=False):
+                # The pipeline's one deliberate fence: tick t's
+                # tokens, fetched under tick t+1.
+                # tpulint: allow=TPL010(the one sanctioned fetch fence)
+                toks = np.asarray(fl["toks"])
         except Exception as e:
-            # Bundle FIRST: _reset rebuilds the pool, and the census
-            # must capture what was resident when the step died.
+            # Async dispatch defers device errors to materialization:
+            # a failed step surfaces here, one tick after dispatch.
             introspection.note_failure(e, "serve/decode_tick")
             log.exception("decode step failed")
             self._reset(e)
             return
-        t_tick = time.monotonic() - t_step
+        # Dispatch-to-fetch span: the tick's device execution plus the
+        # host work hidden under it — pipelined per-tick wall time.
+        t_tick = time.monotonic() - fl["t0"]
         self.recorder.observe_decode_step(t_tick)
         self._budget.note_decode(t_tick)
-        for i, sl in enumerate(self._slots):
-            if sl is None or sl["pending"]:
-                continue
-            sl["out"].append(toks[i])
-            sl["len"] = min(sl["len"] + 1, self.max_len)
-            self._last_tok[i] = toks[i]
-            sl["remaining"] -= 1
-            self.recorder.decode_token(sl["rid"])
-            _stream_event(sl["stream"], {"token": toks[i]}, sl["rid"])
-            if sl["remaining"] <= 0:
-                self._finish(i)
+        with self._clock.phase("stream"):
+            for i, final in fl["slots"]:
+                sl = self._slots[i]
+                if sl is None:
+                    continue  # reclaimed by reset/recovery before fetch
+                # tpulint: allow=TPL010(host numpy scalar, fence paid)
+                tok = int(toks[i])
+                sl["out"].append(tok)
+                self._last_tok[i] = tok
+                self.recorder.decode_token(sl["rid"])
+                _stream_event(sl["stream"], {"token": tok}, sl["rid"])
+                # `final` was pinned at dispatch: a later in-flight
+                # dispatch may already have driven `remaining` to zero,
+                # and finishing on that would drop the true last token.
+                if final:
+                    self._finish(i)
+
+    def _drain_inflight(self) -> None:
+        """Fetch every outstanding tick (pipeline barrier): spec
+        rounds, page-pressure preemption and shutdown paths need the
+        host view current before proceeding."""
+        while self._inflight:
+            self._fetch_tick()
+
+    def _device_busy(self) -> bool:
+        """True while the newest dispatched-but-unfetched tick is still
+        executing on device (host work right now is hidden under it).
+        Non-blocking probe via jax.Array.is_ready()."""
+        if not self._inflight:
+            return False
+        toks = self._inflight[-1]["toks"]
+        try:
+            return not toks.is_ready()
+        except AttributeError:
+            return False  # already host-materialized: device is idle
 
     def _spec_decode_tick(self) -> bool:
         """One draft+verify+commit round over every decoding slot:
@@ -1125,6 +1424,10 @@ class ContinuousEngine:
 
         s = self.max_slots
         k = self.spec_k
+        # Speculative rounds advance tokens host-side; the device
+        # last-token vector is stale after this, so the next plain
+        # dispatch rebuilds it from the host mirror.
+        self._dev_tok = None
         decoding = [sl is not None and not sl["pending"]
                     for sl in self._slots]
         drafts = np.zeros((s, k), np.int32)
@@ -1148,34 +1451,41 @@ class ContinuousEngine:
                         self._draft_params, self._draft_cache, cur,
                         active_arr)
                     cur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                    # Draft tokens feed the host-built verify batch.
+                    # tpulint: allow=TPL010(inherent per-draft fence)
                     drafts[:, j] = np.asarray(cur)
             tokens = np.concatenate(
+                # tpulint: allow=TPL010(host mirror, already fetched)
                 [np.asarray(self._last_tok, np.int32)[:, None], drafts],
                 axis=1)
             logits, self._cache = self._verify_fn(
                 self.params, self._cache, jnp.asarray(tokens), active_arr)
+            # tpulint: allow=TPL010(verify fence: accept needs argmax)
             greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
         except Exception as e:
             introspection.note_failure(e, "serve/decode_tick")
             log.exception("speculative verify failed")
             self._reset(e)
             return True
-        counts, bonus = spec_mod.greedy_verify(greedy, tokens)
-        # Draft mode never commits the bonus token: its K/V is absent
-        # from the draft cache (the drafter stepped only k times), so
-        # committing it would desync the caches — it is re-derived as
-        # the next round's first verify logit instead.
-        cap = k if self.speculate == "draft" else k + 1
-        commit = np.zeros(s, np.int32)
-        emitted: dict = {}
-        for i, sl in enumerate(self._slots):
-            if not decoding[i]:
-                continue
-            a = int(counts[i]) - 1
-            seq = [int(t) for t in tokens[i, 1:1 + a]] + [int(bonus[i])]
-            c = min(len(seq), cap, sl["remaining"])
-            commit[i] = c
-            emitted[i] = seq[:c]
+        with self._clock.phase("sample"):
+            counts, bonus = spec_mod.greedy_verify(greedy, tokens)
+            # Draft mode never commits the bonus token: its K/V is
+            # absent from the draft cache (the drafter stepped only k
+            # times), so committing it would desync the caches — it is
+            # re-derived as the next round's first verify logit instead.
+            cap = k if self.speculate == "draft" else k + 1
+            commit = np.zeros(s, np.int32)
+            emitted: dict = {}
+            for i, sl in enumerate(self._slots):
+                if not decoding[i]:
+                    continue
+                # tpulint: allow=TPL010(host numpy array, fence paid)
+                a = int(counts[i]) - 1
+                # tpulint: allow=TPL010(host numpy rows, no fence)
+                seq = [int(t) for t in tokens[i, 1:1 + a]] + [int(bonus[i])]
+                c = min(len(seq), cap, sl["remaining"])
+                commit[i] = c
+                emitted[i] = seq[:c]
         try:
             self._cache = self._adv_fn(self._cache, jnp.asarray(commit),
                                        active_arr)
@@ -1197,22 +1507,32 @@ class ContinuousEngine:
         self.spec_ticks_run += 1
         self.recorder.observe_decode_step(t_tick)
         self._budget.note_decode(t_tick)
-        n_dec = sum(decoding)
-        self.recorder.observe_spec(
-            drafted=n_dec * k,
-            accepted=int(counts[np.asarray(decoding)].sum()) - n_dec,
-            verifies=n_dec, committed=int(commit.sum()))
-        for i in list(emitted):
-            sl = self._slots[i]
-            for tok in emitted[i]:
-                sl["out"].append(tok)
-                sl["len"] = min(sl["len"] + 1, self.max_len)
-                self._last_tok[i] = tok
-                sl["remaining"] -= 1
-                self.recorder.decode_token(sl["rid"])
-                _stream_event(sl["stream"], {"token": tok}, sl["rid"])
-            if sl["remaining"] <= 0:
-                self._finish(i)
+        # Accept/reject bookkeeping and the stream fan-out below run
+        # with the advance_lengths commit (and the draft-length sync)
+        # still in flight — dispatched above, never fenced — so this
+        # host slice hides under device execution (ISSUE 16). The
+        # commit is not tracked in _inflight, so the clock's probe
+        # can't see it: force the hidden attribution.
+        with self._clock.phase("stream", exposed=False):
+            n_dec = sum(decoding)
+            self.recorder.observe_spec(
+                drafted=n_dec * k,
+                # tpulint: allow=TPL010(host numpy reduction, no fence)
+                accepted=int(counts[np.asarray(decoding)].sum()) - n_dec,
+                # tpulint: allow=TPL010(host numpy reduction, no fence)
+                verifies=n_dec, committed=int(commit.sum()))
+            for i in list(emitted):
+                sl = self._slots[i]
+                for tok in emitted[i]:
+                    sl["out"].append(tok)
+                    sl["len"] = min(sl["len"] + 1, self.max_len)
+                    self._last_tok[i] = tok
+                    sl["remaining"] -= 1
+                    self.recorder.decode_token(sl["rid"])
+                    _stream_event(sl["stream"], {"token": tok},
+                                  sl["rid"])
+                if sl["remaining"] <= 0:
+                    self._finish(i)
         return True
 
     def _finish(self, i: int):
@@ -1231,6 +1551,12 @@ class ContinuousEngine:
         # Device calls DONATE the cache: after any failure the old buffer
         # may be consumed or poisoned, so recovery = fail every in-flight
         # AND backlogged request and rebuild the pool from scratch.
+        # Outstanding pipelined ticks reference the poisoned cache's
+        # outputs: drop them (their slots fail below) and invalidate the
+        # device token vector.
+        self._inflight = []
+        self._dev_tok = None
+        self._tok_overrides = {}
         self.recorder.engine_resets.inc()
         for i, sl in enumerate(self._slots):
             if sl is not None:
@@ -1249,6 +1575,15 @@ class ContinuousEngine:
             _jitted_pick_tokens,
         )
         return _jitted_pick_tokens()
+
+    # Host-token injection into the device-resident token vector
+    # (plain jit on replicated [B] vectors: serves tp unchanged).
+    @property
+    def _merge_fn(self):
+        from container_engine_accelerators_tpu.models.decode import (
+            _jitted_merge_tokens,
+        )
+        return _jitted_merge_tokens()
 
 
 class PagedContinuousEngine(ContinuousEngine):
@@ -1286,7 +1621,7 @@ class PagedContinuousEngine(ContinuousEngine):
                  mesh=None,
                  recorder: RequestRecorder | None = None,
                  speculate: str = "off", spec_k: int = 4,
-                 draft_layers: int = 2):
+                 draft_layers: int = 2, engine_core: str = "async"):
         import math
 
         from container_engine_accelerators_tpu.models.decode import (
@@ -1333,7 +1668,8 @@ class PagedContinuousEngine(ContinuousEngine):
                          prefill_chunk=prefill_chunk,
                          prefill_workers=prefill_workers, mesh=mesh,
                          recorder=recorder, speculate=speculate,
-                         spec_k=spec_k, draft_layers=draft_layers)
+                         spec_k=spec_k, draft_layers=draft_layers,
+                         engine_core=engine_core)
         assert self.max_len == self.max_pages * self.page
 
     def submit(self, tokens, max_new_tokens, temperature, stream=None):
@@ -1580,8 +1916,12 @@ class PagedContinuousEngine(ContinuousEngine):
         pos = np.zeros(s, np.int32)
         rws = np.zeros(s, np.int32)
         for i, sl in enumerate(self._slots):
-            if sl is None or sl["pending"]:
-                continue  # prefilling slots hold all their pages already
+            if sl is None or sl["pending"] or sl["remaining"] <= 0:
+                # Prefilling slots hold all their pages already;
+                # drained slots (final token dispatched, fetch pending)
+                # never tick again, so growing them would leak a page
+                # into the fetch-time release.
+                continue
             # Highest page index the window touches, clamped to logical
             # capacity (writes past it clamp in-kernel).
             target = min((sl["len"] + lookahead) // page,
@@ -1594,6 +1934,19 @@ class PagedContinuousEngine(ContinuousEngine):
                 got = self._try_alloc(1)
                 if got is not None:
                     row = got[0]
+                    continue
+                # Page pressure with a pipelined tick outstanding:
+                # fetch it BEFORE preempting — finishing slots return
+                # pages (often making the preemption moot), and a
+                # victim must requeue with that tick's token delivered,
+                # not dropped (its budget was decremented at dispatch).
+                # Slots the fetch finished may have been granted a page
+                # earlier in this sweep: un-mark them.
+                if self._inflight:
+                    self._drain_inflight()
+                    for j, s2 in enumerate(self._slots):
+                        if s2 is None:
+                            mask[j] = False
                     continue
                 victim = self._preempt_youngest()
                 if victim is None:
@@ -1955,6 +2308,18 @@ def main(argv=None) -> int:
                         "fall back to the plain step")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens per verify pass (--speculate)")
+    p.add_argument("--engine-core", choices=("async", "sync"),
+                   default="async",
+                   help="async = double-buffered engine core: tick "
+                        "t+1 dispatches while tick t executes on "
+                        "device, scheduling/admission/stream fan-out "
+                        "run in the gap and the result fetch trails "
+                        "one tick behind (host_gap_fraction on "
+                        "/metrics shows the exposed remainder); sync "
+                        "= fetch every tick immediately (the "
+                        "token-identity reference path). Greedy "
+                        "outputs are bit-identical either way. "
+                        "--prefill-workers forces sync")
     p.add_argument("--draft-layers", type=int, default=2,
                    help="--speculate draft: layers in the truncated "
                         "self-draft model")
@@ -2081,7 +2446,8 @@ def main(argv=None) -> int:
 
     recorder = RequestRecorder()
     spec_kw = dict(speculate=args.speculate, spec_k=args.spec_k,
-                   draft_layers=args.draft_layers)
+                   draft_layers=args.draft_layers,
+                   engine_core=args.engine_core)
     if args.engine == "paged":
         engine = PagedContinuousEngine(
             params, cfg, max_slots=args.max_batch, max_len=args.max_len,

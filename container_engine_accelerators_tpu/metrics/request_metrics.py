@@ -57,6 +57,16 @@ _STEP_BUCKETS = (.0001, .00025, .0005, .001, .0025, .005, .01, .025,
 
 SAMPLE_KINDS = ("ttft", "tpot", "queue_wait", "prefill", "decode_step")
 
+# Named host-side phases of one engine tick (ISSUE 16). "admit" is
+# request admission, "schedule" covers queue pumping / bucket formation
+# / page growth / the dispatch call itself, "sample" is token pick and
+# spec accept/reject bookkeeping, "stream" is SSE fan-out plus
+# recorder updates, "fetch" is the one blocking device->host transfer.
+# Each observation is flagged hidden (ran under an in-flight device
+# tick) or exposed (device idle while the host worked); the ratio of
+# exposed host time to wall time is `host_gap_fraction`.
+HOST_PHASES = ("admit", "schedule", "sample", "stream", "fetch")
+
 
 def percentile(xs, p):
     """Nearest-rank percentile (inclusive): the smallest sample with at
@@ -92,6 +102,13 @@ class RequestRecorder:
         # over "the last N seconds" instead of "the last N samples".
         self.timed = {k: collections.deque(maxlen=max_samples)
                       for k in SAMPLE_KINDS}
+        # Host-phase attribution (ISSUE 16): per-phase durations kept
+        # apart from SAMPLE_KINDS so histogram-driven consumers are
+        # untouched, plus a rolling (exposed_s, wall_s) window per tick
+        # from which host_gap_fraction is derived.
+        self.host_samples = {p: collections.deque(maxlen=max_samples)
+                             for p in HOST_PHASES}
+        self._host_ticks = collections.deque(maxlen=4096)
 
         reg = self.registry
         self.ttft = Histogram(
@@ -143,6 +160,14 @@ class RequestRecorder:
             "(serve --prefill-workers): prefill = backlogged requests "
             "plus slots still holding prompt tokens, decode = slots "
             "ticking", ["pool"], registry=reg)
+        self.host_gap_fraction = Gauge(
+            "serve_host_gap_fraction",
+            "Fraction of engine wall time spent in host-side work NOT "
+            "hidden under an in-flight device tick (rolling window). "
+            "Near zero when the async double-buffered core keeps "
+            "admission/scheduling/streaming under device execution; "
+            "approaches the full host slice on the synchronous path",
+            registry=reg)
         self.prefix_hit_rate = Gauge(
             "serve_prefix_hit_rate",
             "prefix_hits / prefix_lookups over this process's "
@@ -416,6 +441,52 @@ class RequestRecorder:
                 events.counter("serve/spec", {
                     "drafted": self._spec_drafted,
                     "accepted": self._spec_accepted})
+
+    # ---------- host-gap attribution (ISSUE 16) ----------
+
+    def observe_host_phase(self, phase: str, seconds: float,
+                           hidden: bool = False) -> None:
+        """One named host-phase slice of an engine tick. `hidden` means
+        the slice ran while a dispatched-but-unfetched device tick was
+        outstanding, i.e. the host work cost no device idle time."""
+        with self._lock:
+            self.host_samples[phase].append(
+                (max(seconds, 0.0), bool(hidden)))
+
+    def observe_host_tick(self, exposed_s: float,
+                          wall_s: float) -> None:
+        """One engine tick's exposure accounting: `exposed_s` of host
+        time the device sat idle for, out of `wall_s` total. Feeds the
+        rolling host_gap_fraction gauge."""
+        with self._lock:
+            if wall_s <= 0:
+                return
+            self._host_ticks.append(
+                (max(exposed_s, 0.0), float(wall_s)))
+            wall = sum(w for _, w in self._host_ticks)
+            if wall > 0:
+                exposed = sum(e for e, _ in self._host_ticks)
+                self.host_gap_fraction.set(min(exposed / wall, 1.0))
+
+    def host_gap(self) -> float | None:
+        """Rolling exposed-host / wall fraction; None before any tick
+        has been observed."""
+        with self._lock:
+            wall = sum(w for _, w in self._host_ticks)
+            if wall <= 0:
+                return None
+            return min(sum(e for e, _ in self._host_ticks) / wall, 1.0)
+
+    def host_phase_ms(self, ps=(50, 95, 99)) -> dict:
+        """{phase: {"p50": ms, ...}} over retained per-phase samples
+        (hidden and exposed alike — attribution, not exposure)."""
+        with self._lock:
+            snap = {p: [s for s, _ in self.host_samples[p]]
+                    for p in HOST_PHASES}
+        return {p: {k: round(v * 1e3, 4)
+                    for k, v in percentiles(xs, ps).items()
+                    if v is not None}
+                for p, xs in snap.items() if xs}
 
     def observe_prefill_chunk(self, tokens: int) -> None:
         """One forwarded prompt chunk — the prefill pool's progress

@@ -1121,9 +1121,28 @@ def _jitted_prefill_suffix_slot(cfg: LlamaConfig):
                 donate_argnums=(1,)), "prefill_suffix_slot")
 
 
+def merge_tokens(last: jnp.ndarray, overrides: jnp.ndarray,
+                 mask: jnp.ndarray) -> jnp.ndarray:
+    """Inject host-known tokens into the device-resident last-token
+    vector: where `mask` is set take `overrides`, else keep `last`.
+    The async engine core keeps the per-slot token vector on device
+    between ticks (pick_tokens output feeds the next step directly);
+    freshly prefilled or re-admitted slots sample their first token on
+    the host, and this is how that value enters the pipeline without
+    fencing the whole vector. Shapes: all [B] int32/bool."""
+    return jnp.where(mask, overrides, last).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=32)
 def _jitted_pick_tokens():
     return _watched_jit(jax.jit(pick_tokens), "pick_tokens")
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_merge_tokens():
+    # Plain jit like advance_lengths: operates on replicated [B]
+    # vectors, so the same executable serves single-device and tp.
+    return _watched_jit(jax.jit(merge_tokens), "merge_tokens")
 
 
 @functools.lru_cache(maxsize=32)

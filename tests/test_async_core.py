@@ -1,0 +1,220 @@
+"""Async double-buffered engine core (ISSUE 16): greedy token-identity
+between the async and sync cores for all three engines, FIFO order
+within a bucket under the window engine's single-pass deque partition,
+host-gap accounting sanity, and supervised recovery with a pipelined
+in-flight tick (kill between dispatch(t+1) and fetch(t): zero leaked
+pages, structured errors, restart serves traffic)."""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.cli.serve import (
+    BatchingEngine,
+    ContinuousEngine,
+    EngineSupervisor,
+    PagedContinuousEngine,
+)
+from container_engine_accelerators_tpu.metrics import doctor, events
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    def reset():
+        events._reset_for_tests()
+        doctor.set_active(None)
+        from container_engine_accelerators_tpu.training.dataset import (
+            clear_stall,
+        )
+        clear_stall()
+    reset()
+    yield
+    reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    # Same tiny config as the other serve suites: process-wide jit
+    # caches stay hot across test modules.
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def _wait_for(pred, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [3, 1, 4, 1, 5, 9, 2, 6],
+           [11, 12]]
+
+SLOT_KW = dict(max_slots=4, max_len=256, prompt_bucket=16,
+               max_prompt_len=128)
+PAGED_KW = dict(max_slots=4, max_len=256, page=64, pool_pages=17,
+                max_prompt_len=128)
+
+
+def _run(make_engine, n_new=12):
+    eng = make_engine()
+    try:
+        futs = [eng.submit(list(p), n_new, 0.0) for p in PROMPTS]
+        outs = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop()
+    return outs, eng
+
+
+# ---------- greedy token-identity: async == sync ----------
+
+@pytest.mark.parametrize("name,cls,kw", [
+    ("slot", ContinuousEngine, SLOT_KW),
+    ("paged", PagedContinuousEngine, PAGED_KW),
+    ("spec", ContinuousEngine,
+     dict(SLOT_KW, speculate="ngram", spec_k=4)),
+])
+def test_greedy_token_identity_async_vs_sync(model, name, cls, kw):
+    """The non-negotiable: with temperature 0 the async core must emit
+    bit-identical tokens to the synchronous reference path — deferring
+    the fetch one tick may move WHEN a token is observed, never WHICH
+    token it is."""
+    params, cfg = model
+    got_async, ea = _run(
+        lambda: cls(params, cfg, engine_core="async", **kw))
+    got_sync, _ = _run(
+        lambda: cls(params, cfg, engine_core="sync", **kw))
+    assert got_async == got_sync
+    for p, out in zip(PROMPTS, got_async):
+        assert len(out) == len(p) + 12
+    # The pipelined run must also have produced host-gap accounting:
+    # a fraction in [0, 1] derived from per-phase hidden/exposed time.
+    gap = ea.recorder.host_gap()
+    assert gap is not None and 0.0 <= gap <= 1.0
+    phases = ea.recorder.host_phase_ms()
+    assert "fetch" in phases and "p50" in phases["fetch"]
+
+
+def test_window_engine_identity_async_vs_sync(model):
+    params, cfg = model
+    got_async, _ = _run(lambda: BatchingEngine(
+        params, cfg, max_batch=4, window_ms=5.0, engine_core="async"))
+    got_sync, _ = _run(lambda: BatchingEngine(
+        params, cfg, max_batch=4, window_ms=5.0, engine_core="sync"))
+    assert got_async == got_sync
+
+
+# ---------- single-pass bucket partition keeps FIFO ----------
+
+def test_window_fifo_within_bucket_under_mixed_traffic(model):
+    """Satellite: the deque partition in BatchingEngine._worker must
+    preserve arrival order WITHIN each (prompt_len, n_new, temp)
+    bucket when parked requests from other buckets interleave — the
+    old pop(0)/pop(i) shuffle preserved it by accident; this pins it
+    on purpose."""
+    params, cfg = model
+    eng = BatchingEngine(params, cfg, max_batch=2, window_ms=100.0)
+    done: list[str] = []
+    lock = threading.Lock()
+
+    def mark(label):
+        def cb(_fut):
+            with lock:
+                done.append(label)
+        return cb
+
+    try:
+        futs = []
+        # Interleave two buckets (prompt lengths 4 and 6): every item
+        # parks or batches, and the partition must keep both streams
+        # in submission order.
+        for i in range(3):
+            a = eng.submit([1, 2, 3, 4], 3, 0.0)
+            a.add_done_callback(mark(f"a{i}"))
+            b = eng.submit([5, 6, 7, 8, 9, 10], 3, 0.0)
+            b.add_done_callback(mark(f"b{i}"))
+            futs += [a, b]
+        outs = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop()
+    for i, out in enumerate(outs):
+        assert len(out) == (4 if i % 2 == 0 else 6) + 3
+    a_order = [x for x in done if x.startswith("a")]
+    b_order = [x for x in done if x.startswith("b")]
+    assert a_order == ["a0", "a1", "a2"], done
+    assert b_order == ["b0", "b1", "b2"], done
+
+
+# ---------- supervised recovery with a pipelined in-flight tick ----
+
+def test_worker_kill_with_inflight_pipelined_tick(model):
+    """Satellite: kill the worker between dispatch(t+1) and fetch(t).
+    The async core holds a dispatched-but-unfetched tick at its loop
+    top, so the injected WorkerKilled fires exactly in that gap; the
+    supervisor must drop the in-flight records, reclaim every page
+    (allocator accounting back at zero), fail the abandoned requests
+    with structured errors, and the restarted worker must serve."""
+    params, cfg = model
+    engine = PagedContinuousEngine(
+        params, cfg, engine_core="async", prefix_cap=0,
+        prefill_chunk=0, **PAGED_KW)
+    rec = engine.recorder
+    sup = EngineSupervisor(engine, backoff_base_s=0.05,
+                           poll_interval_s=0.05)
+    try:
+        # Warm the jits, then occupy slots with long decodes.
+        engine.submit([1, 2, 3, 4], 4, 0.0).result(timeout=120)
+        futs = [engine.submit(list(range(1, 9)), 200, 0.0)
+                for _ in range(2)]
+        assert _wait_for(lambda: engine._alloc.pages_in_use > 0,
+                         timeout=60)
+        # Steady-state async decode: a dispatched tick is outstanding
+        # when the worker reaches its loop top (fetch is one behind).
+        assert _wait_for(lambda: len(engine._inflight) >= 1,
+                         timeout=60)
+        sup.start()
+        engine.fault_kill = True
+
+        for fut in futs:
+            with pytest.raises(Exception, match="supervised recovery"):
+                fut.result(timeout=60)
+        assert _wait_for(lambda: engine.worker_restarts >= 1
+                         and engine.thread.is_alive(), timeout=60)
+        # Both outstanding ticks' state is dropped and every page is
+        # back: the in-flight records, the device-token mirror, and
+        # the allocator/gauges all read empty.
+        assert engine._inflight == []
+        assert engine._dev_tok is None
+        assert engine._tok_overrides == {}
+        assert _wait_for(lambda: engine._alloc.pages_in_use == 0,
+                         timeout=60)
+        assert engine._alloc.outstanding_rows() == {}
+        assert rec.active_slots._value.get() == 0
+        assert rec.kv_pages_in_use._value.get() == 0
+        # The restarted pipelined worker serves new traffic.
+        out = engine.submit([1, 2, 3, 4], 4, 0.0).result(timeout=120)
+        assert len(out) == 8
+        assert _wait_for(lambda: engine._alloc.pages_in_use == 0,
+                         timeout=60)
+    finally:
+        sup.stop()
+        engine.stop()
+
+
+def test_sync_core_flag_disables_pipelining(model):
+    """--engine-core sync is the reference path: no tick is ever left
+    in flight across a loop iteration."""
+    params, cfg = model
+    eng = ContinuousEngine(params, cfg, engine_core="sync", **SLOT_KW)
+    try:
+        out = eng.submit([1, 2, 3], 6, 0.0).result(timeout=120)
+        assert len(out) == 9
+        assert eng._inflight == []
+    finally:
+        eng.stop()

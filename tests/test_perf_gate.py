@@ -298,7 +298,13 @@ def test_tier_is_hermetic_schema_complete_and_clean(tier):
         "train_step_ms", "decode_step_slots_ms", "decode_step_paged_ms",
         "matmul_scan_ms", "prefill_cached_ms",
         "decode_tick_under_prefill_ms", "ckpt_async_stall_ms",
-        "decode_spec_tpot_ms", "decode_w8_step_ms"}
+        "decode_spec_tpot_ms", "decode_w8_step_ms",
+        "host_gap_fraction"}
+    # The pipelined host-gap bench reports a fraction, not a latency,
+    # and its device-dominated loop must keep the gap near zero.
+    gap = tier["metrics"]["host_gap_fraction"]
+    assert gap["unit"] == "fraction"
+    assert all(0 < s < 0.5 for s in gap["samples"]), gap
     for result in tier["results"]:
         assert harness.validate_result(result) == [], result["metric"]
         assert result["status"] == "ok"

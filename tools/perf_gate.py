@@ -36,6 +36,12 @@ environment's TPU plugin), tiny shapes, fixed seeds:
   decode_w8_step_ms      slot decode step over int8-quantized weights
                          (fused-dequant matmuls) — the --weight-dtype
                          int8 serving hot path
+  host_gap_fraction      exposed-host fraction of a pipelined
+                         dispatch/fetch loop (the async engine core's
+                         overlap contract, ISSUE 16) — unit "fraction",
+                         not ms, pinned near zero: it grows toward the
+                         host/device ratio if a fence sneaks back
+                         between dispatch and the gap work
   multislice_step_ms     dp=2 train step across TWO real OS processes
                          joined by jax.distributed over gloo — the
                          hermetic stand-in for the DCN gradient psum
@@ -132,6 +138,9 @@ MULTISLICE_METRIC = "multislice_step_ms"
 MULTISLICE_OVERLAP_METRIC = "multislice_overlap_step_ms"
 MULTISLICE_METRICS = (MULTISLICE_METRIC, MULTISLICE_OVERLAP_METRIC)
 MULTISLICE_TIMEOUT_ENV = "PERF_GATE_MULTISLICE_TIMEOUT_S"
+# The one dimensionless metric in the tier (ISSUE 16): per-pass values
+# are already fractions, so the ms scaling and rounding don't apply.
+HOST_GAP_METRIC = "host_gap_fraction"
 
 EXIT_OK = 0
 EXIT_REGRESSION = 2
@@ -696,6 +705,86 @@ def _decode_under_prefill_bench():
     return "decode_tick_under_prefill_ms", measure, None
 
 
+def _host_gap_bench():
+    """('host_gap_fraction'): exposed-host fraction of a pipelined
+    dispatch/fetch loop — the async engine core's overlap contract
+    (ISSUE 16) reduced to its measurable skeleton. Each tick runs a
+    fixed host bookkeeping slice through the REAL serve._PhaseClock /
+    RequestRecorder attribution while a device step big enough to
+    dominate it (the matmul_scan shape, ~2.7ms vs ~0.2ms of host work)
+    is in flight, fetching one tick behind exactly like the engines.
+    The committed value is the fraction of host work the pipeline
+    FAILED to hide — pipeline-fill on the first tick plus scheduling
+    jitter — near zero by construction. If someone re-introduces a
+    fence between dispatch and the gap work, every tick's host slice
+    lands with the device idle and the fraction jumps toward the
+    host/device ratio, tripping the relative gate. Floored at 1e-4 so
+    a perfectly-hidden run still survives learn_bands' positive-median
+    requirement and the baseline's 4-decimal rounding."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.cli.serve import _PhaseClock
+    from container_engine_accelerators_tpu.metrics.introspection import (
+        watch,
+    )
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+
+    L, M = 8, 256
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (M, M), jnp.bfloat16)
+    w = jax.random.normal(key, (L, M, M), jnp.bfloat16)
+
+    def scan_mm(x, w):
+        def body(c, wi):
+            return (c @ wi).astype(jnp.bfloat16), None
+        y, _ = jax.lax.scan(body, x, w)
+        return y
+
+    step = watch(jax.jit(scan_mm), "perf_gate_host_gap_step")
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        step(x, w).block_until_ready()
+
+    def host_slice(n: int = 4000) -> int:
+        # Fixed pure-Python bookkeeping stand-in (admission lists,
+        # bucket math, stream fan-out): the work the pipeline is
+        # supposed to hide under the in-flight device step.
+        acc = 0
+        for i in range(n):
+            acc += i * 31 % 7
+        return acc
+
+    def measure(n_steps: int):
+        rec = RequestRecorder()
+        inflight: list = []
+        clock = _PhaseClock(
+            rec,
+            lambda: bool(inflight) and not inflight[-1].is_ready())
+        for _ in range(n_steps):
+            clock.start_tick()
+            with clock.phase("admit"):
+                host_slice()
+            with clock.phase("schedule"):
+                inflight.append(step(x, w))
+            if len(inflight) > 1:
+                out = inflight.pop(0)
+                with clock.phase("fetch", exposed=False):
+                    out.block_until_ready()
+                with clock.phase("stream"):
+                    host_slice()
+            clock.commit_tick()
+        while inflight:
+            inflight.pop(0).block_until_ready()
+        gap = rec.host_gap() or 0.0
+        # Companion percentile block: the dispatch ("schedule") slice —
+        # flat {pNN: ms}, the harness's percentile schema.
+        return [max(gap, 1e-4)], rec.host_phase_ms().get("schedule", {})
+
+    return HOST_GAP_METRIC, measure, None
+
+
 def _matmul_bench():
     """Stacked scan matmul — the component_bench shape family shrunk to
     the tier-1 budget, watched for compile attribution like the real
@@ -912,23 +1001,29 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
                _decode_bench(paged=False), _decode_bench(paged=True),
                _matmul_bench(), _prefill_cached_bench(),
                _decode_under_prefill_bench(), _ckpt_async_bench(),
-               _decode_spec_bench()]
+               _decode_spec_bench(), _host_gap_bench()]
     metrics: dict = {}
     results: list = []
     with harness.RecompileGuard() as guard:
         for name, measure, perturb in benches:
             if inject_recompile and perturb is not None:
                 perturb()  # steady-state recompile INSIDE the window
+            # host_gap_fraction is dimensionless: its per-pass values
+            # are already fractions, so no ms scaling, and 6-decimal
+            # rounding keeps a near-zero value from collapsing to 0
+            # (learn_bands drops non-positive medians).
+            unit = "fraction" if name == HOST_GAP_METRIC else "ms"
+            scale, digits = (1.0, 6) if unit == "fraction" else (1e3, 4)
             samples_ms, pcts = [], {}
             for _ in range(k):
                 times, pcts = measure(steps)
                 p50 = harness.median(times)
-                samples_ms.append(round(p50 * 1e3, 4))
-            value = round(harness.median(samples_ms), 4)
-            metrics[name] = {"samples": samples_ms, "unit": "ms",
+                samples_ms.append(round(p50 * scale, digits))
+            value = round(harness.median(samples_ms), digits)
+            metrics[name] = {"samples": samples_ms, "unit": unit,
                              "percentiles": pcts}
             results.append(harness.check_result(harness.make_result(
-                name, value, "ms",
+                name, value, unit,
                 percentiles={name.removesuffix("_ms"): pcts},
                 backend_probe=probe, status="ok",
                 samples_ms=samples_ms, k=k, steps_per_pass=steps,

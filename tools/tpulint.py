@@ -676,10 +676,73 @@ class SilentExceptSwallow(Rule):
                        "narrow the type, or pragma with a reason")
 
 
+class EngineTickHostFence(Rule):
+    id = "TPL010"
+    title = "host materialization of a device value in an engine tick"
+    rationale = (
+        "ISSUE 16 (async engine core): the serving engines double-"
+        "buffer — tick t+1 dispatches while tick t executes, and the "
+        "ONE sanctioned fence is the deferred fetch in _fetch_tick/"
+        "_fetch_batch. Inside the tick callbacks in cli/serve.py, "
+        "np.asarray / .item() / int()/float() of a computed or indexed "
+        "value silently materializes a device array, re-serializing "
+        "host and device and erasing the pipelining win. TPL002 can't "
+        "see these (it keys on explicit device_get/block_until_ready "
+        "and only watches the decode/train step files). Deliberate "
+        "fences — the deferred fetch itself, spec-decode's verify "
+        "readback — carry `# tpulint: allow=TPL010(reason)` pragmas."
+    )
+    fixture_path = "container_engine_accelerators_tpu/cli/serve.py"
+    bad = ("import numpy as np\n"
+           "def _decode_tick(self, out_dev):\n"
+           "    toks = np.asarray(out_dev)\n"
+           "    return int(toks[0])\n")
+    good = ("def _decode_tick(self, host_rows):\n"
+            "    ids = [int(t) for t in host_rows]\n"
+            "    return ids\n")
+
+    def applies(self, relpath):
+        return relpath.replace(os.sep, "/").endswith("cli/serve.py")
+
+    @staticmethod
+    def _in_tick_fn(ctx, node) -> bool:
+        fn = ctx.enclosing_function(node)
+        return (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and "tick" in fn.name)
+
+    def check(self, ctx):
+        for call in (n for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.Call)):
+            if not self._in_tick_fn(ctx, call):
+                continue
+            name = call_name(call) or ""
+            if name in ("np.asarray", "numpy.asarray"):
+                yield (call.lineno,
+                       "np.asarray inside an engine tick callback "
+                       "fences the in-flight dispatch; keep values "
+                       "device-resident (the _dev_tok path) or defer "
+                       "to _fetch_tick, or pragma a deliberate fence")
+            elif (isinstance(call.func, ast.Attribute)
+                  and call.func.attr == "item"):
+                yield (call.lineno,
+                       ".item() inside an engine tick callback is a "
+                       "scalar device->host fence the async core is "
+                       "built to avoid; defer to the fetch or pragma")
+            elif (name in ("int", "float") and len(call.args) == 1
+                  and isinstance(call.args[0],
+                                 (ast.Call, ast.Subscript))):
+                yield (call.lineno,
+                       f"{name}() of a computed/indexed value inside "
+                       "an engine tick callback materializes a device "
+                       "value mid-tick; defer to the fetch or pragma "
+                       "a deliberate fence")
+
+
 RULES: tuple[Rule, ...] = (
     BannedSimpleQueue(), HostSyncInHotLoop(), NonAtomicWrite(),
     WallClockDuration(), RawShardMap(), BlockingUnderLock(),
     NonDaemonThread(), UnwatchedJit(), SilentExceptSwallow(),
+    EngineTickHostFence(),
 )
 
 
