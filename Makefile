@@ -89,8 +89,14 @@ train-obs-smoke:
 # is valid Chrome-trace JSON holding request spans, train-step spans
 # and a counter track from two distinct pids. Also covers ring
 # wraparound, the disabled zero-alloc path, SIGUSR2 dumps and /debugz.
+# test_trace.py layers the request-tracing checks on top (ISSUE 17):
+# head-sampling determinism, span pairing across the pool handoff,
+# cross-process JSONL merge validity, tail-sampling of failed /
+# promoted requests, span-derived doctor verdicts, and the
+# trace_report TTFT/TPOT attribution table.
 trace-smoke:
-	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_events.py -q
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_events.py \
+	    tests/test_trace.py -q
 
 # XLA compile + HBM introspection smoke (fourth member of the family):
 # forced recompile counted AND attributed with the exact shape diff,

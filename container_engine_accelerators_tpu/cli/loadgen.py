@@ -45,6 +45,16 @@ broke"); SLO violations, structured errors and hung streams exit 3
 ("server answered but broke its promises") — a chaos schedule treats
 the two differently, and exit 3 covers both of the new counts.
 
+Request tracing (ISSUE 17): `--trace-sample-rate R` head-samples the
+client's request indices with the SAME deterministic hash the server
+uses (metrics/trace.head_sampled), and each sampled POST carries
+`"trace": true` plus `"tags": {"tenant": t, "class": chat|batch}` —
+the server forces those requests into the trace and stamps the tags
+into every span's args, so tools/trace_report.py can slice its
+attribution table by tenant and request class. Server-side tail
+sampling still captures failed/preempted/SLO-violating requests
+regardless of this rate.
+
 Prints ONE human line per percentile block, an `SLO PASS|FAIL` line
 when gating, an outcome line when anything failed, plus a final JSON
 summary line (machine-consumable, mirrors bench.py's one-line
@@ -58,6 +68,8 @@ import concurrent.futures
 import json
 import time
 import urllib.request
+
+from container_engine_accelerators_tpu.metrics.trace import head_sampled
 
 
 def percentiles(xs: list[float], ps=(50, 90, 99)) -> dict[str, float]:
@@ -124,15 +136,21 @@ def _slo_block(ttfts, gaps, args):
 
 def one_request(url: str, tokens: list[int], max_new: int,
                 stream: bool, timeout: float,
-                stall_timeout: float | None = None) -> dict:
+                stall_timeout: float | None = None,
+                trace_tags: dict | None = None) -> dict:
     """Returns {"outcome": "ok"|"structured_error", "error": str|None,
     "latency": s, "ttft": s|None, "tokens": n_generated,
     "gaps": [inter-token seconds]} (gaps only in stream mode).
     Raises StreamStalled when a stream goes silent past
-    `stall_timeout`; transport failures raise their own exceptions."""
+    `stall_timeout`; transport failures raise their own exceptions.
+    `trace_tags` forces the server to trace this request and stamps
+    the tags into every span's args."""
     body = {"tokens": tokens, "max_new_tokens": max_new}
     if stream:
         body["stream"] = True
+    if trace_tags is not None:
+        body["trace"] = True
+        body["tags"] = trace_tags
     req = urllib.request.Request(url + "/generate",
                                  data=json.dumps(body).encode())
     # The socket timeout bounds each blocking read: in stream mode
@@ -193,9 +211,15 @@ def run(args) -> tuple[dict, int]:
             tenant = 0
             tokens = [(i * 7 + j) % 100 + 1
                       for j in range(args.prompt_len)]
+        trace_tags = None
+        if (args.trace_sample_rate
+                and head_sampled(i, args.trace_sample_rate)):
+            trace_tags = {"tenant": tenant,
+                          "class": tenant_class(tenant)}
         r = one_request(args.url, tokens, args.max_new_tokens,
                         args.stream, args.timeout,
-                        stall_timeout=args.stall_timeout_s)
+                        stall_timeout=args.stall_timeout_s,
+                        trace_tags=trace_tags)
         r["tenant"] = tenant
         return r
 
@@ -357,6 +381,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-tpot-p99-ms", type=float, default=None,
                    help="fail (exit 3) unless pooled inter-token-gap "
                         "p99 <= this; requires --stream")
+    p.add_argument("--trace-sample-rate", type=float, default=0.0,
+                   help="head-sample this fraction of requests for "
+                        "server-side tracing: sampled POSTs carry "
+                        "trace=true plus tenant/class tags that land "
+                        "in every span's args (trace_report slices "
+                        "its attribution table on them); the server "
+                        "still tail-samples failed/preempted/SLO-"
+                        "violating requests on its own")
     return p
 
 

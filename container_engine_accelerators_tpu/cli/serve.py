@@ -50,6 +50,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from container_engine_accelerators_tpu.metrics import events, introspection
+from container_engine_accelerators_tpu.metrics import trace
 from container_engine_accelerators_tpu.metrics.request_metrics import (
     RequestRecorder,
     ServeMetricsExporter,
@@ -87,6 +88,16 @@ def _fail(fut, stream, exc: Exception, rid=None, recorder=None) -> None:
         # No-op for requests the recorder never saw enqueued
         # (validation rejections count via validation_failures instead).
         recorder.fail(rid)
+
+
+def _trace_restart_touch(rid, err: Exception) -> None:
+    """Stamp a supervisor-restart instant on a victim request's trace
+    track and promote it so its tail buffer survives to the dump even
+    when the request itself ends up re-dispatched cleanly."""
+    h = trace.handle(rid)
+    if h is not None:
+        h.promote("supervisor_restart")
+        h.instant(trace.EV_SUPERVISOR_RESTART, {"error": str(err)})
 
 
 def _validate_request(tokens, max_new_tokens, max_prompt_len,
@@ -286,7 +297,8 @@ class BatchingEngine:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float,
-               stream: queue.Queue | queue.SimpleQueue | None = None
+               stream: queue.Queue | queue.SimpleQueue | None = None,
+               trace_ctx: dict | None = None
                ) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         rid = next(self._rid)
@@ -294,6 +306,12 @@ class BatchingEngine:
                                  self.max_prompt_len, fut, stream,
                                  rid=rid, recorder=self.recorder):
             return fut
+        # Start the trace BEFORE enqueue so the client's force/tags
+        # land on the handle the recorder's enqueue hook reuses
+        # (trace.start is idempotent per rid).
+        if trace_ctx:
+            trace.start(rid, force=bool(trace_ctx.get("force")),
+                        tags=trace_ctx.get("tags"))
         self.recorder.enqueue(rid)
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut,
                         stream, rid))
@@ -313,6 +331,7 @@ class BatchingEngine:
         inflight = [item for rec in self._inflight
                     for item in rec["batch"]]
         for item in inflight + self._batch + list(self._pending):
+            _trace_restart_touch(item[5], err)
             _fail(item[3], item[4], err, item[5], self.recorder)
         self._inflight = []
         self._batch = []
@@ -322,6 +341,7 @@ class BatchingEngine:
                 item = self.queue.get_nowait()
             except queue.Empty:
                 break
+            _trace_restart_touch(item[5], err)
             _fail(item[3], item[4], err, item[5], self.recorder)
         self._work.clear()
         self.recorder.set_slots(active=0, total=self.max_batch)
@@ -447,6 +467,11 @@ class BatchingEngine:
                 continue
             self._inflight.append({"batch": batch, "out": out,
                                    "stats": stats, "t0": t_batch})
+            for item in batch:
+                h = trace.handle(item[5])
+                if h is not None:
+                    h.instant(trace.EV_DISPATCH,
+                              {"batch": len(batch), "n_new": n_new})
             self._batch = []
             # Async core: fetch ONE batch behind — batch t's results
             # land while batch t+1 executes. Sync fetches immediately.
@@ -482,6 +507,8 @@ class BatchingEngine:
         rec = self.recorder
         fl = self._inflight.pop(0)
         batch, out, stats = fl["batch"], fl["out"], fl["stats"]
+        handles = [trace.handle(item[5]) for item in batch]
+        t_fetch = time.monotonic()
         try:
             with clock.phase("fetch", exposed=False):
                 out_host = [[int(t) for t in row] for row in out]
@@ -500,6 +527,12 @@ class BatchingEngine:
                 verifies=stats.get("verifies", 0),
                 committed=stats.get("committed", 0))
         batch_dt = time.monotonic() - fl["t0"]
+        t_streamed = time.monotonic()
+        for h in handles:
+            if h is not None:
+                h.begin(trace.SPAN_FETCH, ts=t_fetch)
+                h.end(trace.SPAN_FETCH, ts=t_streamed)
+                h.begin(trace.SPAN_STREAM, ts=t_streamed)
         with clock.phase("stream"):
             for item, row in zip(batch, out_host):
                 rid = item[5]
@@ -518,6 +551,9 @@ class BatchingEngine:
                         _stream_event(item[4], {"token": t}, rid)
                     _stream_event(item[4],
                                   {"done": True, "tokens": row}, rid)
+                h = trace.handle(rid)
+                if h is not None:
+                    h.end(trace.SPAN_STREAM)
                 rec.finish(rid)
         self.batches_run += 1
         self.requests_served += len(batch)
@@ -736,7 +772,8 @@ class ContinuousEngine:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float,
-               stream: queue.Queue | queue.SimpleQueue | None = None
+               stream: queue.Queue | queue.SimpleQueue | None = None,
+               trace_ctx: dict | None = None
                ) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         rid = next(self._rid)
@@ -754,6 +791,11 @@ class ContinuousEngine:
                 f"prompt (bucketed to {bucketed}) + max_new_tokens "
                 f"exceeds cache max_len {self.max_len}"), rid)
             return fut
+        # Before enqueue: the recorder's enqueue hook reuses this
+        # handle (trace.start is idempotent per rid).
+        if trace_ctx:
+            trace.start(rid, force=bool(trace_ctx.get("force")),
+                        tags=trace_ctx.get("tags"))
         self.recorder.enqueue(rid)
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut,
                         stream, rid))
@@ -786,10 +828,12 @@ class ContinuousEngine:
             self._tok_overrides = {}
             for sl in getattr(self, "_slots", []):
                 if sl is not None:
+                    _trace_restart_touch(sl["rid"], err)
                     _fail(sl["fut"], sl["stream"], err, sl["rid"],
                           self.recorder)
             self._slots = [None] * self.max_slots
             for item in getattr(self, "_backlog", []):
+                _trace_restart_touch(item[5], err)
                 _fail(item[3], item[4], err, item[5], self.recorder)
             self._backlog = []
             while True:
@@ -797,6 +841,7 @@ class ContinuousEngine:
                     item = self.queue.get_nowait()
                 except queue.Empty:
                     break
+                _trace_restart_touch(item[5], err)
                 _fail(item[3], item[4], err, item[5], self.recorder)
             self._work.clear()
             self.recorder.set_slots(active=0, total=self.max_slots)
@@ -1062,19 +1107,44 @@ class ContinuousEngine:
     def _prefill_worker(self):
         """Prefill-pool worker: drains budget-bounded chunks of the
         oldest prefilling slot under the engine lock. The injected
-        prefill kill is consumed OUTSIDE _mu, so a dying worker never
-        leaves the lock held or slot/page state half-mutated — every
-        page stays owned by its slot (refcounts intact) and the
-        replacement worker resumes the pending prompt exactly where it
-        stopped: the zero-leak property the prefill-pool-kill chaos
-        scenario asserts."""
+        prefill kill raises BETWEEN chunks with _mu released, so a
+        dying worker never leaves the lock held or slot/page state
+        half-mutated — every page stays owned by its slot (refcounts
+        intact) and the replacement worker resumes the pending prompt
+        exactly where it stopped: the zero-leak property the
+        prefill-pool-kill chaos scenario asserts."""
         while not self._stop.is_set():
             if self.fault_kill_prefill:
-                self.fault_kill_prefill = False
-                log.warning("injected prefill-pool worker kill: thread "
-                            "dying between chunks")
-                raise WorkerKilled("injected prefill worker kill "
-                                   "(inject_fault --kind prefill-kill)")
+                # The kill is ARMED by inject_fault and CONSUMED at the
+                # next moment a prompt is actually mid-prefill — dying
+                # at an idle instant would exercise nothing (the fake
+                # engine drains chunks far faster than a human-scale
+                # injection schedule can aim). Victims are stamped at
+                # the precise death point: by the time the supervisor's
+                # poll notices the dead thread, the surviving workers
+                # may have drained the pending prompts and the
+                # restart-time stamping below would find no one to
+                # blame. Lock released before the raise; no engine
+                # state is mutated.
+                die = False
+                with self._mu:
+                    victims = [sl for sl in self._slots
+                               if sl is not None and sl["pending"]]
+                    if victims and self.fault_kill_prefill:
+                        self.fault_kill_prefill = False
+                        die = True
+                        for sl in victims:
+                            h = trace.handle(sl["rid"])
+                            if h is not None:
+                                h.promote("pool_restart")
+                                h.instant(trace.EV_POOL_RESTART,
+                                          {"injected": True})
+                if die:
+                    log.warning("injected prefill-pool worker kill: "
+                                "thread dying between chunks")
+                    raise WorkerKilled(
+                        "injected prefill worker kill "
+                        "(inject_fault --kind prefill-kill)")
             with self._mu:
                 with annotate("serve/prefill_chunk"):
                     did = self._prefill_tick()
@@ -1111,6 +1181,18 @@ class ContinuousEngine:
         if dead:
             self._ensure_prefill_threads()
             self.prefill_worker_restarts += dead
+            # A pool restart is PARTIAL recovery: no request fails, but
+            # requests caught mid-prefill had their chunk cadence
+            # interrupted — stamp (and promote) their trace tracks so
+            # the chaos scenario can read restart -> resumed chunks ->
+            # finish off one Perfetto timeline.
+            for sl in self._slots:
+                if sl is not None and sl["pending"]:
+                    h = trace.handle(sl["rid"])
+                    if h is not None:
+                        h.promote("pool_restart")
+                        h.instant(trace.EV_POOL_RESTART,
+                                  {"dead_workers": dead})
             self._prefill_work.set()
         return dead
 
@@ -1193,6 +1275,11 @@ class ContinuousEngine:
         bucketed = -(-take // self.prompt_bucket) * self.prompt_bucket
         padded = sl["pending"][:take] + [0] * (bucketed - take)
         start, new_len = sl["len"], sl["len"] + take
+        h = trace.handle(sl["rid"])
+        if h is not None:
+            h.begin(trace.SPAN_PREFILL_CHUNK,
+                    {"tokens": take, "final": final,
+                     "pool": bool(self.prefill_workers)})
         t_chunk = time.monotonic()
         try:
             last_logits = self._run_chunk(i, padded, start, new_len)
@@ -1214,6 +1301,8 @@ class ContinuousEngine:
             log.exception("prefill chunk failed")
             self._reset(e)
             return False
+        if h is not None:
+            h.end(trace.SPAN_PREFILL_CHUNK)
         self._budget.note_prefill(take, time.monotonic() - t_chunk)
         self._chunks_this_tick += 1
         sl["pending"] = sl["pending"][take:]
@@ -1334,6 +1423,10 @@ class ContinuousEngine:
                 sl["len"] = min(sl["len"] + 1, self.max_len)
                 sl["remaining"] -= 1
                 ticked.append((i, sl["remaining"] <= 0))
+                h = trace.handle(sl["rid"])
+                if h is not None:
+                    h.instant(trace.EV_DISPATCH,
+                              {"tick": self.steps_run}, ts=t_step)
             self._inflight.append(
                 {"toks": toks_dev, "slots": ticked, "t0": t_step})
         # Fetch one tick behind (async) or immediately (sync).
@@ -1353,6 +1446,7 @@ class ContinuousEngine:
         if not self._inflight:
             return
         fl = self._inflight.pop(0)
+        t_f0 = time.monotonic()
         try:
             with self._clock.phase("fetch", exposed=False):
                 # The pipeline's one deliberate fence: tick t's
@@ -1368,7 +1462,8 @@ class ContinuousEngine:
             return
         # Dispatch-to-fetch span: the tick's device execution plus the
         # host work hidden under it — pipelined per-tick wall time.
-        t_tick = time.monotonic() - fl["t0"]
+        t_f1 = time.monotonic()
+        t_tick = t_f1 - fl["t0"]
         self.recorder.observe_decode_step(t_tick)
         self._budget.note_decode(t_tick)
         with self._clock.phase("stream"):
@@ -1376,12 +1471,20 @@ class ContinuousEngine:
                 sl = self._slots[i]
                 if sl is None:
                     continue  # reclaimed by reset/recovery before fetch
+                h = trace.handle(sl["rid"])
+                if h is not None:
+                    h.begin(trace.SPAN_FETCH, {"tick_ms": round(
+                        t_tick * 1e3, 3)}, ts=t_f0)
+                    h.end(trace.SPAN_FETCH, ts=t_f1)
+                    h.begin(trace.SPAN_STREAM)
                 # tpulint: allow=TPL010(host numpy scalar, fence paid)
                 tok = int(toks[i])
                 sl["out"].append(tok)
                 self._last_tok[i] = tok
                 self.recorder.decode_token(sl["rid"])
                 _stream_event(sl["stream"], {"token": tok}, sl["rid"])
+                if h is not None:
+                    h.end(trace.SPAN_STREAM)
                 # `final` was pinned at dispatch: a later in-flight
                 # dispatch may already have driven `remaining` to zero,
                 # and finishing on that would drop the true last token.
@@ -1486,6 +1589,11 @@ class ContinuousEngine:
                 c = min(len(seq), cap, sl["remaining"])
                 commit[i] = c
                 emitted[i] = seq[:c]
+                h = trace.handle(sl["rid"])
+                if h is not None:
+                    h.instant(trace.EV_DISPATCH,
+                              {"tick": self.steps_run + 1, "spec": True,
+                               "drafted": k, "committed": c}, ts=t_step)
         try:
             self._cache = self._adv_fn(self._cache, jnp.asarray(commit),
                                        active_arr)
@@ -1523,6 +1631,10 @@ class ContinuousEngine:
                 verifies=n_dec, committed=int(commit.sum()))
             for i in list(emitted):
                 sl = self._slots[i]
+                h = trace.handle(sl["rid"])
+                if h is not None and emitted[i]:
+                    h.begin(trace.SPAN_STREAM,
+                            {"tokens": len(emitted[i]), "spec": True})
                 for tok in emitted[i]:
                     sl["out"].append(tok)
                     sl["len"] = min(sl["len"] + 1, self.max_len)
@@ -1531,6 +1643,8 @@ class ContinuousEngine:
                     self.recorder.decode_token(sl["rid"])
                     _stream_event(sl["stream"], {"token": tok},
                                   sl["rid"])
+                if h is not None and emitted[i]:
+                    h.end(trace.SPAN_STREAM)
                 if sl["remaining"] <= 0:
                     self._finish(i)
         return True
@@ -1672,7 +1786,8 @@ class PagedContinuousEngine(ContinuousEngine):
                          engine_core=engine_core)
         assert self.max_len == self.max_pages * self.page
 
-    def submit(self, tokens, max_new_tokens, temperature, stream=None):
+    def submit(self, tokens, max_new_tokens, temperature, stream=None,
+               trace_ctx=None):
         """Reject prompts whose pages can NEVER all be free at once —
         admission would otherwise retry forever, head-of-line blocking
         every later request while the worker spins."""
@@ -1686,7 +1801,7 @@ class PagedContinuousEngine(ContinuousEngine):
                 "--pool-pages"))
             return fut
         return super().submit(tokens, max_new_tokens, temperature,
-                              stream=stream)
+                              stream=stream, trace_ctx=trace_ctx)
 
     def recover_after_worker_death(self, err: Exception) -> None:
         # Reclaim the dead worker's pages BEFORE failing the slots:
@@ -1756,6 +1871,11 @@ class PagedContinuousEngine(ContinuousEngine):
             self._cache = factory()
         self._alloc = PageAllocator(self.pool_pages)
         self._index = PrefixIndex(self._alloc, cap=self.prefix_cap)
+        # Requests whose admission is currently blocked on free pages:
+        # a req/page_stall span stays open from the first failed alloc
+        # to the successful admit (tools/trace_report.py attributes the
+        # gap, the doctor's page_stall detector fires on it).
+        self._page_stalled = set()
         self._fresh_draft_state()
 
     def _try_alloc(self, n):
@@ -1823,6 +1943,7 @@ class PagedContinuousEngine(ContinuousEngine):
             # Can never be satisfied (a PREEMPTED request's regrown
             # prompt can exceed what submit() validated) — fail it
             # instead of head-of-line blocking the backlog forever.
+            self._page_stalled.discard(rid)
             _fail(fut, stream, RuntimeError(
                 f"request needs {tp // page} prompt pages but the pool "
                 f"has only {self.pool_pages - 1} usable; raise "
@@ -1833,13 +1954,34 @@ class PagedContinuousEngine(ContinuousEngine):
         # (len-1)//page — the page holding the last live token stays
         # private since decode will write into it).
         n_full = (len(tokens) - 1) // page
+        h = trace.handle(rid)
+        if h is not None:
+            h.begin(trace.SPAN_PREFIX_LOOKUP, {"full_pages": n_full})
         keys = PrefixIndex.chain_keys(tokens, page, n_full)
         shared = self._index.match(keys)
         p_len = len(shared) * page
+        if h is not None:
+            h.end(trace.SPAN_PREFIX_LOOKUP,
+                  {"shared_pages": len(shared)})
+            h.begin(trace.SPAN_PAGE_ALLOC,
+                    {"pages": tp // page - len(shared)})
         fresh = self._try_alloc(tp // page - len(shared))
         if fresh is None:
             self._alloc.free(shared)  # drop refs; entries stay cached
+            if h is not None:
+                h.end(trace.SPAN_PAGE_ALLOC, {"ok": False})
+                if rid not in self._page_stalled:
+                    # Open-ended until the retry that admits succeeds.
+                    self._page_stalled.add(rid)
+                    h.begin(trace.SPAN_PAGE_STALL,
+                            {"pages_needed": tp // page - len(shared)})
             return False
+        if h is not None:
+            h.end(trace.SPAN_PAGE_ALLOC,
+                  {"ok": True, "fresh_pages": len(fresh)})
+            if rid in self._page_stalled:
+                self._page_stalled.discard(rid)
+                h.end(trace.SPAN_PAGE_STALL)
         if n_full:
             # One lookup per ADMITTED prompt with at least one full
             # page (shorter prompts can never hit; a backlogged retry
@@ -2194,6 +2336,17 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
+                # Client-driven tracing: `"trace": true` forces this
+                # request into the sample (head-sampling override);
+                # `"tags": {...}` stamps every span the request emits
+                # (loadgen sends tenant + request class, so Perfetto
+                # traces filter by tenant).
+                trace_ctx = None
+                if req.get("trace") or req.get("tags"):
+                    tags = req.get("tags")
+                    trace_ctx = {
+                        "force": bool(req.get("trace")),
+                        "tags": tags if isinstance(tags, dict) else None}
                 if req.get("stream"):
                     # queue.Queue, not SimpleQueue: this consumer does a
                     # timed get racing the engine's puts, the exact
@@ -2205,12 +2358,13 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
                         [int(t) for t in req["tokens"]],
                         int(req.get("max_new_tokens", 16)),
                         float(req.get("temperature", 0.0)),
-                        stream=stream_q)
+                        stream=stream_q, trace_ctx=trace_ctx)
                     return self._stream_response(stream_q)
                 fut = engine.submit(
                     [int(t) for t in req["tokens"]],
                     int(req.get("max_new_tokens", 16)),
-                    float(req.get("temperature", 0.0)))
+                    float(req.get("temperature", 0.0)),
+                    trace_ctx=trace_ctx)
                 return self._send({"tokens": fut.result(timeout=120)})
             except (KeyError, ValueError, TypeError) as e:
                 return self._send({"error": str(e)}, 400)
@@ -2339,6 +2493,23 @@ def main(argv=None) -> int:
                         "exit/crash and on SIGUSR2 (a directory gets a "
                         "per-pid file); TPU_TRACE_DUMP env is the "
                         "flagless equivalent")
+    p.add_argument("--trace-jsonl", default=None,
+                   help="stream the EventBus to this JSONL file as "
+                        "events happen (a directory gets a per-pid "
+                        "file) — the per-process input "
+                        "tools/trace_report.py merges into one "
+                        "Perfetto timeline; enables the bus if no "
+                        "--trace-dump armed it")
+    p.add_argument("--trace-sample-rate", type=float,
+                   default=trace.DEFAULT_SAMPLE_RATE,
+                   help="fraction of requests emitting per-request "
+                        "spans (req/queue, req/prefill_chunk, "
+                        "req/dispatch ... on eid=request id; "
+                        "metrics/trace.py), decided per request id. "
+                        "Failed/preempted/SLO-violating requests are "
+                        "ALWAYS captured via tail-sampling regardless "
+                        "of the rate; 0 disables head sampling, 1 "
+                        "traces everything")
     p.add_argument("--doctor", action="store_true",
                    help="run the streaming tpu-doctor (metrics/"
                         "doctor.py): detectors over the flight "
@@ -2386,7 +2557,6 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    from container_engine_accelerators_tpu.metrics import events
     if args.trace_dump:
         events.enable(dump_path=args.trace_dump, signals=True,
                       process_name="serve")
@@ -2394,6 +2564,13 @@ def main(argv=None) -> int:
                  "on demand)", args.trace_dump)
     else:
         events.configure_from_env(process_name="serve")
+    if args.trace_jsonl:
+        events.stream_jsonl(args.trace_jsonl)
+        log.info("streaming EventBus JSONL -> %s", args.trace_jsonl)
+    # The tracer is always configured: with the bus disabled start()
+    # returns None and the request path stays span-free; arming the bus
+    # later (--doctor, SIGUSR2 flows) picks the sample rate up as-is.
+    trace.configure(sample_rate=args.trace_sample_rate)
 
     from container_engine_accelerators_tpu.models.convert import load_model
 

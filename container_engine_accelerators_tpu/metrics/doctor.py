@@ -146,6 +146,14 @@ class DoctorConfig:
     # straggler: heartbeat age spread across processes.
     straggler_skew_s: float = 60.0
     health_storm_n: int = 3
+    # queue_storm: this many req/queue spans longer than queue_storm_s
+    # completing in the fast window (span-derived, ISSUE 17).
+    queue_storm_s: float = 0.75
+    queue_storm_n: int = 4
+    # page_stall: req/page_stall spans (admission blocked on free
+    # pages) longer than page_stall_s in the fast window.
+    page_stall_s: float = 0.25
+    page_stall_n: int = 2
     # Incident episode hygiene: a quiet condition re-arms after this.
     clear_after_s: float = 30.0
     slos: list = dataclasses.field(default_factory=default_slos)
@@ -233,6 +241,34 @@ class Signals:
         for e in self.prefixed(prefix, "C", since):
             out.setdefault(e["name"][len(prefix):], []).append(
                 (e["ts"], e["args"]))
+        return out
+
+    def async_spans(self, name: str, since: float | None = None,
+                    include_open: bool = False) -> list[dict]:
+        """Async b/e pairs for one span name, matched per event id:
+        [{"id", "t0", "t1", "dur", "open"}], t1-ordered. `since` keeps
+        spans that END (or, when open, still run) inside the window;
+        `include_open` also returns unmatched begins with t1 = now —
+        how a stall that has not resolved yet becomes visible."""
+        begins: dict[str, list[float]] = {}
+        out: list[dict] = []
+        for e in self.events:
+            if e["name"] != name or e.get("id") is None:
+                continue
+            rid = str(e["id"])
+            if e["ph"] == "b":
+                begins.setdefault(rid, []).append(e["ts"])
+            elif e["ph"] == "e" and begins.get(rid):
+                t0 = begins[rid].pop()
+                if since is None or e["ts"] >= since:
+                    out.append({"id": rid, "t0": t0, "t1": e["ts"],
+                                "dur": e["ts"] - t0, "open": False})
+        if include_open:
+            for rid, stack in begins.items():
+                for t0 in stack:
+                    out.append({"id": rid, "t0": t0, "t1": self.now,
+                                "dur": self.now - t0, "open": True})
+        out.sort(key=lambda s: s["t1"])
         return out
 
     def ttft_samples(self, since: float) -> list[float]:
@@ -607,11 +643,85 @@ class SloBurnDetector(Detector):
         return out
 
 
+class QueueStormDetector(Detector):
+    """Span-derived admission-wait inflation (ISSUE 17): multiple
+    requests' req/queue spans (enqueue -> admit, re-opened on preempt)
+    run long inside the fast window. Distinct from queue_collapse —
+    requests ARE admitted, just slowly: the backlog is churning, not
+    dead. The verdict names the triggering request ids so the operator
+    can jump straight to their tracks in the merged Perfetto trace."""
+
+    cls = "queue_storm"
+
+    def check(self, sig):
+        spans = sig.async_spans("req/queue", sig.fast_since,
+                                include_open=True)
+        slow = [s for s in spans if s["dur"] >= sig.config.queue_storm_s]
+        if len(slow) < sig.config.queue_storm_n:
+            return []
+        rids = sorted({s["id"] for s in slow})
+        worst = max(slow, key=lambda s: s["dur"])
+        ev = {"count": len(slow), "rids": rids,
+              "threshold_s": sig.config.queue_storm_s,
+              "worst_s": round(worst["dur"], 3),
+              "window_s": sig.config.fast_window_s,
+              "events": [_evidence_event(
+                  {"name": "req/queue", "ph": "e", "ts": s["t1"],
+                   "id": s["id"],
+                   "args": {"dur_s": round(s["dur"], 3),
+                            "open": s["open"]}})
+                  for s in slow[-5:]]}
+        return [Finding(
+            self.cls, "serve",
+            f"{len(slow)} requests waited >= "
+            f"{sig.config.queue_storm_s:.2f}s for admission in "
+            f"{sig.config.fast_window_s:.0f}s (worst "
+            f"{worst['dur']:.2f}s, rid {worst['id']})", 0.85, ev)]
+
+
+class PageStallDetector(Detector):
+    """Span-derived KV page starvation (ISSUE 17): req/page_stall
+    spans — admission blocked on free pages, opened at the first
+    failed alloc and closed at the successful retry — exceeding
+    page_stall_s. Open spans count at their current age, so a stall
+    that never resolves still fires. The page pool, not compute, is
+    the bottleneck: raise --pool-pages or shrink --prefix-cache-cap."""
+
+    cls = "page_stall"
+
+    def check(self, sig):
+        spans = sig.async_spans("req/page_stall", sig.fast_since,
+                                include_open=True)
+        long = [s for s in spans if s["dur"] >= sig.config.page_stall_s]
+        if len(long) < sig.config.page_stall_n:
+            return []
+        rids = sorted({s["id"] for s in long})
+        worst = max(long, key=lambda s: s["dur"])
+        ev = {"count": len(long), "rids": rids,
+              "threshold_s": sig.config.page_stall_s,
+              "worst_s": round(worst["dur"], 3),
+              "still_open": sum(1 for s in long if s["open"]),
+              "window_s": sig.config.fast_window_s,
+              "events": [_evidence_event(
+                  {"name": "req/page_stall", "ph": "e", "ts": s["t1"],
+                   "id": s["id"],
+                   "args": {"dur_s": round(s["dur"], 3),
+                            "open": s["open"]}})
+                  for s in long[-5:]]}
+        return [Finding(
+            self.cls, "serve",
+            f"{len(long)} admissions blocked >= "
+            f"{sig.config.page_stall_s:.2f}s on free KV pages in "
+            f"{sig.config.fast_window_s:.0f}s (worst "
+            f"{worst['dur']:.2f}s, rid {worst['id']})", 0.85, ev)]
+
+
 def default_detectors() -> list[Detector]:
     return [EngineHangDetector(), RecompileStormDetector(),
             OomPrecursorDetector(), QueueCollapseDetector(),
             StragglerDetector(), HealthStormDetector(),
-            SloBurnDetector()]
+            SloBurnDetector(), QueueStormDetector(),
+            PageStallDetector()]
 
 
 # ---------- detector helpers ----------

@@ -239,6 +239,15 @@ class EventBus:
     def dropped(self) -> int:
         return max(0, self._n - self.capacity)
 
+    @property
+    def tap_dropped(self) -> int:
+        """Events lost to slow tap consumers, summed over live taps —
+        the JSONL streamer's blind spots, surfaced on /metrics next to
+        the ring's own `dropped` (ISSUE 17 satellite: truncated traces
+        are labeled, never silent)."""
+        with self._lock:
+            return sum(t.dropped for t in self._taps)
+
     def snapshot(self) -> list:
         """Raw event tuples, oldest first (at most `capacity`)."""
         with self._lock:
@@ -485,12 +494,123 @@ def _install_signal_hook() -> None:
 
 def _reset_for_tests() -> None:
     """Restore pristine module state (tests only)."""
-    global _DUMP_PATH
+    global _DUMP_PATH, _JSONL_WRITER
+    if _JSONL_WRITER is not None:
+        _JSONL_WRITER.close()
+        _JSONL_WRITER = None
     _BUS.enabled = False
     _BUS.clear()
     with _BUS._lock:
         _BUS._taps.clear()
     _DUMP_PATH = None
+
+
+# ---------- per-process JSONL streaming ----------
+
+def _resolve_jsonl_path(path: str) -> str:
+    """Directory paths get a per-pid `events-<pid>.jsonl` so every
+    process in a job can share one --trace-jsonl directory without
+    clobbering (same contract as _resolve_dump_path)."""
+    if path.endswith(os.sep) or os.path.isdir(path):
+        return os.path.join(path, f"events-{os.getpid()}.jsonl")
+    return path
+
+
+class JsonlWriter:
+    """Streams the bus to an append-only JSONL file via a bounded tap
+    and a daemon flusher thread, so long runs survive the ring's
+    wraparound: the ring keeps the last N events for crash dumps, the
+    JSONL keeps the WHOLE run for offline merge (tools/trace_report).
+
+    Line 1 is a header record carrying the process anchor; each event
+    line is the same Chrome event dict `dump()` writes (monotonic µs
+    timestamps — the merge rebases via the header anchor). When the
+    tap overflows, a `{"kind": "drops"}` record lands in-stream so the
+    reader can label the gap instead of missing it silently."""
+
+    def __init__(self, bus: EventBus, path: str,
+                 flush_interval: float = 0.25,
+                 tap_capacity: int = 32768):
+        self.path = _resolve_jsonl_path(path)
+        self.bus = bus
+        self.flush_interval = flush_interval
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "w")
+        self._write_rec({"kind": "anchor", "anchor": dict(bus.anchor),
+                         "process_name": bus.process_name,
+                         "capacity": bus.capacity})
+        self._reported_dropped = 0
+        self._stop = threading.Event()
+        self._closed = False
+        self._tap = bus.subscribe(
+            f"jsonl:{os.path.basename(self.path)}", tap_capacity)
+        self._thread = threading.Thread(
+            target=self._run, name="trace-jsonl-flusher", daemon=True)
+        self._thread.start()
+
+    def _write_rec(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+
+    def _drain_once(self) -> int:
+        evs = self._tap.drain()
+        for ev in evs:
+            self._write_rec(self.bus._event_dict(ev))
+        if self._tap.dropped > self._reported_dropped:
+            self._write_rec({"kind": "drops",
+                             "tap_dropped": self._tap.dropped})
+            self._reported_dropped = self._tap.dropped
+        if evs:
+            self._f.flush()
+        return len(evs)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            try:
+                self._drain_once()
+            except Exception:
+                log.exception("trace-jsonl flush to %s failed", self.path)
+                return
+
+    def close(self) -> None:
+        """Stop the flusher, drain the backlog, close the file. Never
+        raises — the flight recorder must not take down its host."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.bus.unsubscribe(self._tap)
+        try:
+            self._drain_once()
+            self._f.close()
+        except Exception:
+            log.exception("trace-jsonl close of %s failed", self.path)
+
+
+_JSONL_WRITER: JsonlWriter | None = None
+
+
+def stream_jsonl(path: str, flush_interval: float = 0.25) -> JsonlWriter:
+    """Attach (or re-target) the process-wide JSONL streamer; enables
+    the bus if it isn't already on. Closed at exit so the tail of the
+    stream lands on disk."""
+    global _JSONL_WRITER
+    if not _BUS.enabled:
+        enable()
+    if _JSONL_WRITER is not None:
+        if _JSONL_WRITER.path == _resolve_jsonl_path(path):
+            return _JSONL_WRITER
+        _JSONL_WRITER.close()
+    _JSONL_WRITER = JsonlWriter(_BUS, path, flush_interval=flush_interval)
+    atexit.register(_atexit_close_jsonl)
+    return _JSONL_WRITER
+
+
+def _atexit_close_jsonl() -> None:
+    if _JSONL_WRITER is not None:
+        _JSONL_WRITER.close()
 
 
 # ---------- cross-process merge ----------
@@ -596,16 +716,38 @@ def _sse_log_events(path: str, pid: int) -> list[dict]:
     return out
 
 
-def merge_traces(dump_paths=(), train_jsonl_paths=(), sse_log_paths=()
-                 ) -> dict:
-    """Merge per-process EventBus dumps + TrainRecorder JSONL step logs
-    + stamped SSE event logs into ONE clock-aligned Chrome trace.
+def _event_jsonl_records(path: str):
+    """Parsed records of a JsonlWriter stream, tolerating a torn final
+    line (the writer may have been killed mid-append)."""
+    try:
+        with open(path, errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
 
-    Every source is rebased to unix-epoch µs (bus dumps via their
-    recorded anchor, JSONL/SSE via their per-record epoch stamps), then
-    shifted so the earliest event sits at ts 0 — `otherData.
-    epoch_origin_us` records the subtracted origin so absolute wall
-    times stay recoverable."""
+
+def merge_traces(dump_paths=(), train_jsonl_paths=(), sse_log_paths=(),
+                 event_jsonl_paths=()) -> dict:
+    """Merge per-process EventBus dumps + TrainRecorder JSONL step logs
+    + stamped SSE event logs + streamed EventBus JSONL files into ONE
+    clock-aligned Chrome trace.
+
+    Every source is rebased to unix-epoch µs (bus dumps/JSONL streams
+    via their recorded anchor, train-JSONL/SSE via their per-record
+    epoch stamps), then shifted so the earliest event sits at ts 0 —
+    `otherData.epoch_origin_us` records the subtracted origin so
+    absolute wall times stay recoverable. Per-source drop counts ride
+    along in `otherData.sources` so a truncated merge is labeled."""
     merged: list[dict] = []
     meta: list[dict] = []
     sources = []
@@ -613,7 +755,8 @@ def merge_traces(dump_paths=(), train_jsonl_paths=(), sse_log_paths=()
 
     for path in dump_paths:
         data = _load_json(path)
-        anchor = (data.get("otherData") or {}).get("anchor") or {}
+        other = data.get("otherData") or {}
+        anchor = other.get("anchor") or {}
         off_us = (float(anchor.get("unix_time", 0.0))
                   - float(anchor.get("monotonic", 0.0))) * 1e6
         n = 0
@@ -626,7 +769,53 @@ def merge_traces(dump_paths=(), train_jsonl_paths=(), sse_log_paths=()
             merged.append(ev)
             n += 1
         sources.append({"path": path, "kind": "eventbus", "events": n,
-                        "pid": anchor.get("pid")})
+                        "pid": anchor.get("pid"),
+                        "dropped": other.get("dropped", 0)})
+
+    for path in event_jsonl_paths:
+        recs = _event_jsonl_records(path)
+        anchor = {}
+        pname = None
+        dropped = 0
+        n = 0
+        evs: list[dict] = []
+        for rec in recs:
+            kind = rec.get("kind")
+            if kind == "anchor":
+                anchor = rec.get("anchor") or {}
+                pname = rec.get("process_name")
+                continue
+            if kind == "drops":
+                dropped = max(dropped, int(rec.get("tap_dropped", 0)))
+                continue
+            if "ph" not in rec or "ts" not in rec:
+                continue
+            evs.append(rec)
+        if not anchor:
+            # Monotonic-only stamps from an unknown process cannot be
+            # aligned; record the skip instead of merging garbage.
+            sources.append({"path": path, "kind": "event-jsonl",
+                            "events": 0, "dropped": dropped,
+                            "skipped": "no_anchor"})
+            continue
+        off_us = (float(anchor.get("unix_time", 0.0))
+                  - float(anchor.get("monotonic", 0.0))) * 1e6
+        pid = anchor.get("pid")
+        for ev in evs:
+            ev = dict(ev)
+            if ev.get("ph") == "M":
+                meta.append(ev)
+                continue
+            ev["ts"] = float(ev.get("ts", 0.0)) + off_us
+            merged.append(ev)
+            n += 1
+        if pid is not None and pname:
+            meta.append(_synth_meta(
+                int(pid), f"{pname} ({anchor.get('host', '?')} "
+                          f"pid {pid})"))
+        sources.append({"path": path, "kind": "event-jsonl",
+                        "events": n, "pid": pid, "dropped": dropped,
+                        "process_name": pname})
 
     for path in train_jsonl_paths:
         synth_pid += 1
@@ -659,8 +848,9 @@ def merge_traces(dump_paths=(), train_jsonl_paths=(), sse_log_paths=()
 
 
 def write_merged(out_path: str, dump_paths=(), train_jsonl_paths=(),
-                 sse_log_paths=()) -> dict:
-    trace = merge_traces(dump_paths, train_jsonl_paths, sse_log_paths)
+                 sse_log_paths=(), event_jsonl_paths=()) -> dict:
+    trace = merge_traces(dump_paths, train_jsonl_paths, sse_log_paths,
+                         event_jsonl_paths)
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)

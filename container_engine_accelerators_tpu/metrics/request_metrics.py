@@ -44,7 +44,7 @@ import time
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
-from container_engine_accelerators_tpu.metrics import events
+from container_engine_accelerators_tpu.metrics import events, trace
 from container_engine_accelerators_tpu.metrics.serving import ExporterBase
 
 # Spans the tiny-model CPU tests (~1 ms steps) through real serving
@@ -278,6 +278,12 @@ class RequestRecorder:
                 events.async_begin("request", rid, "serve")
                 events.counter("serve/queue_depth",
                                {"queued": self._queued})
+            # Per-request trace (ISSUE 17): the queue span opens here.
+            # `start` is idempotent — engines that started the trace
+            # with force/tags in submit() get their handle back.
+            h = trace.start(rid)
+            if h is not None:
+                h.begin(trace.SPAN_QUEUE, ts=now)
 
     def admit(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -297,6 +303,10 @@ class RequestRecorder:
                 events.async_instant("admit", rid, "serve")
                 events.counter("serve/queue_depth",
                                {"queued": self._queued})
+            h = trace.handle(rid)
+            if h is not None:
+                h.end(trace.SPAN_QUEUE, ts=now)
+                h.begin(trace.SPAN_PREFILL, ts=now)
 
     def first_token(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -304,12 +314,19 @@ class RequestRecorder:
             st = self._state.get(rid)
             if st is None:
                 return
-            self._observe("ttft", now - st["enqueue_ts"], now)
+            ttft = now - st["enqueue_ts"]
+            self._observe("ttft", ttft, now)
             if "admit_ts" in st:
                 self._observe("prefill", now - st["admit_ts"], now)
             st["last_tok_ts"] = now
             if events.enabled():
                 events.async_instant("first_token", rid, "serve")
+            h = trace.handle(rid)
+            if h is not None:
+                tr = trace.get()
+                h.note_ttft(ttft * 1e3,
+                            tr.slo_ttft_ms if tr else None)
+                h.end(trace.SPAN_PREFILL, ts=now)
 
     def decode_token(self, rid, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -317,8 +334,14 @@ class RequestRecorder:
             st = self._state.get(rid)
             if st is None or "last_tok_ts" not in st:
                 return
-            self._observe("tpot", now - st["last_tok_ts"], now)
+            tpot = now - st["last_tok_ts"]
+            self._observe("tpot", tpot, now)
             st["last_tok_ts"] = now
+            h = trace.handle(rid)
+            if h is not None:
+                tr = trace.get()
+                h.note_tpot(tpot * 1e3,
+                            tr.slo_tpot_ms if tr else None)
 
     def observe_tpot(self, seconds: float) -> None:
         """Direct TPOT observation for engines with no incremental
@@ -351,6 +374,13 @@ class RequestRecorder:
                 events.async_instant("preempt", rid, "serve")
                 events.counter("serve/queue_depth",
                                {"queued": self._queued})
+            h = trace.handle(rid)
+            if h is not None:
+                # Preemption promotes the trace out of the tail buffer
+                # and re-opens the queue span for the requeue wait.
+                h.promote("preempt")
+                h.instant(trace.EV_PREEMPT, ts=now)
+                h.begin(trace.SPAN_QUEUE, {"requeue": True}, ts=now)
 
     def finish(self, rid) -> None:
         self._close(rid, "ok")
@@ -370,6 +400,9 @@ class RequestRecorder:
             if events.enabled():
                 events.async_end("request", rid, "serve",
                                  {"outcome": outcome})
+            # Tail-sampling decision point: failed / preempted / SLO-
+            # violating requests flush their buffered spans here.
+            trace.finish(rid, outcome)
 
     # ---------- occupancy gauges (set by the worker loop) ----------
 

@@ -44,10 +44,17 @@ def attach_ring_gauges(registry) -> None:
             "Ring events overwritten before any dump/tap could read "
             "them — nonzero means the flight recorder has blind spots",
             registry=registry)
+        tap_dropped = Gauge(
+            "tpu_trace_tap_events_dropped_total",
+            "Events lost to slow tap consumers (JSONL streamers, the "
+            "streaming doctor) before they could drain — nonzero means "
+            "streamed traces are truncated (ISSUE 17)",
+            registry=registry)
     except ValueError:
         return  # this registry already carries the ring gauges
     emitted.set_function(lambda: float(events.get_bus().emitted))
     dropped.set_function(lambda: float(events.get_bus().dropped))
+    tap_dropped.set_function(lambda: float(events.get_bus().tap_dropped))
 
 
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):

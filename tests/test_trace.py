@@ -1,0 +1,278 @@
+"""Request tracing (ISSUE 17): head-sampling determinism, span pairing
+across the prefill-pool handoff, cross-process JSONL merge into one
+valid Perfetto-loadable trace, tail-sampling of failed / promoted
+requests (with tail-buffer truncation markers), the span-derived
+doctor detectors (queue_storm / page_stall), and the trace_report
+TTFT/TPOT attribution table."""
+
+import io
+import threading
+
+import pytest
+
+from container_engine_accelerators_tpu.metrics import doctor, events, trace
+from container_engine_accelerators_tpu.metrics.doctor import DoctorConfig
+from container_engine_accelerators_tpu.metrics.events import EventBus
+from tools import trace_report
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    """Every test starts and ends with the process-wide bus AND tracer
+    reset — the tracer rides the bus, so both must go."""
+    def reset():
+        trace._reset_for_tests()
+        events._reset_for_tests()
+    reset()
+    yield
+    reset()
+
+
+# ---------- head sampling ----------
+
+def test_head_sampled_edges_and_determinism():
+    rids = list(range(200)) + [f"req-{i}" for i in range(200)]
+    assert all(trace.head_sampled(r, 1.0) for r in rids)
+    assert not any(trace.head_sampled(r, 0.0) for r in rids)
+    # Pure function of (rid, rate): the same request samples the same
+    # way in loadgen (client side) and serve (server side).
+    first = [trace.head_sampled(r, 0.25) for r in rids]
+    assert first == [trace.head_sampled(r, 0.25) for r in rids]
+
+
+def test_head_sampled_rate_is_roughly_honored():
+    n = 20_000
+    hits = sum(trace.head_sampled(i, 0.1) for i in range(n))
+    assert 0.05 * n < hits < 0.15 * n
+
+
+# ---------- span pairing across the pool handoff ----------
+
+def test_span_pairing_across_pool_handoff():
+    """A prefill chunk begun on a pool worker thread and ended on the
+    engine thread still pairs: async spans pair by request id, not by
+    the emitting thread."""
+    events.enable(process_name="serve")
+    trace.configure(sample_rate=1.0)
+    h = trace.start(7, tags={"tenant": "0", "class": "chat"})
+    with h.span(trace.SPAN_QUEUE):
+        pass
+    t = threading.Thread(
+        target=lambda: h.begin(trace.SPAN_PREFILL_CHUNK, {"chunk": 0}))
+    t.start()
+    t.join()
+    h.end(trace.SPAN_PREFILL_CHUNK, {"tokens": 32})
+    with h.span(trace.SPAN_STREAM):
+        pass
+    trace.finish(7)
+
+    by_rid = trace_report._req_events(events.get_bus().to_chrome())
+    assert set(by_rid) == {"7"}
+    spans = trace_report.pair_spans(by_rid["7"])
+    assert [s["name"] for s in spans] == [
+        trace.SPAN_QUEUE, trace.SPAN_PREFILL_CHUNK, trace.SPAN_STREAM]
+    assert not any(s["open"] for s in spans)
+    # begin-side and end-side args merge onto one span...
+    chunk = spans[1]
+    assert chunk["args"]["chunk"] == 0 and chunk["args"]["tokens"] == 32
+    # ...and the handle's tags ride on every span for the report.
+    assert all(s["args"]["tenant"] == "0" for s in spans)
+
+
+def test_unclosed_span_stays_open_to_track_end():
+    evs = [
+        {"name": trace.SPAN_QUEUE, "ph": "b", "ts": 0.0, "id": "1"},
+        {"name": trace.SPAN_QUEUE, "ph": "e", "ts": 10.0, "id": "1"},
+        {"name": trace.SPAN_PAGE_STALL, "ph": "b", "ts": 20.0, "id": "1"},
+        {"name": "req/x", "ph": "n", "ts": 50.0, "id": "1"},
+    ]
+    spans = trace_report.pair_spans(evs)
+    stall = [s for s in spans if s["name"] == trace.SPAN_PAGE_STALL][0]
+    assert stall["open"] and stall["t1"] == 50.0
+
+
+# ---------- cross-process merge ----------
+
+def test_cross_process_merge_is_valid_and_joins_one_rid(tmp_path):
+    """Serve process streams JSONL; the prefill pool process dumps a
+    ring. One request's spans live in both. The merge must produce a
+    single valid Chrome trace with that rid's events from both pids and
+    per-track monotonic timestamps."""
+    bus = events.enable(process_name="serve")
+    writer = events.JsonlWriter(bus, str(tmp_path / "serve.trace.jsonl"),
+                                flush_interval=0.01)
+    trace.configure(sample_rate=1.0)
+    h = trace.start(42, tags={"tenant": "1", "class": "batch"})
+    with h.span(trace.SPAN_QUEUE):
+        pass
+    with h.span(trace.SPAN_STREAM):
+        pass
+    trace.finish(42)
+    writer.close()
+
+    pool = EventBus(capacity=128, enabled=True, process_name="pool")
+    pool.anchor = dict(bus.anchor)
+    pool.anchor.update({"pid": bus.anchor["pid"] + 1,
+                        "process_name": "pool"})
+    base = bus.anchor["monotonic"]
+    pool._emit("b", trace.SPAN_PREFILL_CHUNK, trace.CAT, {"chunk": 0},
+               ts=base + 0.001, eid=42)
+    pool._emit("e", trace.SPAN_PREFILL_CHUNK, trace.CAT, None,
+               ts=base + 0.002, eid=42)
+    dump_path = pool.dump(str(tmp_path / "pool.json"))
+
+    merged = events.merge_traces(
+        dump_paths=[dump_path],
+        event_jsonl_paths=[str(tmp_path / "serve.trace.jsonl")])
+    assert trace_report.validate_trace(merged) == []
+
+    by_rid = trace_report._req_events(merged)
+    evs42 = by_rid["42"]
+    pids = {e.get("pid") for e in evs42}
+    assert len(pids) == 2, f"expected both processes on rid 42: {pids}"
+    names = {s["name"] for s in trace_report.pair_spans(evs42)}
+    assert {trace.SPAN_QUEUE, trace.SPAN_PREFILL_CHUNK,
+            trace.SPAN_STREAM} <= names
+
+
+# ---------- tail sampling ----------
+
+def test_tail_sampling_flushes_failures_and_promotions_only():
+    events.enable(process_name="serve")
+    trace.configure(sample_rate=0.0)
+    for rid in (1, 2, 3):
+        h = trace.start(rid)
+        with h.span(trace.SPAN_QUEUE):
+            pass
+    trace.handle(3).promote("pool_restart")
+    # Unsampled handles buffer: nothing on the bus until an outcome
+    # worth keeping shows up.
+    assert not [e for e in events.get_bus().to_chrome()["traceEvents"]
+                if e.get("cat") == "req"]
+
+    trace.finish(1)                     # clean: discarded
+    trace.finish(2, outcome="error")    # failed: flushed
+    trace.finish(3)                     # promoted: flushed
+
+    by_rid = trace_report._req_events(events.get_bus().to_chrome())
+    assert set(by_rid) == {"2", "3"}
+    why = {rid: [(e.get("args") or {}).get("why") for e in evs
+                 if e.get("name") == "req/tail_sampled"][0]
+           for rid, evs in by_rid.items()}
+    assert why == {"2": "outcome", "3": "pool_restart"}
+    # Buffered spans replay with their ORIGINAL timestamps: the queue
+    # span still pairs after the flush.
+    assert [s["name"] for s in trace_report.pair_spans(by_rid["2"])
+            ] == [trace.SPAN_QUEUE]
+    stats = trace.get().stats()
+    assert stats["flushed"] == 2 and stats["discarded"] == 1
+
+
+def test_tail_buffer_overflow_emits_truncation_marker():
+    events.enable(process_name="serve")
+    trace.configure(sample_rate=0.0, tail_events=8)
+    h = trace.start(9)
+    for i in range(30):
+        h.instant("req/dispatch", {"i": i})
+    trace.finish(9, outcome="error")
+    evs = trace_report._req_events(events.get_bus().to_chrome())["9"]
+    trunc = [e for e in evs if e.get("name") == trace.EV_TRUNCATED]
+    assert trunc and trunc[0]["args"]["dropped"] > 0
+    report = trace_report.build_report(events.get_bus().to_chrome())
+    assert report["truncated"]
+    assert report["requests"][0]["truncated_events"] > 0
+
+
+# ---------- span-derived doctor detectors ----------
+
+def _span(name, rid, t0_us, t1_us):
+    return [{"name": name, "cat": "req", "ph": "b", "ts": t0_us,
+             "id": str(rid), "pid": 1, "tid": 1},
+            {"name": name, "cat": "req", "ph": "e", "ts": t1_us,
+             "id": str(rid), "pid": 1, "tid": 1}]
+
+
+def test_doctor_queue_storm_and_page_stall_from_span_stream(tmp_path):
+    evs = []
+    for rid in (1, 2, 3):           # three 2s admission waits
+        evs += _span(trace.SPAN_QUEUE, rid, 0.0, 2e6)
+    evs += _span(trace.SPAN_QUEUE, 4, 0.0, 0.1e6)   # fast: not a storm
+    evs += _span(trace.SPAN_PAGE_STALL, 9, 1e6, 1.6e6)
+    evs.sort(key=lambda e: e["ts"])
+    cfg = DoctorConfig(queue_storm_s=1.0, queue_storm_n=3,
+                       page_stall_s=0.25, page_stall_n=1,
+                       fast_window_s=60.0)
+    incidents = doctor.replay({"traceEvents": evs}, config=cfg,
+                              step_s=1.0, out_dir=str(tmp_path))
+    by_cls = {i["class"]: i for i in incidents}
+    assert "queue_storm" in by_cls and "page_stall" in by_cls
+    assert set(by_cls["queue_storm"]["evidence"]["rids"]) == {
+        "1", "2", "3"}
+    assert by_cls["page_stall"]["evidence"]["rids"] == ["9"]
+
+
+def test_doctor_quiet_on_healthy_span_stream(tmp_path):
+    evs = []
+    for rid in range(6):
+        evs += _span(trace.SPAN_QUEUE, rid, rid * 1e5, rid * 1e5 + 2e4)
+    evs.sort(key=lambda e: e["ts"])
+    incidents = doctor.replay({"traceEvents": evs},
+                              config=DoctorConfig(),
+                              step_s=1.0, out_dir=str(tmp_path))
+    assert [i for i in incidents
+            if i["class"] in ("queue_storm", "page_stall")] == []
+
+
+# ---------- attribution report ----------
+
+def test_attribution_table_decomposes_ttft_and_tpot():
+    evs = []
+    evs += _span(trace.SPAN_QUEUE, 5, 0.0, 100e3)           # 100ms
+    prefill = _span(trace.SPAN_PREFILL, 5, 100e3, 150e3)    # 50ms
+    prefill[0]["args"] = {"tenant": "2", "class": "chat"}
+    evs += prefill
+    evs += _span(trace.SPAN_PREFILL_CHUNK, 5, 100e3, 140e3)  # 40ms
+    for k in range(2):
+        t0 = 150e3 + k * 100e3
+        evs.append({"name": trace.EV_DISPATCH, "cat": "req", "ph": "n",
+                    "ts": t0, "id": "5", "pid": 1, "tid": 1})
+        fetch = _span(trace.SPAN_FETCH, 5, t0 + 10e3, t0 + 90e3)
+        fetch[1]["args"] = {"tick_ms": 60.0}
+        evs += fetch
+    evs += _span(trace.SPAN_STREAM, 5, 150e3, 350e3)
+    evs.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "b" else 1))
+    merged = {"traceEvents": evs, "otherData": {"sources": [
+        {"path": "a.json", "kind": "eventbus", "events": len(evs),
+         "dropped": 0}]}}
+
+    report = trace_report.build_report(merged)
+    assert report["problems"] == []
+    assert not report["truncated"]
+    (row,) = report["requests"]
+    assert (row["rid"], row["tenant"], row["class"]) == ("5", "2", "chat")
+    assert row["ticks"] == 2
+    assert row["queue_ms"] == pytest.approx(100.0)
+    assert row["prefill_ms"] == pytest.approx(40.0)
+    # TTFT anchors on the enclosing prefill span's end.
+    assert row["ttft_ms"] == pytest.approx(150.0)
+    # Decode wall = 350 - 150 = 200ms over 2 ticks; device = 2 x 60ms.
+    assert row["tpot_ms"] == pytest.approx(100.0)
+    assert row["device_ms"] == pytest.approx(120.0)
+    assert row["exposed_host_ms"] == pytest.approx(80.0)
+
+    out = io.StringIO()
+    trace_report.print_report(report, file=out)
+    text = out.getvalue()
+    assert "rid" in text and "exposed_host_ms" in text
+    assert "TRUNCATED" not in text
+
+
+def test_report_surfaces_source_drops_as_truncation():
+    merged = {"traceEvents": [], "otherData": {"sources": [
+        {"path": "a.jsonl", "kind": "event_jsonl", "events": 10,
+         "dropped": 7}]}}
+    report = trace_report.build_report(merged)
+    assert report["events_dropped_total"] == 7 and report["truncated"]
+    out = io.StringIO()
+    trace_report.print_report(report, file=out)
+    assert "TRACE TRUNCATED" in out.getvalue()

@@ -77,7 +77,7 @@ _ACTIONS = ("sleep", "warmup", "loadgen", "loadgen_start", "loadgen_wait",
             "inject", "health_errors", "kill", "start", "wait_exit",
             "wait_ckpt_steps", "wait_log_record", "corrupt_newest_ckpt")
 _ASSERT_KEYS = ("doctor", "serve_gauges_baseline", "healthz",
-                "timeline_require", "train", "ckpt")
+                "timeline_require", "train", "ckpt", "request_trace")
 # Actions that mark the end of the clean phase: the first one to run
 # stamps fault_start, and the doctor assertion rejects any incident
 # diagnosed before it.
@@ -208,6 +208,16 @@ def check_doctor(incidents: list[dict], spec: dict,
                 bool(got) and all(i["subject"] == want["subject"]
                                   for i in got),
                 f"expected subject {want['subject']!r}, got {subjects}"))
+        # evidence_has: each incident's evidence must carry these keys
+        # NON-EMPTY — e.g. the span-derived serving verdicts must name
+        # the triggering request ids, not just count them.
+        for key in (want.get("evidence_has", [])
+                    if isinstance(want, dict) else []):
+            vals = [i.get("evidence", {}).get(key) for i in got]
+            out.append(_result(
+                f"doctor.{cls}.evidence.{key}",
+                bool(got) and all(vals),
+                f"evidence[{key}] per incident: {vals}"))
     unexpected = [c for c in by_cls
                   if c not in expect and c not in allow]
     out.append(_result(
@@ -449,6 +459,57 @@ def check_timeline(trace: dict, require: list[str]) -> list[dict]:
             f"timeline.{req}", req in names,
             f"event {req!r} {'present' if req in names else 'MISSING'} "
             "on the merged timeline"))
+    return out
+
+
+def check_request_trace(trace: dict, spec: dict) -> list[dict]:
+    """(ISSUE 17) per-request span assertions over the merged
+    timeline. `min_traced` — at least N distinct request tracks carry
+    spans. `sequences` — some single request's track shows the named
+    instant FOLLOWED by the listed span begins in order (e.g. a pool
+    victim: req/pool_restart, then a fresh req/prefill_chunk, then
+    req/stream — the restart was survived on the SAME request, not
+    papered over by a retry)."""
+    reqs: dict[str, list[dict]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("cat") != "req" or e.get("id") is None:
+            continue
+        reqs.setdefault(str(e["id"]), []).append(e)
+    for evs in reqs.values():
+        evs.sort(key=lambda e: float(e.get("ts", 0.0)))
+    out = []
+    if "min_traced" in spec:
+        out.append(_check_count("request_trace.traced", len(reqs),
+                                {"min": int(spec["min_traced"])}))
+    for i, want in enumerate(spec.get("sequences", [])):
+        label = want.get("label", f"seq{i}")
+        hit = None
+        for rid, evs in sorted(reqs.items()):
+            idx = next(
+                (j for j, e in enumerate(evs)
+                 if e.get("name") == want["after_instant"]
+                 and e.get("ph") in ("n", "i", "I")), None)
+            if idx is None:
+                continue
+            begins = [e.get("name") for e in evs[idx:]
+                      if e.get("ph") in ("b", "B")]
+            pos, ok = 0, True
+            for span in want.get("spans", []):
+                try:
+                    pos = begins.index(span, pos) + 1
+                except ValueError:
+                    ok = False
+                    break
+            if ok:
+                hit = rid
+                break
+        out.append(_result(
+            f"request_trace.{label}", hit is not None,
+            (f"request {hit} shows {want['after_instant']} then "
+             f"{want.get('spans', [])}" if hit is not None else
+             f"no request track shows {want['after_instant']} "
+             f"followed by spans {want.get('spans', [])} "
+             f"({len(reqs)} tracks examined)")))
     return out
 
 
@@ -736,6 +797,8 @@ def _loadgen_args(url: str, ph: dict) -> "argparse.Namespace":
                  str(ph.get("tenant_prefix_len", 64)),
                  "--long-prompt-len",
                  str(ph.get("long_prompt_len", 256))]
+    if ph.get("trace_sample_rate") is not None:
+        argv += ["--trace-sample-rate", str(ph["trace_sample_rate"])]
     return loadgen.make_parser().parse_args(argv)
 
 
@@ -754,6 +817,10 @@ def _doctor_config(spec: dict) -> doctor.DoctorConfig:
         queue_min_depth=4,
         health_storm_n=int(spec.get("health_storm_n", 3)),
         straggler_skew_s=float(spec.get("straggler_skew_s", 60.0)),
+        queue_storm_s=float(spec.get("queue_storm_s", 0.75)),
+        queue_storm_n=int(spec.get("queue_storm_n", 4)),
+        page_stall_s=float(spec.get("page_stall_s", 0.25)),
+        page_stall_n=int(spec.get("page_stall_n", 2)),
         clear_after_s=1e9,  # one episode per (class, subject) per run
         slos=[],
     )
@@ -1058,6 +1125,9 @@ class ScenarioRun:
         if "timeline_require" in asserts:
             self.results.extend(
                 check_timeline(timeline, asserts["timeline_require"]))
+        if "request_trace" in asserts:
+            self.results.extend(
+                check_request_trace(timeline, asserts["request_trace"]))
         ckpt_spec = asserts.get("ckpt")
         if ckpt_spec is not None:
             seen = set()

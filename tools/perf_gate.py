@@ -36,6 +36,16 @@ environment's TPU plugin), tiny shapes, fixed seeds:
   decode_w8_step_ms      slot decode step over int8-quantized weights
                          (fused-dequant matmuls) — the --weight-dtype
                          int8 serving hot path
+  decode_step_traced_ms  the SAME slot decode step with the flight
+                         recorder armed and the ISSUE-17 request
+                         tracer emitting the engine's per-tick span
+                         pattern at the default sample rate — the
+                         tracing-overhead pin: gate_check scores it
+                         against the baseline's UNTRACED
+                         decode_step_slots_ms with a 5% allowance on
+                         top of that metric's noise band
+                         (regression:tracing_overhead), recompiles 0
+                         because it reuses the watched executable
   host_gap_fraction      exposed-host fraction of a pipelined
                          dispatch/fetch loop (the async engine core's
                          overlap contract, ISSUE 16) — unit "fraction",
@@ -141,6 +151,13 @@ MULTISLICE_TIMEOUT_ENV = "PERF_GATE_MULTISLICE_TIMEOUT_S"
 # The one dimensionless metric in the tier (ISSUE 16): per-pass values
 # are already fractions, so the ms scaling and rounding don't apply.
 HOST_GAP_METRIC = "host_gap_fraction"
+# Tracing-overhead pin (ISSUE 17): decode_step_traced_ms may exceed
+# the baseline's untraced decode_step_slots_ms by the untraced
+# metric's own noise band plus this allowance before the gate calls
+# it regression:tracing_overhead.
+TRACED_METRIC = "decode_step_traced_ms"
+UNTRACED_METRIC = "decode_step_slots_ms"
+TRACING_OVERHEAD_ALLOWED = 0.05
 
 EXIT_OK = 0
 EXIT_REGRESSION = 2
@@ -405,6 +422,91 @@ def _decode_bench(paged: bool):
 
     name = "decode_step_paged_ms" if paged else "decode_step_slots_ms"
     return name, measure, perturb
+
+
+def _decode_traced_bench():
+    """('decode_step_traced_ms'): the slot decode step with the flight
+    recorder ON and the request tracer (metrics/trace.py) at its
+    default sample rate, emitting the serving engines' per-tick span
+    pattern — one req/dispatch instant plus req/fetch and req/stream
+    b/e per slot per step, one slot force-sampled (direct ring emits),
+    the rest tail-buffered (the untraced-request bookkeeping cost).
+    Scored against the UNTRACED decode_step_slots_ms baseline with a
+    5% allowance (gate_check: regression:tracing_overhead). Reuses the
+    exact executable _decode_bench warmed (the jit cache is keyed on
+    cfg), so the recompile hard gate stays 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics import events, trace
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        _jitted_decode_step_slots,
+        init_slot_cache,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_slots, max_len = 4, 128
+    cache = init_slot_cache(cfg, n_slots, max_len)
+    step = _jitted_decode_step_slots(cfg)
+
+    def fresh_len():
+        return jnp.full((n_slots,), max_len // 4, jnp.int32)
+
+    cache = cache._replace(length=fresh_len())
+    toks = jnp.ones((n_slots,), jnp.int32)
+    active = jnp.ones((n_slots,), bool)
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        logits, cache = step(params, cache, toks, active)
+        float(jnp.sum(logits))
+    box = [cache, toks]
+
+    def measure(n_steps: int):
+        box[0] = box[0]._replace(length=fresh_len())
+        was_enabled = events.enabled()
+        events.enable(process_name="perf-gate")
+        tracer = trace.configure(
+            sample_rate=trace.DEFAULT_SAMPLE_RATE)
+        rids = list(range(1, n_slots + 1))
+        handles = {}
+        for j, rid in enumerate(rids):
+            # Slot 0 is forced into the sample (direct ring emission);
+            # the rest take the default-rate path (tail buffering) —
+            # both costs belong in the traced number.
+            handles[rid] = tracer.start(rid, force=(j == 0))
+        rec = RequestRecorder()
+        times = []
+        try:
+            for _ in range(n_steps):
+                t0 = time.monotonic()
+                last, box[0] = step(params, box[0], box[1], active)
+                box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                for rid in rids:
+                    h = trace.handle(rid)
+                    if h is not None:
+                        h.instant(trace.EV_DISPATCH, {"tick": 0},
+                                  ts=t0)
+                        h.begin(trace.SPAN_FETCH)
+                        h.end(trace.SPAN_FETCH)
+                        h.begin(trace.SPAN_STREAM)
+                        h.end(trace.SPAN_STREAM)
+                float(jnp.sum(last))
+                dt = time.monotonic() - t0
+                times.append(dt)
+                rec.observe_decode_step(dt)
+        finally:
+            for rid in rids:
+                tracer.finish(rid, "ok")
+            trace._reset_for_tests()
+            if not was_enabled:
+                events.disable()
+        return times, rec.pct_ms("decode_step")
+
+    return "decode_step_traced_ms", measure, None
 
 
 def _decode_spec_bench():
@@ -998,7 +1100,8 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
     # recent compile — so the injected off-shape perturb() attributes
     # as a dimension diff (4 -> 7), not a pytree-structure diff.
     benches = [_decode_w8_bench(), _train_bench(),
-               _decode_bench(paged=False), _decode_bench(paged=True),
+               _decode_bench(paged=False), _decode_traced_bench(),
+               _decode_bench(paged=True),
                _matmul_bench(), _prefill_cached_bench(),
                _decode_under_prefill_bench(), _ckpt_async_bench(),
                _decode_spec_bench(), _host_gap_bench()]
@@ -1093,6 +1196,32 @@ def tier_current_values(tier: dict) -> dict:
     return current
 
 
+def _tracing_overhead_check(baseline_metrics: dict, current: dict,
+                            band_scale: float, verdict: str,
+                            rows: list) -> str:
+    """ISSUE-17 cross-metric pin: the traced decode step (current run)
+    against the UNTRACED decode step's committed baseline. Allowed
+    drift = the untraced metric's learned noise band (scaled) plus the
+    5% tracing allowance; above that the tracing layer itself became a
+    serving regression. Appends its row either way; only escalates an
+    otherwise-ok verdict (a real decode regression stays the headline)."""
+    base = baseline_metrics.get(UNTRACED_METRIC)
+    traced = current.get(TRACED_METRIC)
+    if base is None or traced is None:
+        return verdict
+    band = base["band"] * band_scale + TRACING_OVERHEAD_ALLOWED
+    rel = traced / base["value"] - 1.0
+    regressed = rel > band
+    rows.append({"metric": "tracing_overhead",
+                 "baseline": base["value"],
+                 "current": round(float(traced), 4),
+                 "rel_change": round(rel, 4), "band": round(band, 4),
+                 "verdict": "regression" if regressed else "ok"})
+    if regressed and verdict == "ok":
+        return "regression:tracing_overhead"
+    return verdict
+
+
 def gate_check(tier: dict, baseline_path: str,
                band_scale: float | None = None,
                report_path: str = DEFAULT_REPORT) -> tuple[int, dict]:
@@ -1132,6 +1261,8 @@ def gate_check(tier: dict, baseline_path: str,
             baseline_metrics = {k: v for k, v in baseline_metrics.items()
                                 if k not in MULTISLICE_METRICS}
         verdict, rows = compare(baseline_metrics, current, band_scale)
+        verdict = _tracing_overhead_check(
+            baseline_metrics, current, band_scale, verdict, rows)
 
     report = {
         "kind": "perf_gate_report",

@@ -738,11 +738,158 @@ class EngineTickHostFence(Rule):
                        "a deliberate fence")
 
 
+class EventSpanHygiene(Rule):
+    id = "TPL011"
+    title = "unbalanced EventBus begin / unguarded tick emission"
+    rationale = (
+        "ISSUE 17 (request tracing): a B event with no matching E "
+        "leaves an open span that skews every duration stacked above "
+        "it in Perfetto, and trace_report's critical paths inherit the "
+        "lie. `EventBus.begin` must pair with `.end`/`.span` in the "
+        "same function (or the same class, for the __enter__/__exit__ "
+        "context-manager idiom in utils/profiling.py). Separately, the "
+        "engine tick callbacks are the latency floor the perf gate "
+        "pins: module-level `events.*` emission there builds args "
+        "dicts on every tick even when the recorder is off, so it must "
+        "sit under an `events.enabled()` guard (the per-request trace "
+        "path is exempt by construction — SpanHandle methods are "
+        "no-ops when unsampled and `trace.handle` is one dict get). "
+        "The bus's own delegation shims in metrics/events.py are the "
+        "implementation, not call sites, and are out of scope."
+    )
+    bad = ("from container_engine_accelerators_tpu.metrics import "
+           "events\n"
+           "def admit(bus):\n"
+           "    bus.begin('serve/admit', 'serve')\n"
+           "    work()\n"
+           "def _decode_tick(self):\n"
+           "    events.counter('serve/ticks', {'n': 1})\n")
+    good = ("from container_engine_accelerators_tpu.metrics import "
+            "events\n"
+            "def admit(bus):\n"
+            "    bus.begin('serve/admit', 'serve')\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        bus.end('serve/admit', 'serve')\n"
+            "def _decode_tick(self):\n"
+            "    if events.enabled():\n"
+            "        events.counter('serve/ticks', {'n': 1})\n")
+
+    _EMIT = ("instant", "counter", "begin", "end", "span",
+             "async_begin", "async_instant", "async_end")
+
+    def applies(self, relpath):
+        return not relpath.replace(os.sep, "/").endswith(
+            "metrics/events.py")
+
+    @staticmethod
+    def _bus_receiver(call: ast.Call) -> bool:
+        """True when the call's receiver looks like an EventBus —
+        `bus.begin`, `self._bus.begin`, `events.get_bus().begin`,
+        module-level `events.begin` — and NOT a trace SpanHandle
+        (`h.begin`), whose methods are no-ops when unsampled."""
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return False
+        recv = func.value
+        if isinstance(recv, ast.Call):
+            return _norm(call_name(recv) or "") == "get_bus"
+        rq = qualname(recv)
+        if rq is None:
+            return False
+        return _norm(rq).endswith("bus") or _norm(rq) == "events"
+
+    def _closes(self, scope) -> bool:
+        for call in (n for n in ast.walk(scope)
+                     if isinstance(n, ast.Call)):
+            if (self._bus_receiver(call)
+                    and call.func.attr in ("end", "span")):
+                return True
+        return False
+
+    @staticmethod
+    def _guarded(ctx, node) -> bool:
+        """Under an If/IfExp whose test mentions an `enabled` name, or
+        in a function with an early-return `enabled` guard clause."""
+        fn = None
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.If, ast.IfExp)) and fn is None:
+                for sub in ast.walk(anc.test):
+                    q = qualname(sub) if isinstance(
+                        sub, (ast.Name, ast.Attribute)) else None
+                    if q and "enabled" in _norm(q):
+                        return True
+            if isinstance(anc, _FUNC_NODES):
+                fn = fn or anc
+        if fn is None:
+            return False
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.If):
+                continue
+            mentions = any(
+                isinstance(s, (ast.Name, ast.Attribute))
+                and "enabled" in _norm(qualname(s) or "")
+                for s in ast.walk(stmt.test))
+            terminates = stmt.body and isinstance(
+                stmt.body[-1], (ast.Return, ast.Raise,
+                                ast.Continue, ast.Break))
+            if mentions and terminates:
+                return True
+        return False
+
+    def check(self, ctx):
+        # (a) bus.begin with no end/span in the same function — or, for
+        # the context-manager idiom, anywhere in the same class.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            begins = [c for c in _subtree_calls(node)
+                      if self._bus_receiver(c)
+                      and c.func.attr == "begin"]
+            if not begins or self._closes(node):
+                continue
+            cls = next((a for a in ctx.ancestors(node)
+                        if isinstance(a, ast.ClassDef)), None)
+            if cls is not None and self._closes(cls):
+                continue
+            for call in begins:
+                yield (call.lineno,
+                       f"EventBus.begin in '{node.name}' with no "
+                       "matching end/span in the function (or class): "
+                       "the open B event skews every span stacked "
+                       "above it in the merged trace")
+        # (b) module-level events.* emission in an engine tick callback
+        # without an events.enabled() guard.
+        for call in (n for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.Call)):
+            func = call.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "events"
+                    and func.attr in self._EMIT):
+                continue
+            fn = ctx.enclosing_function(call)
+            if not (isinstance(fn, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                    and "tick" in fn.name):
+                continue
+            if self._guarded(ctx, call):
+                continue
+            yield (call.lineno,
+                   f"events.{func.attr} in tick callback "
+                   f"'{fn.name}' without an events.enabled() guard: "
+                   "args dicts get built on every tick even with the "
+                   "recorder off; guard it or use the per-request "
+                   "trace.handle path")
+
+
 RULES: tuple[Rule, ...] = (
     BannedSimpleQueue(), HostSyncInHotLoop(), NonAtomicWrite(),
     WallClockDuration(), RawShardMap(), BlockingUnderLock(),
     NonDaemonThread(), UnwatchedJit(), SilentExceptSwallow(),
-    EngineTickHostFence(),
+    EngineTickHostFence(), EventSpanHygiene(),
 )
 
 

@@ -1,0 +1,386 @@
+"""trace_report — merge per-process EventBus streams into one Perfetto
+timeline and attribute each traced request's latency (ISSUE 17).
+
+Input: any mix of flight-recorder dumps (`--trace-dump` Chrome JSON),
+streamed EventBus JSONL (`--trace-jsonl`, the per-process files
+`JsonlWriter` appends), and stamped SSE logs. Sources are
+auto-classified by content, clock-anchored via each process's recorded
+`_now_anchor`, and merged (events.merge_traces) into one
+Perfetto-loadable Chrome trace.
+
+Output:
+  - `--out merged.json`: the merged trace, openable at ui.perfetto.dev
+    (every request's `req/*` spans share one async track keyed by its
+    request id, so a request that crossed the prefill pool and the
+    decode engine reads as one flow).
+  - stdout: a per-request TTFT/TPOT attribution table — the same
+    queue / prefill / page-stall / exposed-host / device decomposition
+    `RequestRecorder.host_phase_ms` gives in aggregate, reconstructed
+    per request from its span critical path. `--json` emits the table
+    machine-readable instead.
+  - per-source drop counts: a ring that wrapped or a tap that fell
+    behind means the merge is missing events — the report labels the
+    trace TRUNCATED rather than letting an incomplete timeline read as
+    a complete one.
+
+Usage:
+    python -m tools.trace_report /tmp/tr/*.jsonl --out /tmp/merged.json
+    python -m tools.trace_report serve.jsonl client.jsonl --json
+    python -m tools.trace_report merged-inputs/ --request 17
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from container_engine_accelerators_tpu.metrics import events, trace  # noqa: E402
+
+# Span names whose summed duration feeds each attribution column.
+_QUEUE = (trace.SPAN_QUEUE,)
+_PREFILL = (trace.SPAN_PREFILL_CHUNK,)
+_STALL = (trace.SPAN_PAGE_STALL,)
+_ALLOC = (trace.SPAN_PREFIX_LOOKUP, trace.SPAN_PAGE_ALLOC)
+_EXPOSED = (trace.SPAN_FETCH, trace.SPAN_STREAM)
+
+
+def classify_path(path: str) -> str:
+    """'dump' | 'jsonl' | 'sse' | 'unknown' by peeking at content, not
+    extension — chaos artifact dirs mix all three."""
+    try:
+        with open(path, errors="replace") as f:
+            head = f.read(4096).lstrip()
+    except OSError:
+        return "unknown"
+    if head.startswith("{"):
+        try:
+            first = json.loads(head.splitlines()[0])
+        except (json.JSONDecodeError, IndexError):
+            first = None
+        if isinstance(first, dict):
+            if first.get("kind") == "anchor" or "ph" in first:
+                return "jsonl"
+            if "token" in first or "done" in first or "req" in first:
+                return "sse"
+        if '"traceEvents"' in head:
+            return "dump"
+        # Multi-line JSON dump whose traceEvents key sits past 4 KiB.
+        try:
+            whole = events._load_json(path)
+        except Exception:
+            return "unknown"
+        return "dump" if "traceEvents" in whole else "unknown"
+    return "unknown"
+
+
+def collect_inputs(paths) -> dict:
+    """Expand directories and bucket every input by kind."""
+    out = {"dump": [], "jsonl": [], "sse": [], "unknown": []}
+    flat: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            flat.extend(sorted(
+                os.path.join(p, n) for n in os.listdir(p)))
+        else:
+            flat.append(p)
+    for p in flat:
+        out[classify_path(p)].append(p)
+    return out
+
+
+def validate_trace(merged: dict) -> list[str]:
+    """Structural validation of a merged Chrome trace: what Perfetto's
+    loader needs plus the per-track monotonicity tests pin. Returns a
+    list of problems (empty = valid)."""
+    problems = []
+    evs = merged.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents missing or not a list"]
+    last_ts: dict = {}
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            problems.append(f"event {i}: no ph")
+            continue
+        if ev["ph"] == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i} ({ev.get('name')}): no ts")
+            continue
+        if ts < 0:
+            problems.append(f"event {i} ({ev.get('name')}): ts < 0")
+        track = (ev.get("pid"), ev.get("tid"), ev.get("id"))
+        if ts < last_ts.get(track, ts):
+            problems.append(
+                f"event {i} ({ev.get('name')}): ts regressed on track "
+                f"{track}")
+        last_ts[track] = ts
+    return problems
+
+
+def _req_events(merged: dict):
+    """cat=='req' events grouped by request id, each list ts-sorted."""
+    by_rid: dict = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("cat") != "req" or ev.get("ph") == "M":
+            continue
+        rid = ev.get("id")
+        if rid is None:
+            continue
+        by_rid.setdefault(rid, []).append(ev)
+    for evs in by_rid.values():
+        evs.sort(key=lambda e: (e.get("ts", 0.0),
+                                0 if e.get("ph") == "b" else 1))
+    return by_rid
+
+
+def pair_spans(evs) -> list[dict]:
+    """Reconstruct [{name, t0, t1, args, open}] from b/e events on one
+    request's track. Unclosed spans (killed worker, stalled admission)
+    stay `open` with t1 = the track's last timestamp."""
+    stacks: dict = {}
+    out = []
+    t_last = evs[-1]["ts"] if evs else 0.0
+    for ev in evs:
+        name, ph = ev.get("name"), ev.get("ph")
+        if ph == "b":
+            stacks.setdefault(name, []).append(ev)
+        elif ph == "e":
+            open_ = stacks.get(name)
+            if open_:
+                b = open_.pop()
+                args = dict(b.get("args") or {})
+                args.update(ev.get("args") or {})
+                out.append({"name": name, "t0": b["ts"], "t1": ev["ts"],
+                            "args": args, "open": False})
+    for name, rem in stacks.items():
+        for b in rem:
+            out.append({"name": name, "t0": b["ts"], "t1": t_last,
+                        "args": dict(b.get("args") or {}), "open": True})
+    out.sort(key=lambda s: s["t0"])
+    return out
+
+
+def _sum_ms(spans, names) -> float:
+    return sum(s["t1"] - s["t0"] for s in spans
+               if s["name"] in names) / 1e3
+
+
+def attribute_request(rid, evs) -> dict:
+    """One request's critical-path decomposition from its span track.
+
+    TTFT = queue + prefill-compute + page-stall + the remainder
+    (scheduler gaps between chunks); TPOT decomposes into device time
+    (dispatch->fetch, from the fetch spans' tick_ms) and exposed host
+    time (fetch fences + stream fan-out actually on the critical path).
+    """
+    spans = pair_spans(evs)
+    instants = [e for e in evs if e.get("ph") == "n"]
+    t0 = evs[0]["ts"]
+    t_end = evs[-1]["ts"]
+
+    prefill_spans = [s for s in spans if s["name"] == trace.SPAN_PREFILL]
+    t_first_tok = (prefill_spans[0]["t1"] if prefill_spans
+                   and not prefill_spans[0]["open"] else None)
+    dispatches = [e for e in instants
+                  if e.get("name") == trace.EV_DISPATCH]
+    n_ticks = len(dispatches)
+
+    queue_ms = _sum_ms(spans, _QUEUE)
+    prefill_ms = _sum_ms(spans, _PREFILL)
+    stall_ms = _sum_ms(spans, _STALL)
+    alloc_ms = _sum_ms(spans, _ALLOC)
+    device_ms = 0.0
+    for s in spans:
+        if s["name"] == trace.SPAN_FETCH:
+            tick = (s["args"] or {}).get("tick_ms")
+            device_ms += (float(tick) if tick is not None
+                          else (s["t1"] - s["t0"]) / 1e3)
+    exposed_ms = _sum_ms(spans, _EXPOSED)
+
+    ttft_ms = (t_first_tok - t0) / 1e3 if t_first_tok is not None else None
+    decode_wall_ms = ((t_end - t_first_tok) / 1e3
+                      if t_first_tok is not None else None)
+    tpot_ms = (decode_wall_ms / max(n_ticks, 1)
+               if decode_wall_ms is not None and n_ticks else None)
+
+    tags = {}
+    for e in evs:
+        a = e.get("args") or {}
+        for k in ("tenant", "class"):
+            if k in a and k not in tags:
+                tags[k] = a[k]
+    why = [
+        (e.get("args") or {}).get("why") for e in instants
+        if e.get("name") == "req/tail_sampled"]
+    truncated = sum(
+        int((e.get("args") or {}).get("dropped", 0)) for e in instants
+        if e.get("name") == trace.EV_TRUNCATED)
+    restarts = [e["name"].split("/", 1)[1] for e in instants
+                if e.get("name") in (trace.EV_SUPERVISOR_RESTART,
+                                     trace.EV_POOL_RESTART)]
+    preempts = sum(1 for e in instants
+                   if e.get("name") == trace.EV_PREEMPT)
+
+    other_ttft = None
+    if ttft_ms is not None:
+        other_ttft = max(
+            ttft_ms - queue_ms - prefill_ms - stall_ms - alloc_ms, 0.0)
+    exposed_host_ms = None
+    if decode_wall_ms is not None:
+        exposed_host_ms = max(decode_wall_ms - device_ms, 0.0)
+
+    return {
+        "rid": rid, "tenant": tags.get("tenant"),
+        "class": tags.get("class"), "events": len(evs),
+        "ticks": n_ticks, "preempts": preempts, "restarts": restarts,
+        "tail_sampled": why[0] if why else None,
+        "truncated_events": truncated,
+        "ttft_ms": ttft_ms, "tpot_ms": tpot_ms,
+        "queue_ms": queue_ms, "prefill_ms": prefill_ms,
+        "page_stall_ms": stall_ms, "alloc_ms": alloc_ms,
+        "sched_gap_ms": other_ttft,
+        "device_ms": device_ms if t_first_tok is not None else None,
+        "exposed_host_ms": exposed_host_ms,
+        "spans": spans,
+    }
+
+
+def build_report(merged: dict) -> dict:
+    by_rid = _req_events(merged)
+    rows = [attribute_request(rid, evs)
+            for rid, evs in sorted(by_rid.items(),
+                                   key=lambda kv: str(kv[0]))]
+    sources = (merged.get("otherData") or {}).get("sources", [])
+    dropped = sum(int(s.get("dropped") or 0) for s in sources)
+    truncated = dropped > 0 or any(r["truncated_events"] for r in rows)
+    return {"requests": rows, "sources": sources,
+            "events_dropped_total": dropped, "truncated": truncated,
+            "problems": validate_trace(merged)}
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def print_report(report: dict, file=sys.stdout) -> None:
+    cols = ("rid", "tenant", "class", "ticks", "ttft_ms", "tpot_ms",
+            "queue_ms", "prefill_ms", "page_stall_ms", "device_ms",
+            "exposed_host_ms")
+    rows = report["requests"]
+    table = [[_fmt(r.get(c)) for c in cols] for r in rows]
+    widths = [max(len(c), *(len(row[i]) for row in table))
+              if table else len(c) for i, c in enumerate(cols)]
+    print("  ".join(c.rjust(w) for c, w in zip(cols, widths)),
+          file=file)
+    for r, row in zip(rows, table):
+        print("  ".join(v.rjust(w) for v, w in zip(row, widths)),
+              file=file)
+        notes = []
+        if r["preempts"]:
+            notes.append(f"preempted x{r['preempts']}")
+        notes.extend(r["restarts"])
+        if r["tail_sampled"]:
+            notes.append(f"tail-sampled ({r['tail_sampled']})")
+        if r["truncated_events"]:
+            notes.append(
+                f"trace truncated ({r['truncated_events']} events "
+                "lost to the tail buffer)")
+        if notes:
+            print(" " * widths[0] + "  ^ " + ", ".join(notes),
+                  file=file)
+    print(file=file)
+    for s in report["sources"]:
+        line = (f"source {s.get('kind')}: {s.get('path')} "
+                f"({s.get('events', 0)} events, pid {s.get('pid')})")
+        if s.get("skipped"):
+            line += f" SKIPPED: {s['skipped']}"
+        if s.get("dropped"):
+            line += f" DROPPED {s['dropped']} events"
+        print(line, file=file)
+    if report["truncated"]:
+        print(f"WARNING: TRACE TRUNCATED — "
+              f"{report['events_dropped_total']} events dropped at the "
+              "source(s); timings above may under-count", file=file)
+    if report["problems"]:
+        print(f"INVALID TRACE: {len(report['problems'])} problems, "
+              f"first: {report['problems'][0]}", file=file)
+
+
+def print_request(report: dict, rid, file=sys.stdout) -> None:
+    """Single-request critical path: the ordered span timeline."""
+    for r in report["requests"]:
+        if str(r["rid"]) != str(rid):
+            continue
+        print(f"request {rid} — {r['events']} events, "
+              f"ttft={_fmt(r['ttft_ms'])}ms "
+              f"tpot={_fmt(r['tpot_ms'], 3)}ms", file=file)
+        for s in r["spans"]:
+            state = " (OPEN)" if s["open"] else ""
+            print(f"  {s['t0'] / 1e3:10.3f}ms  "
+                  f"{(s['t1'] - s['t0']) / 1e3:9.3f}ms  "
+                  f"{s['name']}{state}  {s['args'] or ''}", file=file)
+        return
+    print(f"request {rid}: no req/* events in the merge", file=file)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge EventBus streams; per-request attribution")
+    p.add_argument("paths", nargs="+",
+                   help="trace dumps (.json), EventBus JSONL streams, "
+                        "SSE logs, or directories of them")
+    p.add_argument("--out", default=None,
+                   help="write the merged Perfetto-loadable trace here")
+    p.add_argument("--json", action="store_true",
+                   help="emit the attribution report as JSON on stdout "
+                        "(spans omitted) instead of the table")
+    p.add_argument("--request", default=None,
+                   help="print one request's ordered span critical "
+                        "path instead of the table")
+    args = p.parse_args(argv)
+
+    inputs = collect_inputs(args.paths)
+    for path in inputs["unknown"]:
+        print(f"warning: cannot classify {path}; skipped",
+              file=sys.stderr)
+    if args.out:
+        merged = events.write_merged(
+            args.out, dump_paths=inputs["dump"],
+            sse_log_paths=inputs["sse"],
+            event_jsonl_paths=inputs["jsonl"])
+    else:
+        merged = events.merge_traces(
+            dump_paths=inputs["dump"], sse_log_paths=inputs["sse"],
+            event_jsonl_paths=inputs["jsonl"])
+
+    report = build_report(merged)
+    if args.json:
+        slim = dict(report)
+        slim["requests"] = [
+            {k: v for k, v in r.items() if k != "spans"}
+            for r in report["requests"]]
+        json.dump(slim, sys.stdout, indent=2, default=str)
+        print()
+    elif args.request is not None:
+        print_request(report, args.request)
+    else:
+        print_report(report)
+    if args.out:
+        print(f"merged trace -> {args.out} "
+              f"(open at https://ui.perfetto.dev)", file=sys.stderr)
+    return 2 if report["problems"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
