@@ -236,11 +236,24 @@ spec-smoke:
 async-core-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_async_core.py -q
 
+# Fleet telemetry smoke (ISSUE 18): FleetState staleness/transition
+# units, torn-scrape tolerance (incl. the SIGKILL-mid-scrape
+# regression), aggregate rollup math, the three fleet doctor detectors
+# (replica_down / fleet_imbalance / fleet_slo_burn) with dedup, the
+# scraper against live in-process exporters, and the slow-tier e2e:
+# cli/fleet.py launching two real replicas, loadgen --targets fanning
+# out over both, fleetmon converging on up=2, and trace_report merging
+# the two replicas into one timeline with distinct per-replica tracks.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q \
+	    -m "slow or not slow"
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
     serve-pools-smoke multislice-smoke dcn-overlap-smoke \
-    preemption-smoke spec-smoke async-core-smoke chaos-smoke
+    preemption-smoke spec-smoke async-core-smoke fleet-smoke \
+    chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -256,4 +269,4 @@ clean:
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
     dcn-overlap-smoke preemption-smoke spec-smoke async-core-smoke \
-    smoke dryrun clean
+    fleet-smoke smoke dryrun clean

@@ -55,6 +55,13 @@ attribution table by tenant and request class. Server-side tail
 sampling still captures failed/preempted/SLO-violating requests
 regardless of this rate.
 
+Fleet fan-out (ISSUE 18): `--targets a,b,c` round-robins request i
+onto replica i % N (one loadgen driving every replica of a
+cli/fleet.py launch) and adds a per-target outcome/latency block to
+the summary — a dead replica concentrates its transport errors on one
+url while the survivors stay clean, which is exactly what the
+replica-kill chaos scenario asserts.
+
 Prints ONE human line per percentile block, an `SLO PASS|FAIL` line
 when gating, an outcome line when anything failed, plus a final JSON
 summary line (machine-consumable, mirrors bench.py's one-line
@@ -204,6 +211,18 @@ def run(args) -> tuple[dict, int]:
     """Drive the load and return (summary, exit_code) — the in-process
     entry the chaos harness (tools/chaos.py) consumes; main() wraps it
     for the CLI."""
+    # Fleet fan-out (ISSUE 18): --targets a,b,c round-robins request i
+    # onto target i % N, so one loadgen drives every replica of a
+    # cli/fleet.py launch; attribution stays deterministic from the
+    # request index even when the request itself dies in transport.
+    targets = None
+    if getattr(args, "targets", None):
+        targets = [t.strip() for t in args.targets.split(",")
+                   if t.strip()]
+
+    def target_for(i: int) -> str:
+        return targets[i % len(targets)] if targets else args.url
+
     def req_i(i: int) -> dict:
         if args.tenants:
             tenant, tokens = tenant_tokens(args, i)
@@ -216,7 +235,7 @@ def run(args) -> tuple[dict, int]:
                 and head_sampled(i, args.trace_sample_rate)):
             trace_tags = {"tenant": tenant,
                           "class": tenant_class(tenant)}
-        r = one_request(args.url, tokens, args.max_new_tokens,
+        r = one_request(target_for(i), tokens, args.max_new_tokens,
                         args.stream, args.timeout,
                         stall_timeout=args.stall_timeout_s,
                         trace_tags=trace_tags)
@@ -226,23 +245,42 @@ def run(args) -> tuple[dict, int]:
     t0 = time.perf_counter()
     results = []
     structured_errors = hung_streams = transport_errors = 0
+    per_target: dict[str, dict] = {
+        url: {"requests_ok": 0, "structured_errors": 0,
+              "hung_streams": 0, "transport_errors": 0,
+              "latencies": []}
+        for url in (targets or [])}
+
+    def tally(i: int, key: str) -> None:
+        if targets:
+            per_target[target_for(i)][key] += 1
+
     with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
-        for fut in [ex.submit(req_i, i) for i in range(args.requests)]:
+        futs = [(i, ex.submit(req_i, i)) for i in range(args.requests)]
+        for i, fut in futs:
             try:
                 r = fut.result()
             except StreamStalled as e:
                 hung_streams += 1
-                print(f"request HUNG: {e}")
+                tally(i, "hung_streams")
+                print(f"request HUNG ({target_for(i)}): {e}")
                 continue
             except Exception as e:
                 transport_errors += 1
-                print(f"request failed (transport): {e}")
+                tally(i, "transport_errors")
+                print(f"request failed (transport, {target_for(i)}): "
+                      f"{e}")
                 continue
             if r["outcome"] == "structured_error":
                 structured_errors += 1
+                tally(i, "structured_errors")
                 print(f"request failed (structured): {r['error']}")
             else:
                 results.append(r)
+                tally(i, "requests_ok")
+                if targets:
+                    per_target[target_for(i)]["latencies"].append(
+                        r["latency"])
     wall = time.perf_counter() - t0
     errors = structured_errors + hung_streams + transport_errors
 
@@ -264,6 +302,25 @@ def run(args) -> tuple[dict, int]:
         "tokens_per_sec": round(
             sum(r["tokens"] for r in results) / wall, 1),
     }
+    if targets:
+        # Per-target verdicts: a dead replica shows up as transport
+        # errors concentrated on ONE url while the survivors stay
+        # clean — the split the replica-kill chaos scenario asserts.
+        tblock = {}
+        for url, t in per_target.items():
+            entry = {k: t[k] for k in
+                     ("requests_ok", "structured_errors",
+                      "hung_streams", "transport_errors")}
+            entry["latency_ms"] = {
+                k: round(v * 1e3, 1) for k, v in
+                percentiles(t["latencies"]).items()}
+            tblock[url] = entry
+            print(f"target {url}: ok={entry['requests_ok']} "
+                  f"structured={entry['structured_errors']} "
+                  f"hung={entry['hung_streams']} "
+                  f"transport={entry['transport_errors']} "
+                  f"latency_p99={entry['latency_ms']['p99']}ms")
+        summary["targets"] = tblock
     slo_violated = False
     if args.stream:
         ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
@@ -343,6 +400,12 @@ def run(args) -> tuple[dict, int]:
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--targets", default=None,
+                   help="comma-separated replica base URLs: request i "
+                        "goes to target i %% N (round-robin fan-out "
+                        "over a cli/fleet.py launch); the summary "
+                        "gains a per-target outcome/latency block and "
+                        "--url is ignored")
     p.add_argument("--requests", type=int, default=50)
     p.add_argument("--concurrency", type=int, default=4,
                    help="in-flight requests (exercises the continuous "

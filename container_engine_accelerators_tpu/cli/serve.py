@@ -44,6 +44,7 @@ import contextlib
 import itertools
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -2274,7 +2275,8 @@ class EngineSupervisor:
                                 "backoff_s": round(delay, 3)})
 
 
-def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
+def make_server(engine: BatchingEngine, port: int,
+                replica_id: str | None = None) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
             pass
@@ -2292,6 +2294,7 @@ def make_server(engine: BatchingEngine, port: int) -> ThreadingHTTPServer:
                 alive_fn = getattr(engine, "prefill_workers_alive", None)
                 return self._send({
                     "ok": True,
+                    "replica_id": replica_id,
                     "batches": engine.batches_run,
                     "requests": engine.requests_served,
                     # Worker liveness: a dead worker with a green
@@ -2477,6 +2480,14 @@ def main(argv=None) -> int:
     p.add_argument("--draft-layers", type=int, default=2,
                    help="--speculate draft: layers in the truncated "
                         "self-draft model")
+    p.add_argument("--replica-id", default=None,
+                   help="fleet replica identity (ISSUE 18): stamped "
+                        "into the EventBus anchor and process track "
+                        "name, every request trace span, the "
+                        "serve_replica_info metric and /healthz, so "
+                        "N replicas' dumps merge into distinct "
+                        "per-replica timeline tracks. Default: the "
+                        "TPU_REPLICA_ID env var, else pid-<pid>")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve request-lifecycle Prometheus metrics "
                         "(TTFT/TPOT/queue-wait histograms, slot and KV "
@@ -2557,6 +2568,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
+    replica_id = (args.replica_id or os.environ.get("TPU_REPLICA_ID")
+                  or f"pid-{os.getpid()}")
     if args.trace_dump:
         events.enable(dump_path=args.trace_dump, signals=True,
                       process_name="serve")
@@ -2564,13 +2577,17 @@ def main(argv=None) -> int:
                  "on demand)", args.trace_dump)
     else:
         events.configure_from_env(process_name="serve")
+    # After enable(): enable re-anchors the bus, and the replica stamp
+    # must land on the POST-re-anchor anchor.
+    events.set_replica_id(replica_id)
     if args.trace_jsonl:
         events.stream_jsonl(args.trace_jsonl)
         log.info("streaming EventBus JSONL -> %s", args.trace_jsonl)
     # The tracer is always configured: with the bus disabled start()
     # returns None and the request path stays span-free; arming the bus
     # later (--doctor, SIGUSR2 flows) picks the sample rate up as-is.
-    trace.configure(sample_rate=args.trace_sample_rate)
+    trace.configure(sample_rate=args.trace_sample_rate,
+                    base_tags={"replica": replica_id})
 
     from container_engine_accelerators_tpu.models.convert import load_model
 
@@ -2622,6 +2639,16 @@ def main(argv=None) -> int:
         log.info("tensor-parallel over %d chips", args.tp)
 
     recorder = RequestRecorder()
+    # Replica identity on the scrape surface as an info-style gauge:
+    # ONE labeled family carrying the id, rather than a replica label
+    # on every serve_* family — existing unlabeled-scrape consumers
+    # (tools/chaos.py parse_gauge, serve_bench) keep working, and the
+    # fleet exporter owns the per-replica label space.
+    from prometheus_client import Gauge as _Gauge
+    _Gauge("serve_replica_info",
+           "Constant 1; the replica_id label names this replica",
+           ["replica_id"],
+           registry=recorder.registry).labels(replica_id).set(1)
     spec_kw = dict(speculate=args.speculate, spec_k=args.spec_k,
                    draft_layers=args.draft_layers,
                    engine_core=args.engine_core)
@@ -2690,9 +2717,31 @@ def main(argv=None) -> int:
     if args.metrics_port is not None:
         exporter = ServeMetricsExporter(recorder, port=args.metrics_port,
                                         host=args.metrics_host)
+
+        def _state_snapshot(engine=engine, recorder=recorder,
+                            rid=replica_id, engine_kind=args.engine):
+            """/debugz?state=1: the fleet scraper's machine-readable
+            snapshot — recorder state plus engine liveness."""
+            snap = recorder.state_snapshot()
+            alive_fn = getattr(engine, "prefill_workers_alive", None)
+            snap.update({
+                "replica_id": rid,
+                "pid": os.getpid(),
+                "engine": engine_kind,
+                "worker_alive": engine.thread.is_alive(),
+                "worker_restarts": engine.worker_restarts,
+                "requests_served": engine.requests_served,
+                "batches_run": engine.batches_run,
+                "prefill_workers": getattr(engine, "prefill_workers", 0),
+                "prefill_workers_alive": (alive_fn() if alive_fn
+                                          else 0),
+            })
+            return snap
+
+        exporter.state_provider = _state_snapshot
         exporter.start_background()
         log.info("request metrics on :%d/metrics", exporter.bound_port)
-    server = make_server(engine, args.port)
+    server = make_server(engine, args.port, replica_id=replica_id)
     log.info("serving on :%d (/generate, /healthz)", args.port)
     # TPU_PROFILE_DIR set -> the whole serving session is one xplane
     # trace whose serve/* annotations line up with the request metrics;

@@ -154,6 +154,12 @@ class DoctorConfig:
     # pages) longer than page_stall_s in the fast window.
     page_stall_s: float = 0.25
     page_stall_n: int = 2
+    # fleet_imbalance (metrics/fleet.py): sustained cross-replica skew
+    # bands — queue-depth gap, KV-headroom fraction gap, and the
+    # per-replica sample floor before either comparison is trusted.
+    fleet_imbalance_queue: float = 6.0
+    fleet_imbalance_headroom_frac: float = 0.5
+    fleet_imbalance_min_samples: int = 4
     # Incident episode hygiene: a quiet condition re-arms after this.
     clear_after_s: float = 30.0
     slos: list = dataclasses.field(default_factory=default_slos)
@@ -717,11 +723,17 @@ class PageStallDetector(Detector):
 
 
 def default_detectors() -> list[Detector]:
+    # Lazy import: fleet.py imports Detector/Finding from this module
+    # at its top, so the fleet registry slice must load inside the
+    # function body. The fleet detectors read only the fleet/* event
+    # namespace and stay quiet in any process without a FleetScraper.
+    from container_engine_accelerators_tpu.metrics import fleet
+
     return [EngineHangDetector(), RecompileStormDetector(),
             OomPrecursorDetector(), QueueCollapseDetector(),
             StragglerDetector(), HealthStormDetector(),
             SloBurnDetector(), QueueStormDetector(),
-            PageStallDetector()]
+            PageStallDetector(), *fleet.fleet_detectors()]
 
 
 # ---------- detector helpers ----------

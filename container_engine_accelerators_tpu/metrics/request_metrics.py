@@ -67,6 +67,14 @@ SAMPLE_KINDS = ("ttft", "tpot", "queue_wait", "prefill", "decode_step")
 # exposed host time to wall time is `host_gap_fraction`.
 HOST_PHASES = ("admit", "schedule", "sample", "stream", "fetch")
 
+# Rolling SLO window published by state_snapshot() for the fleet
+# scraper (ISSUE 18). The thresholds mirror doctor.default_slos()
+# (ttft_p99 2.0s, tpot_p99 0.25s) but stay local constants: the
+# snapshot path must not import the detector stack.
+STATE_SLO_WINDOW_S = 60.0
+STATE_SLO_TTFT_S = 2.0
+STATE_SLO_TPOT_S = 0.25
+
 
 def percentile(xs, p):
     """Nearest-rank percentile (inclusive): the smallest sample with at
@@ -254,6 +262,13 @@ class RequestRecorder:
         self._spec_committed = 0
         self._prefix_lookups = 0
         self._prefix_hits = 0
+        # Shadow copies of the occupancy gauges (prometheus Gauges are
+        # write-only from here), so state_snapshot() can publish them
+        # machine-readably for the fleet scraper (ISSUE 18).
+        self._last_slots = (0, 0)
+        self._last_kv = (0, 0)
+        self._last_pools = (0, 0)
+        self._last_prefix_pages = 0
 
     # ---------- lifecycle edges ----------
 
@@ -407,6 +422,7 @@ class RequestRecorder:
     # ---------- occupancy gauges (set by the worker loop) ----------
 
     def set_slots(self, active: int, total: int) -> None:
+        self._last_slots = (active, total)
         self.active_slots.set(active)
         self.slots_total.set(total)
         if events.enabled():
@@ -414,6 +430,7 @@ class RequestRecorder:
                                            "total": total})
 
     def set_kv_pages(self, used: int, total: int) -> None:
+        self._last_kv = (used, total)
         self.kv_pages_in_use.set(used)
         self.kv_pages_total.set(total)
         if events.enabled():
@@ -421,12 +438,14 @@ class RequestRecorder:
                                               "total": total})
 
     def set_prefix_cache_pages(self, pages: int) -> None:
+        self._last_prefix_pages = pages
         self.prefix_cache_pages.set(pages)
 
     def set_pool_depths(self, prefill: int, decode: int) -> None:
         """Per-pool depth gauges (disaggregated layout); the twin
         flight-recorder counter is what the doctor's two-queue
         queue_collapse detector reads (metrics/doctor.py)."""
+        self._last_pools = (prefill, decode)
         self.pool_queue_depth.labels(pool="prefill").set(prefill)
         self.pool_queue_depth.labels(pool="decode").set(decode)
         if events.enabled():
@@ -554,6 +573,54 @@ class RequestRecorder:
         if threshold is None:
             return len(pts), 0
         return len(pts), sum(1 for v in pts if v > threshold)
+
+    # ---------- fleet state snapshot (ISSUE 18) ----------
+
+    def state_snapshot(self, now: float | None = None) -> dict:
+        """Machine-readable engine-state snapshot for the fleet
+        scraper, served on /debugz?state=1 (metrics/serving.py
+        `state_provider`): the routing inputs (queue depth, KV-page
+        headroom, prefix hit rate) plus the rolling SLO windows the
+        fleet_slo_burn detector aggregates across replicas. The SLO
+        thresholds mirror doctor.default_slos() without importing it —
+        a jax-free scrape consumer must not pull the detector stack
+        into every serve process's snapshot path."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            queued = self._queued
+            active, total = self._last_slots
+            kv_used, kv_total = self._last_kv
+            prefill_d, decode_d = self._last_pools
+            prefix_pages = self._last_prefix_pages
+            lookups, hits = self._prefix_lookups, self._prefix_hits
+        since = now - STATE_SLO_WINDOW_S
+        ttft_n, ttft_bad = self.window_counts("ttft", since,
+                                              STATE_SLO_TTFT_S)
+        tpot_n, tpot_bad = self.window_counts("tpot", since,
+                                              STATE_SLO_TPOT_S)
+        return {
+            # tpulint: allow=TPL004(epoch stamp for cross-process
+            # alignment, not a duration)
+            "t": round(time.time(), 3),
+            "ts_monotonic": round(now, 6),
+            "queued": queued,
+            "slots": {"active": active, "total": total},
+            "kv_pages": {"used": kv_used, "total": kv_total,
+                         "headroom": max(kv_total - kv_used, 0)},
+            "prefix_cache": {"lookups": lookups, "hits": hits,
+                             "hit_rate": (hits / lookups
+                                          if lookups else None),
+                             "pages": prefix_pages},
+            "pool_depth": {"prefill": prefill_d, "decode": decode_d},
+            "host_gap_fraction": self.host_gap(),
+            "slo_windows": {
+                "window_s": STATE_SLO_WINDOW_S,
+                "ttft": {"n": ttft_n, "bad": ttft_bad,
+                         "threshold_s": STATE_SLO_TTFT_S},
+                "tpot": {"n": tpot_n, "bad": tpot_bad,
+                         "threshold_s": STATE_SLO_TPOT_S},
+            },
+        }
 
 
 class ServeMetricsExporter(ExporterBase):

@@ -130,6 +130,22 @@ class ExporterBase:
                     d = doctor.get_active()
                     payload["doctor"] = (d.debugz() if d is not None
                                          else {"active": False})
+                if qs.get("state", ["0"])[0] not in ("", "0"):
+                    # Machine-readable engine state snapshot (ISSUE
+                    # 18): the fleet scraper's structured half of the
+                    # scrape. Exporters opt in by setting a
+                    # `state_provider` callable (cli/serve.py wires
+                    # the recorder+engine snapshot; cli/fleetmon.py
+                    # wires the FleetState table).
+                    provider = getattr(self, "state_provider", None)
+                    if provider is not None:
+                        try:
+                            payload["state"] = provider()
+                        except Exception:
+                            log.exception("/debugz state provider "
+                                          "failed")
+                            payload["state"] = {
+                                "error": "state provider failed"}
                 body = json.dumps(payload).encode()
                 start_response("200 OK", [
                     ("Content-Type", "application/json"),

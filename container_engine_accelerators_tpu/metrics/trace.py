@@ -210,12 +210,18 @@ class RequestTracer:
     def __init__(self, sample_rate: float = DEFAULT_SAMPLE_RATE,
                  slo_ttft_ms=None, slo_tpot_ms=None,
                  tail_events: int = DEFAULT_TAIL_EVENTS,
-                 max_tail_requests: int = DEFAULT_TAIL_REQUESTS):
+                 max_tail_requests: int = DEFAULT_TAIL_REQUESTS,
+                 base_tags=None):
         self.sample_rate = sample_rate
         self.slo_ttft_ms = slo_ttft_ms
         self.slo_tpot_ms = slo_tpot_ms
         self.tail_events = tail_events
         self.max_tail_requests = max_tail_requests
+        # Process-level tags stamped into every span of every traced
+        # request (ISSUE 18: serve stamps {"replica": rid} so merged
+        # multi-replica timelines filter spans by replica). Per-request
+        # tags override on key collision.
+        self.base_tags = dict(base_tags) if base_tags else None
         self._handles: dict = {}
         self._lock = threading.Lock()
         self.started = 0
@@ -229,6 +235,9 @@ class RequestTracer:
         recorder; no recorder, no spans."""
         if not events.enabled():
             return None
+        if self.base_tags:
+            tags = {**self.base_tags, **tags} if tags \
+                else dict(self.base_tags)
         with self._lock:
             h = self._handles.get(rid)
             if h is not None:
@@ -287,14 +296,15 @@ _TRACER: RequestTracer | None = None
 
 def configure(sample_rate: float = DEFAULT_SAMPLE_RATE, slo_ttft_ms=None,
               slo_tpot_ms=None, tail_events: int = DEFAULT_TAIL_EVENTS,
-              max_tail_requests: int = DEFAULT_TAIL_REQUESTS
-              ) -> RequestTracer:
+              max_tail_requests: int = DEFAULT_TAIL_REQUESTS,
+              base_tags=None) -> RequestTracer:
     global _TRACER
     _TRACER = RequestTracer(sample_rate=sample_rate,
                             slo_ttft_ms=slo_ttft_ms,
                             slo_tpot_ms=slo_tpot_ms,
                             tail_events=tail_events,
-                            max_tail_requests=max_tail_requests)
+                            max_tail_requests=max_tail_requests,
+                            base_tags=base_tags)
     return _TRACER
 
 

@@ -1,0 +1,583 @@
+"""Fleet telemetry plane (ISSUE 18): FleetState staleness/transition
+machinery, torn-scrape tolerance, aggregate rollup math, the fleet
+doctor detectors (fire on bad, quiet on good, one incident per
+episode), the scraper surviving a replica SIGKILLed mid-scrape, and
+the slow-tier e2e — cli/fleet.py launching two real replicas, loadgen
+fanning out over both, fleetmon converging on up=2, and trace_report
+merging the two replicas into one valid timeline with distinct
+per-replica track groups."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from container_engine_accelerators_tpu.cli import loadgen
+from container_engine_accelerators_tpu.metrics import doctor, events
+from container_engine_accelerators_tpu.metrics import fleet
+from container_engine_accelerators_tpu.metrics.doctor import (
+    Doctor,
+    DoctorConfig,
+    Signals,
+    SloSpec,
+)
+from container_engine_accelerators_tpu.metrics.fleet import (
+    FleetExporter,
+    FleetScraper,
+    FleetState,
+    ScrapeError,
+    parse_metrics_text,
+)
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    def reset():
+        events._reset_for_tests()
+        doctor.set_active(None)
+    reset()
+    yield
+    reset()
+
+
+# ---------- synthetic event helpers (test_doctor.py idiom) ----------
+
+def C(name, ts, **vals):
+    return {"name": name, "cat": "", "ph": "C", "ts": ts,
+            "args": vals, "id": None}
+
+
+def I(name, ts, **args):
+    return {"name": name, "cat": "", "ph": "i", "ts": ts,
+            "args": args, "id": None}
+
+
+def fleet_cfg(**kw):
+    defaults = dict(
+        poll_interval_s=1.0, fast_window_s=10.0, slow_window_s=50.0,
+        clear_after_s=5.0, slos=[],
+        fleet_imbalance_queue=3.0, fleet_imbalance_min_samples=3)
+    defaults.update(kw)
+    return DoctorConfig(**defaults)
+
+
+def sig(evs, now, cfg=None, **kw):
+    return Signals(now, sorted(evs, key=lambda e: e["ts"]),
+                   cfg or fleet_cfg(), live=False, **kw)
+
+
+def up_sample(rid, ts, queued=0.0, active=0.0, kv_free=8.0,
+              kv_total=8.0, requests=0.0):
+    return C(f"fleet/replica/{rid}", ts, state=2, queued=queued,
+             active=active, kv_free=kv_free, kv_total=kv_total,
+             requests=requests, restarts=0.0, worker_alive=1.0)
+
+
+def down_sample(rid, ts):
+    return C(f"fleet/replica/{rid}", ts, state=0, queued=0.0,
+             active=0.0, kv_free=0.0, kv_total=0.0, requests=0.0,
+             restarts=0.0, worker_alive=0.0)
+
+
+# ---------- /metrics parsing: torn-scrape tolerance ----------
+
+def test_parse_metrics_text_unlabelled_families():
+    text = ("# HELP serve_queue_depth q\n"
+            "# TYPE serve_queue_depth gauge\n"
+            "serve_queue_depth 3.0\n"
+            'serve_requests_total{outcome="ok"} 7.0\n'
+            "serve_kv_pages_in_use 5.0\n")
+    out = parse_metrics_text(text, required=("serve_queue_depth",))
+    assert out["serve_queue_depth"] == 3.0
+    assert out["serve_kv_pages_in_use"] == 5.0
+    # Labeled samples are skipped, never mis-parsed.
+    assert "serve_requests_total" not in out
+
+
+def test_parse_metrics_text_rejects_torn_bodies():
+    with pytest.raises(ScrapeError):
+        parse_metrics_text("")
+    # Cut mid-line: a complete exposition always ends with a newline.
+    with pytest.raises(ScrapeError):
+        parse_metrics_text("serve_queue_depth 3.0\nserve_kv_pa")
+    # Complete-looking body from a half-initialized replica missing a
+    # family every healthy serve exporter carries.
+    with pytest.raises(ScrapeError):
+        parse_metrics_text("some_other_family 1.0\n",
+                           required=("serve_queue_depth",))
+
+
+# ---------- FleetState transitions ----------
+
+def test_replica_degrades_stale_then_down():
+    st = FleetState(down_after_s=5.0)
+    prev, cur = st.observe_ok("r0", "http://x", {"queued": 2}, {},
+                              now=100.0)
+    assert (prev, cur) == (fleet.STATE_STALE, fleet.STATE_UP)
+    prev, cur = st.observe_failure("r0", "http://x", "refused",
+                                   now=101.0)
+    assert (prev, cur) == (fleet.STATE_UP, fleet.STATE_STALE)
+    # Still inside the grace window: stays stale.
+    prev, cur = st.observe_failure("r0", "http://x", "refused",
+                                   now=104.0)
+    assert cur == fleet.STATE_STALE
+    prev, cur = st.observe_failure("r0", "http://x", "refused",
+                                   now=105.0)
+    assert (prev, cur) == (fleet.STATE_STALE, fleet.STATE_DOWN)
+    r = st.replicas()[0]
+    assert r.consecutive_failures == 3
+    assert r.transitions == 3  # stale->up, up->stale, stale->down
+    # The last good snapshot is retained for the post-mortem.
+    assert r.snapshot == {"queued": 2}
+
+
+def test_never_scraped_replica_goes_down_from_first_seen():
+    st = FleetState(down_after_s=2.0)
+    _, cur = st.observe_failure("r0", "http://x", "refused", now=10.0)
+    assert cur == fleet.STATE_STALE
+    _, cur = st.observe_failure("r0", "http://x", "refused", now=12.5)
+    assert cur == fleet.STATE_DOWN
+
+
+def test_recovery_and_remove_bump_version():
+    st = FleetState(down_after_s=1.0)
+    st.observe_failure("r0", "http://x", "refused", now=0.0)
+    st.observe_failure("r0", "http://x", "refused", now=2.0)
+    v = st.version
+    prev, cur = st.observe_ok("r0", "http://x", {}, {}, now=3.0)
+    assert (prev, cur) == (fleet.STATE_DOWN, fleet.STATE_UP)
+    assert st.version == v + 1
+    st.remove("r0")
+    assert st.replicas() == []
+    assert st.version == v + 2
+
+
+# ---------- aggregate math ----------
+
+def test_aggregates_sum_up_replicas_only():
+    st = FleetState(down_after_s=1.0)
+    st.observe_ok("r0", "u0", {
+        "queued": 2, "kv_pages": {"used": 3, "total": 8},
+        "prefix_cache": {"lookups": 10, "hits": 9},
+        "slo_windows": {"ttft": {"n": 5, "bad": 1},
+                        "tpot": {"n": 50, "bad": 0}}}, {}, now=0.0)
+    st.observe_ok("r1", "u1", {
+        "queued": 1, "kv_pages": {"used": 6, "total": 8},
+        "prefix_cache": {"lookups": 0, "hits": 0},
+        "slo_windows": {"ttft": {"n": 3, "bad": 0},
+                        "tpot": {"n": 30, "bad": 3}}}, {}, now=0.0)
+    st.observe_ok("r2", "u2", {"queued": 50,
+                               "kv_pages": {"used": 8, "total": 8}},
+                  {}, now=0.0)
+    st.observe_failure("r2", "u2", "reset", now=5.0)  # down
+    agg = st.aggregates(now=5.0)
+    assert agg["replicas"] == {"up": 2, "stale": 0, "down": 1}
+    # r2's retained snapshot (queued=50) must NOT leak into the sums.
+    assert agg["queue_depth"] == 3.0
+    assert agg["kv_headroom_pages"] == 7.0  # (8-3) + (8-6)
+    # Lookup-weighted, not a mean of rates: 9/10 despite r1's zero.
+    assert agg["prefix_hit_rate"] == pytest.approx(0.9)
+    assert agg["slo"]["ttft"] == {"n": 8, "bad": 1}
+    assert agg["slo"]["tpot"] == {"n": 80, "bad": 3}
+
+
+def test_aggregates_hit_rate_none_without_lookups():
+    st = FleetState()
+    st.observe_ok("r0", "u0", {}, {}, now=0.0)
+    assert st.aggregates(now=0.0)["prefix_hit_rate"] is None
+
+
+# ---------- detectors ----------
+
+def test_replica_down_fires_once_and_names_victim():
+    evs = ([up_sample("rB", t, queued=1.0, requests=5.0)
+            for t in (1.0, 2.0, 3.0)]
+           + [down_sample("rB", t) for t in (4.0, 5.0, 6.0)]
+           + [up_sample("rA", t, requests=9.0)
+              for t in (1.0, 3.0, 5.0)]
+           + [I("fleet/scrape_error", 4.0, replica="rB",
+                error="connection refused")])
+    found = fleet.ReplicaDownDetector().check(sig(evs, now=7.0))
+    assert [f.subject for f in found] == ["rB"]
+    ev = found[0].evidence
+    assert ev["down_for_s"] == pytest.approx(3.0)
+    assert ev["last_traffic"]["requests"] == 5.0
+    assert ev["scrape_error"] == "connection refused"
+    assert ev["events"], "evidence must point at ring events"
+
+
+def test_replica_down_quiet_without_prior_traffic():
+    # A replica that never carried traffic (fresh node that died while
+    # idle) is a provisioning story, not a replica_down verdict.
+    evs = ([up_sample("rB", t) for t in (1.0, 2.0)]
+           + [down_sample("rB", t) for t in (3.0, 4.0)])
+    assert fleet.ReplicaDownDetector().check(sig(evs, now=5.0)) == []
+
+
+def test_replica_down_quiet_after_recovery():
+    evs = ([up_sample("rB", t, requests=5.0) for t in (1.0, 2.0)]
+           + [down_sample("rB", 3.0)]
+           + [up_sample("rB", 4.0, requests=6.0)])
+    assert fleet.ReplicaDownDetector().check(sig(evs, now=5.0)) == []
+
+
+def test_fleet_imbalance_fires_on_sustained_queue_skew():
+    evs = ([up_sample("rA", t, queued=9.0) for t in (1.0, 2.0, 3.0, 4.0)]
+           + [up_sample("rB", t, queued=1.0)
+              for t in (1.0, 2.0, 3.0, 4.0)])
+    found = fleet.FleetImbalanceDetector().check(sig(evs, now=5.0))
+    assert [f.subject for f in found] == ["rA"]
+    assert found[0].evidence["dimension"] == "queue_depth"
+
+
+def test_fleet_imbalance_quiet_on_crossing_ranges():
+    # Mean gap clears the band but the ranges overlap — a rebalancing
+    # transient, not a sustained skew.
+    evs = ([up_sample("rA", t, queued=q)
+            for t, q in ((1.0, 12.0), (2.0, 1.0), (3.0, 12.0))]
+           + [up_sample("rB", t, queued=q)
+              for t, q in ((1.0, 2.0), (2.0, 2.0), (3.0, 2.0))])
+    assert fleet.FleetImbalanceDetector().check(sig(evs, now=4.0)) == []
+
+
+def test_fleet_imbalance_quiet_for_single_survivor():
+    # Post-kill: one UP replica is skewed by definition; that story
+    # belongs to replica_down.
+    evs = ([up_sample("rA", t, queued=9.0) for t in (1.0, 2.0, 3.0)]
+           + [down_sample("rB", t) for t in (1.0, 2.0, 3.0)])
+    assert fleet.FleetImbalanceDetector().check(sig(evs, now=4.0)) == []
+
+
+def _slo_cfg():
+    return fleet_cfg(slos=[SloSpec("ttft_p99", "ttft", threshold_s=0.5,
+                                   objective=0.9, min_samples=4,
+                                   fast_burn=2.0, slow_burn=1.0)])
+
+
+def test_fleet_slo_burn_fires_on_aggregate_budget_burn():
+    # bad/n = 0.5 against a 0.1 budget: 5x burn in both windows.
+    evs = [C("fleet/slo_ttft", t, n=30, bad=15)
+           for t in (1.0, 2.0, 3.0, 4.0)]
+    found = fleet.FleetSloBurnDetector().check(
+        sig(evs, now=5.0, cfg=_slo_cfg()))
+    assert [f.subject for f in found] == ["fleet/ttft_p99"]
+    assert found[0].evidence["burn_fast"] == pytest.approx(5.0)
+
+
+def test_fleet_slo_burn_quiet_within_budget():
+    evs = [C("fleet/slo_ttft", t, n=30, bad=1)
+           for t in (1.0, 2.0, 3.0, 4.0)]
+    assert fleet.FleetSloBurnDetector().check(
+        sig(evs, now=5.0, cfg=_slo_cfg())) == []
+
+
+def test_fleet_slo_burn_quiet_below_min_samples():
+    evs = [C("fleet/slo_ttft", t, n=2, bad=2) for t in (1.0, 2.0)]
+    assert fleet.FleetSloBurnDetector().check(
+        sig(evs, now=3.0, cfg=_slo_cfg())) == []
+
+
+def test_default_registry_includes_fleet_detectors():
+    classes = {d.cls for d in doctor.default_detectors()}
+    assert {"replica_down", "fleet_imbalance",
+            "fleet_slo_burn"} <= classes
+
+
+def test_replica_down_dedup_one_incident_per_episode():
+    doc = Doctor(config=fleet_cfg(), out_dir=None, bus=None,
+                 live=False, detectors=fleet.fleet_detectors())
+    evs = ([up_sample("rB", t, requests=5.0) for t in (1.0, 2.0)]
+           + [down_sample("rB", t) for t in (3.0, 4.0)])
+    first = doc.evaluate(sig(evs, now=5.0))
+    assert [i["class"] for i in first] == ["replica_down"]
+    assert first[0]["subject"] == "rB"
+    # Same episode re-observed a second later: no second bundle.
+    evs.append(down_sample("rB", 5.5))
+    again = doc.evaluate(sig(evs, now=6.0))
+    assert again == []
+
+
+# ---------- scraper against live exporters ----------
+
+def _stub_replica(queued=0.0, state=None):
+    """A real ServeMetricsExporter on an ephemeral port backed by a
+    plain RequestRecorder, optionally serving a /debugz?state=1
+    snapshot — the wire contract fleetmon consumes, minus the engine."""
+    rec = RequestRecorder()
+    for i in range(int(queued)):  # drive the real lifecycle edge
+        rec.enqueue(f"stub-{i}")
+    exp = ServeMetricsExporter(rec, port=0, host="127.0.0.1",
+                               interval=0.1)
+    if state is not None:
+        exp.state_provider = lambda: state
+    exp.start_background()
+    return rec, exp, f"http://127.0.0.1:{exp.bound_port}"
+
+
+def test_scraper_polls_real_exporters_and_aggregates():
+    state_a = {"queued": 4, "kv_pages": {"used": 1, "total": 9},
+               "worker_alive": True, "requests_served": 3}
+    _, exp_a, url_a = _stub_replica(state=state_a)
+    _, exp_b, url_b = _stub_replica(queued=2.0)  # no state provider
+    try:
+        sc = FleetScraper([url_a, url_b], replica_ids=["rA", "rB"],
+                          timeout_s=5.0)
+        agg = sc.poll_once(now=0.0)
+        assert agg["replicas"] == {"up": 2, "stale": 0, "down": 0}
+        # rA from its snapshot, rB from the /metrics fallback.
+        assert agg["queue_depth"] == 6.0
+        assert agg["kv_headroom_pages"] == 8.0
+        assert sc.last_outcomes == {"rA": "ok", "rB": "ok"}
+    finally:
+        exp_a.stop()
+        exp_b.stop()
+
+
+def test_dead_replica_degrades_without_crashing_poller():
+    _, exp_a, url_a = _stub_replica()
+    _, exp_b, url_b = _stub_replica()
+    try:
+        sc = FleetScraper([url_a, url_b], replica_ids=["rA", "rB"],
+                          timeout_s=2.0, down_after_s=5.0)
+        sc.poll_once(now=0.0)
+        exp_b.stop()  # rB dies between polls
+        agg = sc.poll_once(now=1.0)
+        assert agg["replicas"] == {"up": 1, "stale": 1, "down": 0}
+        agg = sc.poll_once(now=10.0)
+        assert agg["replicas"] == {"up": 1, "stale": 0, "down": 1}
+        assert sc.scrape_errors == 2
+        rb = {r.rid: r for r in sc.state.replicas()}["rB"]
+        assert rb.last_error
+    finally:
+        exp_a.stop()
+
+
+def test_scrape_failure_emits_error_instant_and_transition():
+    events.enable()
+    tap = events.get_bus().subscribe("test")
+    sc = FleetScraper(["http://127.0.0.1:9"],  # discard port: refused
+                      replica_ids=["rX"], timeout_s=0.5,
+                      down_after_s=100.0)
+    sc.poll_once()
+    names = [ev[3] for ev in tap.drain()]
+    assert "fleet/scrape_error" in names
+    assert "fleet/replica/rX" in names
+    assert "fleet/replicas" in names
+    # First failure is NOT a transition (stale is the starting state).
+    assert "fleet/replica_state" not in names
+
+
+def test_fleet_exporter_serves_labeled_rollup():
+    from prometheus_client import generate_latest
+
+    state = {"queued": 1, "kv_pages": {"used": 2, "total": 10},
+             "worker_alive": True}
+    _, exp_a, url_a = _stub_replica(state=state)
+    try:
+        sc = FleetScraper([url_a], replica_ids=["rA"], timeout_s=5.0)
+        fx = FleetExporter(sc, port=0, host="127.0.0.1", interval=0.1)
+        fx.poll_once()
+        text = generate_latest(fx.registry).decode()
+        assert 'fleet_replicas{state="up"} 1.0' in text
+        assert 'fleet_replicas{state="down"} 0.0' in text
+        assert 'fleet_replica_state{replica="rA"} 2.0' in text
+        assert "fleet_kv_headroom_pages 8.0" in text
+        assert 'fleet_scrapes_total{outcome="ok",replica="rA"} 1.0' \
+            in text
+        # fleetmon's own /debugz contract: the replica table.
+        dz = fx.state_provider()
+        assert dz["replicas"][0]["replica"] == "rA"
+        assert dz["replicas"][0]["state"] == "up"
+    finally:
+        exp_a.stop()
+
+
+# ---------- regression: replica SIGKILLed mid-scrape ----------
+
+_SLOW_SERVER = r"""
+import http.server, time
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", "1000000")
+        self.end_headers()
+        self.wfile.write(b"serve_queue_depth 0.0\n")
+        self.wfile.flush()
+        time.sleep(120)  # hold the socket: the parent kills us here
+    def log_message(self, *a):
+        pass
+srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+print(srv.server_address[1], flush=True)
+srv.serve_forever()
+"""
+
+
+def test_poller_survives_replica_sigkill_mid_scrape():
+    """ISSUE 18 satellite fix: a replica that dies MID-RESPONSE (body
+    promised, socket severed) must degrade to stale with a
+    fleet/scrape_error instant — the poll thread must neither crash
+    nor hang on the half-read body."""
+    proc = subprocess.Popen([sys.executable, "-c", _SLOW_SERVER],
+                            stdout=subprocess.PIPE)
+    try:
+        port = int(proc.stdout.readline())
+        events.enable()
+        tap = events.get_bus().subscribe("test")
+        sc = FleetScraper([f"http://127.0.0.1:{port}"],
+                          replica_ids=["victim"], timeout_s=10.0,
+                          down_after_s=100.0)
+        done = threading.Event()
+        agg: dict = {}
+
+        def poll():
+            agg.update(sc.poll_once())
+            done.set()
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        time.sleep(0.5)  # poller is now blocked mid-body
+        proc.kill()      # SIGKILL: connection severed, no FIN courtesy
+        assert done.wait(timeout=30), \
+            "poller hung on the half-read scrape"
+        assert agg["replicas"] == {"up": 0, "stale": 1, "down": 0}
+        assert sc.scrape_errors == 1
+        names = [ev[3] for ev in tap.drain()]
+        assert "fleet/scrape_error" in names
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ---------- e2e: two real replicas (slow tier / make fleet-smoke) ----------
+
+def _read_json_line(stream, kind, deadline_s=240.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        line = stream.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("kind") == kind:
+            return rec
+    raise AssertionError(f"no {kind!r} ready line within "
+                         f"{deadline_s}s")
+
+
+@pytest.mark.slow
+def test_fleet_e2e_two_replicas_loadgen_fleetmon_merge(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    trace_base = tmp_path / "tr"
+    for rid in ("r0", "r1"):
+        (tmp_path / f"tr.{rid}").mkdir()
+    procs = []
+    try:
+        fl = subprocess.Popen(
+            [sys.executable, "-m",
+             "container_engine_accelerators_tpu.cli.fleet",
+             "--replicas", "2", "--ready-timeout", "240", "--",
+             "--engine", "continuous", "--trace-dump",
+             str(trace_base), "--trace-sample-rate", "1.0"],
+            cwd=repo, env=env, stdout=subprocess.PIPE)
+        procs.append(fl)
+        ready = _read_json_line(fl.stdout, "fleet")
+        reps = {r["id"]: r for r in ready["replicas"]}
+        assert set(reps) == {"r0", "r1"}
+
+        # loadgen fans out over both replicas, forcing traces.
+        args = loadgen.make_parser().parse_args([
+            "--targets", ",".join(r["url"] for r in ready["replicas"]),
+            "--requests", "4", "--concurrency", "2",
+            "--max-new-tokens", "4", "--prompt-len", "4",
+            "--trace-sample-rate", "1.0", "--timeout", "300"])
+        summary, rc = loadgen.run(args)
+        assert rc == 0, summary
+        assert summary["requests_ok"] == 4
+        per_target = summary["targets"]
+        assert len(per_target) == 2
+        assert all(t["requests_ok"] == 2 for t in per_target.values())
+
+        # fleetmon scrapes both replicas' metrics endpoints.
+        fm = subprocess.Popen(
+            [sys.executable, "-m",
+             "container_engine_accelerators_tpu.cli.fleetmon",
+             "--endpoints",
+             ",".join(r["metrics_url"] for r in ready["replicas"]),
+             "--replica-ids", "r0,r1", "--port", "0",
+             "--interval", "0.25"],
+            cwd=repo, env=env, stdout=subprocess.PIPE)
+        procs.append(fm)
+        fm_ready = _read_json_line(fm.stdout, "fleetmon")
+        fm_url = f"http://127.0.0.1:{fm_ready['port']}"
+        deadline = time.monotonic() + 60
+        while True:
+            with urllib.request.urlopen(fm_url + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            if 'fleet_replicas{state="up"} 2.0' in text:
+                break
+            assert time.monotonic() < deadline, text
+            time.sleep(0.3)
+        with urllib.request.urlopen(fm_url + "/debugz?state=1",
+                                    timeout=10) as r:
+            dz = json.loads(r.read())
+        rows = {row["replica"]: row for row in
+                dz["state"]["replicas"]}
+        assert set(rows) == {"r0", "r1"}
+        assert all(row["state"] == "up" for row in rows.values())
+
+        # Ask each replica for its ring dump, then merge and validate.
+        for rid, rep in reps.items():
+            os.kill(rep["pid"], signal.SIGUSR2)
+        dumps = []
+        deadline = time.monotonic() + 60
+        while len(dumps) < 2 and time.monotonic() < deadline:
+            dumps = [os.path.join(str(tmp_path), f"tr.{rid}", fn)
+                     for rid in ("r0", "r1")
+                     if os.path.isdir(tmp_path / f"tr.{rid}")
+                     for fn in os.listdir(tmp_path / f"tr.{rid}")
+                     if fn.endswith(".json")]
+            time.sleep(0.3)
+        assert len(dumps) == 2, dumps
+
+        from tools.trace_report import build_report
+        merged = events.merge_traces(dumps, [], [])
+        report = build_report(merged)
+        assert not report["problems"], report["problems"][:3]
+        # Distinct per-replica track groups: the merge keeps the two
+        # processes separate and labels their tracks with the replica.
+        meta = {e["args"]["name"]
+                for e in merged["traceEvents"]
+                if e.get("ph") == "M"
+                and e.get("name") == "process_name"}
+        assert any("[r0]" in n for n in meta), meta
+        assert any("[r1]" in n for n in meta), meta
+        assert set(report["replicas"]) == {"r0", "r1"}
+        by_rep = {rep: [r for r in report["requests"]
+                        if r["replica"] == rep]
+                  for rep in ("r0", "r1")}
+        assert all(len(rows) >= 1 for rows in by_rep.values()), {
+            k: len(v) for k, v in by_rep.items()}
+    finally:
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -72,12 +72,13 @@ log = logging.getLogger("tpu-chaos")
 
 SCENARIO_DIR = os.path.join(_REPO, "chaos", "scenarios")
 
-_WORKLOAD_KINDS = ("serve", "train")
+_WORKLOAD_KINDS = ("serve", "train", "fleetmon")
 _ACTIONS = ("sleep", "warmup", "loadgen", "loadgen_start", "loadgen_wait",
             "inject", "health_errors", "kill", "start", "wait_exit",
             "wait_ckpt_steps", "wait_log_record", "corrupt_newest_ckpt")
 _ASSERT_KEYS = ("doctor", "serve_gauges_baseline", "healthz",
-                "timeline_require", "train", "ckpt", "request_trace")
+                "timeline_require", "train", "ckpt", "request_trace",
+                "fleet_gauges")
 # Actions that mark the end of the clean phase: the first one to run
 # stamps fault_start, and the doctor assertion rejects any incident
 # diagnosed before it.
@@ -119,6 +120,16 @@ def load_scenario(path: str) -> dict:
             raise ScenarioError(
                 f"{sc['name']}: serve workload needs engine "
                 "window|continuous|paged")
+    serve_ids = {w.get("id", w["kind"]) for w in sc["workloads"]
+                 if w["kind"] == "serve"}
+    for w in sc["workloads"]:
+        if w["kind"] != "fleetmon":
+            continue
+        for tgt in w.get("targets", []):
+            if tgt not in serve_ids:
+                raise ScenarioError(
+                    f"{sc['name']}: fleetmon target {tgt!r} is not a "
+                    "serve workload id")
     lg_ids = set()
     for ph in sc["phases"]:
         act = ph.get("action")
@@ -131,6 +142,11 @@ def load_scenario(path: str) -> dict:
             raise ScenarioError(
                 f"{sc['name']}: action {act} targets unknown workload "
                 f"{tgt!r}")
+        for fan in ph.get("targets", []):
+            if fan not in serve_ids:
+                raise ScenarioError(
+                    f"{sc['name']}: action {act} fan-out target "
+                    f"{fan!r} is not a serve workload id")
         if act == "wait_log_record" and not ph.get("kind"):
             raise ScenarioError(
                 f"{sc['name']}: wait_log_record needs a 'kind' (the "
@@ -342,6 +358,67 @@ def check_healthz(body: dict, expect: dict) -> list[dict]:
     return out
 
 
+def parse_labeled_gauge(metrics_text: str, name: str,
+                        labels: dict) -> float | None:
+    """Last sample of `name{...}` whose label set CONTAINS `labels`
+    (Prometheus text format; label order in the line is arbitrary)."""
+    want = {f'{k}="{v}"' for k, v in labels.items()}
+    val = None
+    for line in metrics_text.splitlines():
+        if not line.startswith(name + "{") or "} " not in line:
+            continue
+        lab, _, rest = line.partition("} ")
+        got = set(lab[len(name) + 1:].split(","))
+        if not want <= got:
+            continue
+        try:
+            val = float(rest.split()[0])
+        except (IndexError, ValueError):
+            continue
+    return val
+
+
+def check_fleet_gauges(metrics_text: str, expect: dict) -> list[dict]:
+    """(ISSUE 18) fleet rollup convergence on the fleetmon exporter:
+    `replicas` pins fleet_replicas{state} exactly (the survivor count
+    AND the dead count — a kill that never converges to down=1 fails),
+    `replica_state` pins per-replica levels (up=2 stale=1 down=0),
+    `queue_depth_max` / `kv_headroom_min` bound the aggregates."""
+    out = []
+    for state, want in expect.get("replicas", {}).items():
+        got = parse_labeled_gauge(metrics_text, "fleet_replicas",
+                                  {"state": state})
+        out.append(_result(
+            f"fleet.replicas.{state}",
+            got is not None and int(got) == int(want),
+            f"fleet_replicas{{state={state!r}}}={got}, expected "
+            f"{want}"))
+    for rid, want in expect.get("replica_state", {}).items():
+        got = parse_labeled_gauge(metrics_text, "fleet_replica_state",
+                                  {"replica": rid})
+        out.append(_result(
+            f"fleet.replica_state.{rid}",
+            got is not None and int(got) == int(want),
+            f"fleet_replica_state{{replica={rid!r}}}={got}, expected "
+            f"{want} (up=2 stale=1 down=0)"))
+    if "queue_depth_max" in expect:
+        got = parse_gauge(metrics_text, "fleet_queue_depth")
+        out.append(_result(
+            "fleet.queue_depth", got is not None
+            and got <= float(expect["queue_depth_max"]),
+            f"fleet_queue_depth={got}, need <= "
+            f"{expect['queue_depth_max']} (requests stuck on a dead "
+            "replica never drain)"))
+    if "kv_headroom_min" in expect:
+        got = parse_gauge(metrics_text, "fleet_kv_headroom_pages")
+        out.append(_result(
+            "fleet.kv_headroom", got is not None
+            and got >= float(expect["kv_headroom_min"]),
+            f"fleet_kv_headroom_pages={got}, need >= "
+            f"{expect['kv_headroom_min']}"))
+    return out
+
+
 def check_train(summary: dict | None, spec: dict,
                 label: str = "train") -> list[dict]:
     """(c) training: step target reached across the fault, with the
@@ -548,7 +625,14 @@ class Workload:
         self.trace_dir = os.path.join(out_dir, "traces")
         os.makedirs(self.trace_dir, exist_ok=True)
         self.port = _free_port() if self.kind == "serve" else None
-        self.metrics_port = _free_port() if self.kind == "serve" else None
+        self.metrics_port = (_free_port()
+                             if self.kind in ("serve", "fleetmon")
+                             else None)
+        # Resolved by ScenarioRun once every workload's ports exist:
+        # the serve metrics endpoints a fleetmon workload scrapes and
+        # the replica ids it labels them with.
+        self.fleet_endpoints: list[str] = []
+        self.fleet_replica_ids: list[str] = []
         self.metrics_log = (os.path.join(out_dir, f"steps-{self.id}.jsonl")
                             if self.kind == "train" else None)
         self.proc: subprocess.Popen | None = None
@@ -571,6 +655,25 @@ class Workload:
             if self.spec.get("supervise"):
                 argv += ["--supervise", "--supervise-backoff",
                          str(self.spec.get("supervise_backoff", 0.5))]
+            return argv + extra
+        if self.kind == "fleetmon":
+            argv = [sys.executable, "-m",
+                    "container_engine_accelerators_tpu.cli.fleetmon",
+                    "--endpoints", ",".join(self.fleet_endpoints),
+                    "--replica-ids", ",".join(self.fleet_replica_ids),
+                    "--port", str(self.metrics_port),
+                    "--interval", str(self.spec.get("interval_s", 0.25)),
+                    "--down-after",
+                    str(self.spec.get("down_after_s", 1.0)),
+                    "--timeout", str(self.spec.get("timeout_s", 1.0)),
+                    "--trace-dump", self.trace_dir]
+            if self.spec.get("doctor", True):
+                # Live fleet doctor: incidents in their own dir so the
+                # offline replay's bundles stay the assertion source.
+                argv += ["--doctor", "--doctor-interval",
+                         str(self.spec.get("doctor_interval_s", 0.5)),
+                         "--doctor-dir",
+                         os.path.join(self.out_dir, "incidents-live")]
             return argv + extra
         argv = [sys.executable, "-m",
                 "container_engine_accelerators_tpu.cli.train",
@@ -613,9 +716,14 @@ class Workload:
                  self.proc.pid)
 
     def wait_ready(self, timeout_s: float = 180.0) -> None:
-        """Serve: poll /healthz until the server answers. Train is
-        'ready' once started (its loop begins immediately)."""
-        if self.kind != "serve":
+        """Serve: poll /healthz until the server answers. Fleetmon:
+        poll its own /metrics (it is ready once its exporter binds).
+        Train is 'ready' once started (its loop begins immediately)."""
+        if self.kind == "serve":
+            url = f"http://127.0.0.1:{self.port}/healthz"
+        elif self.kind == "fleetmon":
+            url = f"http://127.0.0.1:{self.metrics_port}/metrics"
+        else:
             return
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -624,10 +732,11 @@ class Workload:
                     f"workload {self.id} exited rc={self.proc.returncode}"
                     " before becoming ready")
             try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{self.port}/healthz",
-                        timeout=2) as r:
-                    if json.loads(r.read()).get("ok"):
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    if self.kind == "fleetmon":
+                        if r.status == 200:
+                            return
+                    elif json.loads(r.read()).get("ok"):
                         return
             except Exception:
                 time.sleep(0.3)
@@ -776,7 +885,9 @@ class _BgLoadgen:
             raise RuntimeError("background loadgen did not finish")
 
 
-def _loadgen_args(url: str, ph: dict) -> "argparse.Namespace":
+def _loadgen_args(url: str, ph: dict,
+                  targets: list[str] | None = None
+                  ) -> "argparse.Namespace":
     argv = ["--url", url,
             "--requests", str(ph.get("requests", 4)),
             "--concurrency", str(ph.get("concurrency", 2)),
@@ -799,6 +910,8 @@ def _loadgen_args(url: str, ph: dict) -> "argparse.Namespace":
                  str(ph.get("long_prompt_len", 256))]
     if ph.get("trace_sample_rate") is not None:
         argv += ["--trace-sample-rate", str(ph["trace_sample_rate"])]
+    if targets:
+        argv += ["--targets", ",".join(targets)]
     return loadgen.make_parser().parse_args(argv)
 
 
@@ -857,6 +970,25 @@ class ScenarioRun:
         self.workloads = {
             w.get("id", w["kind"]): Workload(w, self.out_dir, self.subs)
             for w in sc["workloads"]}
+        # Fleetmon workloads name their scrape targets by serve
+        # workload id; resolve to the ephemeral metrics ports now that
+        # every workload has one. The replica id defaults to the serve
+        # workload's --replica-id arg (so fleet verdicts and the
+        # replica's own event stream agree on the name), else its id.
+        for wl in self.workloads.values():
+            if wl.kind != "fleetmon":
+                continue
+            tids = wl.spec.get("targets") or [
+                w.id for w in self.workloads.values()
+                if w.kind == "serve"]
+            for tid in tids:
+                tgt = self.workloads[tid]
+                args = [str(a) for a in tgt.spec.get("args", [])]
+                rid = (args[args.index("--replica-id") + 1]
+                       if "--replica-id" in args else tid)
+                wl.fleet_endpoints.append(
+                    f"http://127.0.0.1:{tgt.metrics_port}")
+                wl.fleet_replica_ids.append(rid)
         self.bg: dict[str, _BgLoadgen] = {}
         self.loadgen_results: list[tuple[str, dict, int, dict]] = []
         self.fault_start: float | None = None
@@ -867,6 +999,16 @@ class ScenarioRun:
         if tgt is None:
             tgt = next(iter(self.workloads))
         return self.workloads[tgt]
+
+    def _fanout(self, ph: dict):
+        """(url, targets) for a traffic phase: `targets` round-robins
+        over the named serve workloads (loadgen --targets); otherwise
+        the single `target` workload's url."""
+        tids = ph.get("targets")
+        if tids:
+            urls = [self.workloads[t].url() for t in tids]
+            return urls[0], urls
+        return self._wl(ph).url(), None
 
     # -- phase execution --
 
@@ -879,23 +1021,24 @@ class ScenarioRun:
         elif act == "warmup":
             # Absorb the cold-jit stall before the scenario clock
             # matters: a few sync requests with generous timeouts.
-            wl = self._wl(ph)
-            args = _loadgen_args(wl.url(), dict(ph, stream=True,
-                                                stall_timeout_s=None))
+            url, targets = self._fanout(ph)
+            args = _loadgen_args(url, dict(ph, stream=True,
+                                           stall_timeout_s=None),
+                                 targets=targets)
             summary, rc = loadgen.run(args)
             if rc != 0:
                 raise RuntimeError(
                     f"warmup traffic failed (rc={rc}): {summary}")
         elif act == "loadgen":
-            wl = self._wl(ph)
-            args = _loadgen_args(wl.url(), ph)
+            url, targets = self._fanout(ph)
+            args = _loadgen_args(url, ph, targets=targets)
             summary, rc = loadgen.run(args)
             self.loadgen_results.append(
                 (ph.get("label", "loadgen"), summary, rc,
                  ph.get("expect", {})))
         elif act == "loadgen_start":
-            wl = self._wl(ph)
-            bg = _BgLoadgen(_loadgen_args(wl.url(), ph))
+            url, targets = self._fanout(ph)
+            bg = _BgLoadgen(_loadgen_args(url, ph, targets=targets))
             self.bg[ph.get("id", "bg")] = bg
             bg.start()
         elif act == "loadgen_wait":
@@ -1084,6 +1227,25 @@ class ScenarioRun:
                 if wl.kind == "serve":
                     self.results.extend(
                         check_healthz(wl.healthz(), asserts["healthz"]))
+        fg = asserts.get("fleet_gauges")
+        if fg is not None:
+            # Convergence, not an instant: the fleetmon poller needs a
+            # scrape or two past down_after before a killed replica's
+            # gauge flips stale -> down, so retry until the deadline.
+            expect = fg.get("expect", {})
+            deadline = time.monotonic() + float(fg.get("timeout_s", 10.0))
+            for wl in self.workloads.values():
+                if wl.kind != "fleetmon":
+                    continue
+                if fg.get("target") not in (None, wl.id):
+                    continue
+                while True:
+                    res = check_fleet_gauges(wl.scrape_metrics(), expect)
+                    if (all(r["ok"] for r in res)
+                            or time.monotonic() > deadline):
+                        break
+                    time.sleep(0.3)
+                self.results.extend(res)
         specs = asserts.get("train")
         if specs:
             if isinstance(specs, dict):

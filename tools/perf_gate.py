@@ -52,6 +52,12 @@ environment's TPU plugin), tiny shapes, fixed seeds:
                          not ms, pinned near zero: it grows toward the
                          host/device ratio if a fence sneaks back
                          between dispatch and the gap work
+  fleet_scrape_ms        one FleetScraper.poll_once over two live
+                         in-process replica exporters (ISSUE 18) —
+                         /metrics + /debugz?state=1 per replica plus
+                         the rollup; pins the fleet telemetry plane's
+                         per-poll cost so a scrape-path regression
+                         can't silently starve the monitoring loop
   multislice_step_ms     dp=2 train step across TWO real OS processes
                          joined by jax.distributed over gloo — the
                          hermetic stand-in for the DCN gradient psum
@@ -887,6 +893,67 @@ def _host_gap_bench():
     return HOST_GAP_METRIC, measure, None
 
 
+def _fleet_scrape_bench():
+    """('fleet_scrape_ms'): one FleetScraper.poll_once over two live
+    in-process replica exporters — the full scrape path (/metrics GET
+    + parse + /debugz?state=1 snapshot per replica) plus the
+    FleetState rollup, exactly what fleetmon pays per interval tick.
+    The exporters are started fresh inside each measure pass and torn
+    down before it returns, so the tier never leaks listener threads;
+    only the per-poll wall time lands in the samples. No jax anywhere
+    in this path, so it contributes nothing to the recompile window."""
+    from container_engine_accelerators_tpu.metrics.fleet import (
+        FleetScraper,
+    )
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+        ServeMetricsExporter,
+    )
+
+    state = {"queued": 2, "slots": {"active": 2, "total": 4},
+             "kv_pages": {"used": 5, "total": 16},
+             "prefix_cache": {"lookups": 10, "hits": 7},
+             "host_gap_fraction": 0.01,
+             "slo_windows": {"ttft": {"n": 8, "bad": 0},
+                             "tpot": {"n": 80, "bad": 1}},
+             "worker_alive": True, "worker_restarts": 0,
+             "requests_served": 12}
+
+    def measure(n_steps: int):
+        exps = []
+        try:
+            for _ in range(2):
+                rec = RequestRecorder()
+                exp = ServeMetricsExporter(rec, port=0,
+                                           host="127.0.0.1",
+                                           interval=0.1)
+                exp.state_provider = lambda: state
+                exp.start_background()
+                exps.append(exp)
+            sc = FleetScraper(
+                [f"http://127.0.0.1:{e.bound_port}" for e in exps],
+                timeout_s=10.0)
+            sc.poll_once()  # warm sockets/parsers outside the samples
+            times = []
+            # Each sample averages several polls: a single loopback
+            # HTTP round trip is dominated by thread-wakeup jitter
+            # (fresh handler thread per request), which would swamp
+            # the learned band at small k — the mean of a burst is
+            # the stable per-poll cost the gate should pin.
+            burst = 4
+            for _ in range(n_steps):
+                t0 = time.monotonic()
+                for _ in range(burst):
+                    sc.poll_once()
+                times.append((time.monotonic() - t0) / burst)
+        finally:
+            for exp in exps:
+                exp.stop()
+        return times, harness.pct_ms(times)
+
+    return "fleet_scrape_ms", measure, None
+
+
 def _matmul_bench():
     """Stacked scan matmul — the component_bench shape family shrunk to
     the tier-1 budget, watched for compile attribution like the real
@@ -1104,7 +1171,8 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
                _decode_bench(paged=True),
                _matmul_bench(), _prefill_cached_bench(),
                _decode_under_prefill_bench(), _ckpt_async_bench(),
-               _decode_spec_bench(), _host_gap_bench()]
+               _decode_spec_bench(), _host_gap_bench(),
+               _fleet_scrape_bench()]
     metrics: dict = {}
     results: list = []
     with harness.RecompileGuard() as guard:

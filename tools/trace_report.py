@@ -213,7 +213,7 @@ def attribute_request(rid, evs) -> dict:
     tags = {}
     for e in evs:
         a = e.get("args") or {}
-        for k in ("tenant", "class"):
+        for k in ("tenant", "class", "replica"):
             if k in a and k not in tags:
                 tags[k] = a[k]
     why = [
@@ -238,7 +238,8 @@ def attribute_request(rid, evs) -> dict:
 
     return {
         "rid": rid, "tenant": tags.get("tenant"),
-        "class": tags.get("class"), "events": len(evs),
+        "class": tags.get("class"),
+        "replica": tags.get("replica"), "events": len(evs),
         "ticks": n_ticks, "preempts": preempts, "restarts": restarts,
         "tail_sampled": why[0] if why else None,
         "truncated_events": truncated,
@@ -260,7 +261,22 @@ def build_report(merged: dict) -> dict:
     sources = (merged.get("otherData") or {}).get("sources", [])
     dropped = sum(int(s.get("dropped") or 0) for s in sources)
     truncated = dropped > 0 or any(r["truncated_events"] for r in rows)
-    return {"requests": rows, "sources": sources,
+    # Per-replica rollup (ISSUE 18): sources carry the replica id their
+    # anchor was stamped with (serve --replica-id), request rows carry
+    # the replica tag the server's tracer injected into every span —
+    # a two-replica merge reads as two track groups plus this block.
+    replicas: dict = {}
+    for s in sources:
+        rep = s.get("replica")
+        if rep:
+            replicas.setdefault(
+                rep, {"sources": 0, "requests": 0})["sources"] += 1
+    for r in rows:
+        rep = r.get("replica")
+        if rep:
+            replicas.setdefault(
+                rep, {"sources": 0, "requests": 0})["requests"] += 1
+    return {"requests": rows, "sources": sources, "replicas": replicas,
             "events_dropped_total": dropped, "truncated": truncated,
             "problems": validate_trace(merged)}
 
@@ -274,9 +290,9 @@ def _fmt(v, nd=1) -> str:
 
 
 def print_report(report: dict, file=sys.stdout) -> None:
-    cols = ("rid", "tenant", "class", "ticks", "ttft_ms", "tpot_ms",
-            "queue_ms", "prefill_ms", "page_stall_ms", "device_ms",
-            "exposed_host_ms")
+    cols = ("rid", "replica", "tenant", "class", "ticks", "ttft_ms",
+            "tpot_ms", "queue_ms", "prefill_ms", "page_stall_ms",
+            "device_ms", "exposed_host_ms")
     rows = report["requests"]
     table = [[_fmt(r.get(c)) for c in cols] for r in rows]
     widths = [max(len(c), *(len(row[i]) for row in table))
@@ -302,12 +318,18 @@ def print_report(report: dict, file=sys.stdout) -> None:
     print(file=file)
     for s in report["sources"]:
         line = (f"source {s.get('kind')}: {s.get('path')} "
-                f"({s.get('events', 0)} events, pid {s.get('pid')})")
+                f"({s.get('events', 0)} events, pid {s.get('pid')}")
+        if s.get("replica"):
+            line += f", replica {s['replica']}"
+        line += ")"
         if s.get("skipped"):
             line += f" SKIPPED: {s['skipped']}"
         if s.get("dropped"):
             line += f" DROPPED {s['dropped']} events"
         print(line, file=file)
+    for rep, info in sorted(report.get("replicas", {}).items()):
+        print(f"replica {rep}: {info['sources']} source(s), "
+              f"{info['requests']} traced request(s)", file=file)
     if report["truncated"]:
         print(f"WARNING: TRACE TRUNCATED — "
               f"{report['events_dropped_total']} events dropped at the "
