@@ -248,12 +248,21 @@ fleet-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q \
 	    -m "slow or not slow"
 
+# KV thermal observability smoke (ISSUE 19): thermal census math +
+# drain-to-zero invariant, refcount-vs-temperature pinning, per-tenant
+# occupancy across preemption, the kv_cold_waste / kv_thrash doctor
+# detectors, the kv_report two-level LRU tier simulator pinned against
+# a hand-computed trace, and the idle-tenant e2e.
+kv-thermal-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_kv_thermal.py -q \
+	    -m "slow or not slow"
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
     serve-pools-smoke multislice-smoke dcn-overlap-smoke \
     preemption-smoke spec-smoke async-core-smoke fleet-smoke \
-    chaos-smoke
+    kv-thermal-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -269,4 +278,4 @@ clean:
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
     dcn-overlap-smoke preemption-smoke spec-smoke async-core-smoke \
-    fleet-smoke smoke dryrun clean
+    fleet-smoke kv-thermal-smoke smoke dryrun clean

@@ -95,10 +95,25 @@ class StreamStalled(Exception):
     failing cleanly — the outcome chaos assertions must tell apart."""
 
 
-def tenant_class(tenant: int) -> str:
+def tenant_class(tenant: int, args=None) -> str:
     """Odd tenants run long-prompt "batch" traffic, even ones chatty
     "chat" traffic — interleaving the two is the whole point of the
-    mix."""
+    mix. With --idle-tenants/--churn-tenants (ISSUE 19) the TOP of the
+    tenant range is carved off first: the last `idle_tenants` tenants
+    are "idle" (long think-time sessions whose prefix pages sit
+    resident and go cold) and the `churn_tenants` before them are
+    "churn" (their system prefix cycles through more variants than the
+    server's prefix cache holds, forcing evict-then-re-reference
+    thrash). Passing args is optional so legacy callers keep the
+    two-class layout."""
+    if args is not None:
+        idle_n = getattr(args, "idle_tenants", 0) or 0
+        churn_n = getattr(args, "churn_tenants", 0) or 0
+        n = getattr(args, "tenants", 0) or 0
+        if n and tenant >= n - idle_n:
+            return "idle"
+        if n and tenant >= n - idle_n - churn_n:
+            return "churn"
     return "batch" if tenant % 2 else "chat"
 
 
@@ -106,11 +121,26 @@ def tenant_tokens(args, i: int) -> tuple[int, list[int]]:
     """(tenant, prompt) for request i of a multi-tenant mix. The
     prefix depends only on the TENANT (their shared system prompt —
     deterministic, so repeat requests hit the server's prefix cache);
-    the suffix depends on the request (each conversation differs)."""
+    the suffix depends on the request (each conversation differs).
+    Churn tenants break that rule on purpose: their prefix also
+    depends on the request's cycle position (i // tenants mod
+    --churn-cycle), so successive rounds reference MORE prefix
+    variants than the cache retains."""
     t = i % args.tenants
-    prefix = [(t * 31 + j) % 97 + 1
+    cls = tenant_class(t, args)
+    variant = 0
+    if cls == "churn":
+        variant = (i // args.tenants) % max(
+            getattr(args, "churn_cycle", 1), 1)
+    # The variant multiplier must keep (t*31 + v*17) mod 97 distinct
+    # across every coexisting (tenant, variant) pair — a churn variant
+    # that lands on another tenant's offset silently SHARES that
+    # tenant's prefix pages (first-owner-wins attribution then charges
+    # them to the wrong tenant). 17 is collision-free for <=8 tenants
+    # x 8-variant cycles; 53 aliased churn variants onto idle tenants.
+    prefix = [(t * 31 + variant * 17 + j) % 97 + 1
               for j in range(args.tenant_prefix_len)]
-    body_len = (args.long_prompt_len if tenant_class(t) == "batch"
+    body_len = (args.long_prompt_len if cls == "batch"
                 else args.prompt_len)
     body = [(i * 7 + j) % 100 + 1 for j in range(body_len)]
     return t, prefix + body
@@ -144,7 +174,8 @@ def _slo_block(ttfts, gaps, args):
 def one_request(url: str, tokens: list[int], max_new: int,
                 stream: bool, timeout: float,
                 stall_timeout: float | None = None,
-                trace_tags: dict | None = None) -> dict:
+                trace_tags: dict | None = None,
+                force_trace: bool = True) -> dict:
     """Returns {"outcome": "ok"|"structured_error", "error": str|None,
     "latency": s, "ttft": s|None, "tokens": n_generated,
     "gaps": [inter-token seconds]} (gaps only in stream mode).
@@ -156,8 +187,12 @@ def one_request(url: str, tokens: list[int], max_new: int,
     if stream:
         body["stream"] = True
     if trace_tags is not None:
-        body["trace"] = True
+        # Tags alone give the server tenant attribution (the thermal
+        # census's per-tenant occupancy); "trace": true additionally
+        # forces the request into the span trace.
         body["tags"] = trace_tags
+        if force_trace:
+            body["trace"] = True
     req = urllib.request.Request(url + "/generate",
                                  data=json.dumps(body).encode())
     # The socket timeout bounds each blocking read: in stream mode
@@ -230,15 +265,26 @@ def run(args) -> tuple[dict, int]:
             tenant = 0
             tokens = [(i * 7 + j) % 100 + 1
                       for j in range(args.prompt_len)]
-        trace_tags = None
-        if (args.trace_sample_rate
-                and head_sampled(i, args.trace_sample_rate)):
-            trace_tags = {"tenant": tenant,
-                          "class": tenant_class(tenant)}
+        cls = tenant_class(tenant, args)
+        # Tenant tags ride EVERY multi-tenant request (the server's
+        # thermal census attributes pages by them); head-sampled
+        # requests additionally force a span trace.
+        trace_tags = ({"tenant": tenant, "class": cls}
+                      if args.tenants else None)
+        force = bool(args.trace_sample_rate
+                     and head_sampled(i, args.trace_sample_rate))
+        if trace_tags is None and force:
+            trace_tags = {"tenant": tenant, "class": cls}
+        if cls == "idle":
+            # Think time: the session holds its prefix pages resident
+            # while saying nothing — the cold-page producer. Slept
+            # before the request clock starts, so idle tenants' TTFT
+            # still measures the server, not the think time.
+            time.sleep(getattr(args, "idle_think_s", 0.0) or 0.0)
         r = one_request(target_for(i), tokens, args.max_new_tokens,
                         args.stream, args.timeout,
                         stall_timeout=args.stall_timeout_s,
-                        trace_tags=trace_tags)
+                        trace_tags=trace_tags, force_trace=force)
         r["tenant"] = tenant
         return r
 
@@ -356,7 +402,8 @@ def run(args) -> tuple[dict, int]:
         tenants = {}
         for t in sorted({r["tenant"] for r in results}):
             rs = [r for r in results if r["tenant"] == t]
-            entry = {"class": tenant_class(t), "requests_ok": len(rs),
+            entry = {"class": tenant_class(t, args),
+                     "requests_ok": len(rs),
                      "latency_ms": {
                          k: round(v * 1e3, 1) for k, v in
                          percentiles([r["latency"] for r in rs]).items()}}
@@ -428,6 +475,26 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--long-prompt-len", type=int, default=256,
                    help="prompt body length for odd (batch-class) "
                         "tenants in the multi-tenant mix")
+    p.add_argument("--idle-tenants", type=int, default=0,
+                   help="carve this many tenants off the TOP of the "
+                        "tenant range as 'idle' class: chat-length "
+                        "prompts preceded by --idle-think-s of think "
+                        "time per request, so their prefix pages sit "
+                        "resident and go cold (the kv_cold_waste "
+                        "producer, ISSUE 19)")
+    p.add_argument("--idle-think-s", type=float, default=2.0,
+                   help="seconds an idle-class request thinks before "
+                        "sending (not counted in its latency)")
+    p.add_argument("--churn-tenants", type=int, default=0,
+                   help="carve this many tenants (below the idle "
+                        "block) as 'churn' class: their system prefix "
+                        "cycles through --churn-cycle variants, so a "
+                        "cache smaller than the variant set evicts "
+                        "pages it will re-reference (the kv_thrash "
+                        "producer, ISSUE 19)")
+    p.add_argument("--churn-cycle", type=int, default=8,
+                   help="distinct prefix variants a churn tenant "
+                        "cycles through")
     p.add_argument("--stream", action="store_true",
                    help="SSE mode: measure time-to-first-token and "
                         "inter-token gaps")
@@ -465,6 +532,10 @@ def main(argv=None) -> int:
     if args.stall_timeout_s is not None and not args.stream:
         p.error("--stall-timeout-s requires --stream (hung-stream "
                 "detection reads the SSE event gaps)")
+    if args.idle_tenants + args.churn_tenants > args.tenants:
+        p.error("--idle-tenants + --churn-tenants cannot exceed "
+                "--tenants (they carve classes out of the tenant "
+                "range)")
     _, rc = run(args)
     return rc
 

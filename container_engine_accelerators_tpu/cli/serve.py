@@ -797,6 +797,7 @@ class ContinuousEngine:
         if trace_ctx:
             trace.start(rid, force=bool(trace_ctx.get("force")),
                         tags=trace_ctx.get("tags"))
+            self._note_tenant(rid, trace_ctx.get("tags"))
         self.recorder.enqueue(rid)
         self.queue.put((tuple(tokens), max_new_tokens, temperature, fut,
                         stream, rid))
@@ -848,6 +849,11 @@ class ContinuousEngine:
             self.recorder.set_slots(active=0, total=self.max_slots)
 
     # ---------- engine hooks (overridden by the paged engine) ----------
+
+    def _note_tenant(self, rid: int, tags: dict | None) -> None:
+        """Tenant attribution hook: the paged engine records the
+        request's tenant/class tags so admitted pages carry an owner
+        in the thermal census. No-op on the slot engine."""
 
     def _weights_quantized(self) -> bool:
         from container_engine_accelerators_tpu.ops.quant import QuantWeight
@@ -1736,7 +1742,9 @@ class PagedContinuousEngine(ContinuousEngine):
                  mesh=None,
                  recorder: RequestRecorder | None = None,
                  speculate: str = "off", spec_k: int = 4,
-                 draft_layers: int = 2, engine_core: str = "async"):
+                 draft_layers: int = 2, engine_core: str = "async",
+                 thermal_hot_s: float = 2.0, thermal_warm_s: float = 10.0,
+                 thermal_interval_s: float = 1.0):
         import math
 
         from container_engine_accelerators_tpu.models.decode import (
@@ -1777,6 +1785,20 @@ class PagedContinuousEngine(ContinuousEngine):
         # their forward is skipped entirely at admission.
         self.prefix_cap = prefix_cap
         self.prefix_pages_reused = 0
+        # KV thermal observability (ISSUE 19): census cadence +
+        # idle-bucket thresholds, tenant attribution by rid (tags ride
+        # trace_ctx from loadgen's X-Trace-Tags header), and the
+        # rereference watermark that turns PrefixIndex thrash counts
+        # into flight-recorder events.
+        self.thermal_hot_s = thermal_hot_s
+        self.thermal_warm_s = thermal_warm_s
+        self.thermal_interval_s = thermal_interval_s
+        self._tenants: "collections.OrderedDict[int, tuple[str, str]]" \
+            = collections.OrderedDict()
+        self._tenants_cap = 4096
+        self._last_census_ts = 0.0
+        self._last_census: dict | None = None
+        self._rerefs_seen = 0
         super().__init__(params, cfg, max_slots=max_slots,
                          max_len=max_len, prompt_bucket=page,
                          max_prompt_len=max_prompt_len,
@@ -1820,12 +1842,46 @@ class PagedContinuousEngine(ContinuousEngine):
                 while index.evict_lru():
                     pass
             super().recover_after_worker_death(err)
+            self._tenants.clear()
             alloc = getattr(self, "_alloc", None)
             total = (alloc.n_pages - 1) if alloc is not None \
                 else max(self.pool_pages - 1, 0)
             self.recorder.set_kv_pages(used=0, total=total)
+            if alloc is not None:
+                # Every page was reclaimed above; publish the drained
+                # census so temperature gauges don't hold stale heat.
+                self.recorder.set_kv_thermal(self._thermal_census_locked())
 
     # ---------- hooks ----------
+
+    def _note_tenant(self, rid: int, tags: dict | None) -> None:
+        if not tags:
+            return
+        tenant = tags.get("tenant")
+        if tenant is None:
+            return
+        self._tenants[rid] = (str(tenant), str(tags.get("class", "-")))
+        while len(self._tenants) > self._tenants_cap:
+            self._tenants.popitem(last=False)
+
+    def thermal_census(self, top_n: int = 16) -> dict:
+        """Live thermal snapshot of the page pool (the /debugz?kv=1
+        payload). Active-slot rows are pinned hot — the device reads
+        them every tick — and prefix-index rows carry the cold-
+        evictable linkage. Under _mu: slot/index state must not move
+        mid-census."""
+        with self._mu:
+            return self._thermal_census_locked(top_n=top_n)
+
+    def _thermal_census_locked(self, top_n: int = 16) -> dict:
+        active: set[int] = set()
+        for sl in self._slots:
+            if sl is not None:
+                active.update(sl["rows"])
+        return self._alloc.thermal_census(
+            hot_s=self.thermal_hot_s, warm_s=self.thermal_warm_s,
+            active_rows=active, prefix_rows=self._index.rows_held(),
+            top_n=top_n)
 
     def _make_fns(self):
         from container_engine_accelerators_tpu.models.decode import (
@@ -1872,6 +1928,9 @@ class PagedContinuousEngine(ContinuousEngine):
             self._cache = factory()
         self._alloc = PageAllocator(self.pool_pages)
         self._index = PrefixIndex(self._alloc, cap=self.prefix_cap)
+        self._rerefs_seen = 0
+        self._last_census = None
+        self._last_census_ts = 0.0
         # Requests whose admission is currently blocked on free pages:
         # a req/page_stall span stays open from the first failed alloc
         # to the successful admit (tools/trace_report.py attributes the
@@ -1905,6 +1964,34 @@ class PagedContinuousEngine(ContinuousEngine):
             used=self._alloc.n_pages - 1 - self._alloc.free_pages,
             total=self._alloc.n_pages - 1)
         self.recorder.set_prefix_cache_pages(self._index.pages_held())
+        # Throttled thermal census (ISSUE 19): O(pages) host work at
+        # ~1 Hz, not per tick — the perf gate's decode_tick_thermal_ms
+        # pins the amortised cost inside the untracked tick's noise
+        # band.
+        now = time.monotonic()
+        if now - self._last_census_ts >= self.thermal_interval_s:
+            self._last_census_ts = now
+            census = self.thermal_census()
+            self._last_census = census
+            self.recorder.set_kv_thermal(census)
+            self._emit_thrash_events()
+
+    def _emit_thrash_events(self) -> None:
+        """Flush PrefixIndex evicted-then-rereferenced observations to
+        the flight recorder: one kv/thrash instant per rereference
+        (the doctor's kv_thrash detector counts them) plus the
+        cumulative counter track."""
+        new = self._index.rereferences - self._rerefs_seen
+        if new <= 0:
+            return
+        ages = list(self._index.reref_ages)[-new:]
+        self._rerefs_seen = self._index.rereferences
+        if events.enabled():
+            for _, age in ages:
+                events.instant("kv/thrash", "kv",
+                               {"age_s": round(age, 3)})
+            events.counter("serve/kv_thrash",
+                           {"rerefs": self._index.rereferences})
 
     def _preempt_youngest(self) -> int | None:
         """Free the most recently admitted request's pages and requeue
@@ -1990,6 +2077,22 @@ class PagedContinuousEngine(ContinuousEngine):
             # matched — the hit-rate gauge divides these two counters.
             self.recorder.prefix_lookup(hit=bool(shared))
         all_rows = shared + fresh
+        owner = self._tenants.get(rid)
+        if owner is not None:
+            self._alloc.set_owner(all_rows, owner[0], owner[1])
+        if events.enabled():
+            # Touch-trace record (ISSUE 19): one instant per admitted
+            # prompt with its full-page chain hashes — the JSONL
+            # sidecar stream tools/kv_report.py replays through the
+            # tier simulator.
+            events.instant("kv/prefix_access", "kv", {
+                "rid": rid,
+                "tenant": owner[0] if owner else None,
+                "class": owner[1] if owner else None,
+                "keys": [k for k, _ in keys],
+                "hit_pages": len(shared),
+                "full_pages": n_full,
+            })
         table_row = all_rows + [0] * (self.max_pages - len(all_rows))
         self._cache = self._set_pages_fn(
             self._cache, jnp.int32(slot_idx),
@@ -2409,6 +2512,15 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-cache-cap", type=int, default=256,
                    help="paged engine: max retained full prompt pages "
                         "in the prefix cache (0 disables sharing)")
+    p.add_argument("--thermal-hot-s", type=float, default=2.0,
+                   help="paged engine: pages idle <= this many seconds "
+                        "count hot in the KV thermal census")
+    p.add_argument("--thermal-warm-s", type=float, default=10.0,
+                   help="paged engine: pages idle <= this (and > "
+                        "--thermal-hot-s) count warm; beyond is cold")
+    p.add_argument("--thermal-interval-s", type=float, default=1.0,
+                   help="paged engine: seconds between KV thermal "
+                        "census snapshots (O(pages) host work each)")
     p.add_argument("--prefill-chunk", type=int, default=512,
                    help="continuous/paged engine: max prompt tokens "
                         "prefilled between decode steps (bounds the "
@@ -2659,7 +2771,9 @@ def main(argv=None) -> int:
             prefix_cap=args.prefix_cache_cap,
             prefill_chunk=args.prefill_chunk,
             prefill_workers=args.prefill_workers, mesh=mesh,
-            recorder=recorder, **spec_kw)
+            recorder=recorder, thermal_hot_s=args.thermal_hot_s,
+            thermal_warm_s=args.thermal_warm_s,
+            thermal_interval_s=args.thermal_interval_s, **spec_kw)
     elif args.engine == "continuous":
         engine = ContinuousEngine(params, cfg, max_slots=args.max_batch,
                                   max_len=args.max_len,
@@ -2739,6 +2853,10 @@ def main(argv=None) -> int:
             return snap
 
         exporter.state_provider = _state_snapshot
+        if args.engine == "paged":
+            # /debugz?kv=1: the live cold-page census with tenant and
+            # prefix linkage (metrics/serving.py `kv_provider`).
+            exporter.kv_provider = engine.thermal_census
         exporter.start_background()
         log.info("request metrics on :%d/metrics", exporter.bound_port)
     server = make_server(engine, args.port, replica_id=replica_id)

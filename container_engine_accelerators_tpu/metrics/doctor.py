@@ -154,6 +154,17 @@ class DoctorConfig:
     # pages) longer than page_stall_s in the fast window.
     page_stall_s: float = 0.25
     page_stall_n: int = 2
+    # kv_cold_waste: every serve/kv_thermal sample in the fast window
+    # (at least kv_cold_min_samples of them) has a cold-bucket share
+    # at/above kv_cold_share WHILE admission is page-limited
+    # (req/page_stall spans in the window) — HBM held by dead pages
+    # that live requests are stalling for.
+    kv_cold_share: float = 0.5
+    kv_cold_min_samples: int = 3
+    # kv_thrash: this many kv/thrash instants (prefix pages evicted
+    # then re-referenced within the index's horizon) in the fast
+    # window — the cache is cycling pages it still needs.
+    kv_thrash_n: int = 3
     # fleet_imbalance (metrics/fleet.py): sustained cross-replica skew
     # bands — queue-depth gap, KV-headroom fraction gap, and the
     # per-replica sample floor before either comparison is trusted.
@@ -722,6 +733,98 @@ class PageStallDetector(Detector):
             f"{worst['dur']:.2f}s, rid {worst['id']})", 0.85, ev)]
 
 
+class KvColdWasteDetector(Detector):
+    """HBM wasted on dead KV pages (ISSUE 19): EVERY serve/kv_thermal
+    census sample in the fast window shows a cold-bucket share at or
+    above kv_cold_share, while admission is page-limited in the same
+    window (req/page_stall spans, open ones included). Evidence names
+    the tenant holding the most cold pages from the latest
+    serve/kv_tenant_cold sample — the occupant the tier (or a smaller
+    --prefix-cache-cap) would evict first."""
+
+    cls = "kv_cold_waste"
+
+    def check(self, sig):
+        series = sig.series("serve/kv_thermal", sig.fast_since)
+        if len(series) < sig.config.kv_cold_min_samples:
+            return []
+        shares = []
+        for _, v in series:
+            total = (v.get("hot", 0) + v.get("warm", 0)
+                     + v.get("cold", 0))
+            if total <= 0:
+                return []  # an empty pool has no waste
+            shares.append(v.get("cold", 0) / total)
+        if min(shares) < sig.config.kv_cold_share:
+            return []  # sustained means every sample in the window
+        stalls = sig.async_spans("req/page_stall", sig.fast_since,
+                                 include_open=True)
+        if not stalls:
+            return []  # cold pages nobody is waiting on are free HBM
+        ts_last, last = series[-1]
+        tenant_cold: dict = {}
+        coldest_tenant = None
+        tcold = sig.series("serve/kv_tenant_cold", sig.fast_since)
+        if tcold:
+            tenant_cold = dict(tcold[-1][1])
+            if tenant_cold:
+                coldest_tenant = max(tenant_cold,
+                                     key=lambda t: tenant_cold[t])
+        ev = {"cold_share_min": round(min(shares), 3),
+              "cold_share_last": round(shares[-1], 3),
+              "threshold": sig.config.kv_cold_share,
+              "samples": len(shares),
+              "window_s": sig.config.fast_window_s,
+              "cold_pages": last.get("cold"),
+              "working_set_pages": last.get("wss"),
+              "page_stalls": len(stalls),
+              "tenant_cold_pages": tenant_cold,
+              "coldest_tenant": coldest_tenant,
+              "events": [_evidence_event(
+                  {"name": "serve/kv_thermal", "ph": "C", "ts": ts,
+                   "args": v}) for ts, v in series[-5:]]}
+        who = (f"; coldest tenant {coldest_tenant} holds "
+               f"{tenant_cold.get(coldest_tenant)} of them"
+               if coldest_tenant is not None else "")
+        return [Finding(
+            self.cls, "serve",
+            f"{last.get('cold', 0)} KV pages ({shares[-1] * 100:.0f}% "
+            f"of the pool) stayed cold for the whole "
+            f"{sig.config.fast_window_s:.0f}s window while "
+            f"{len(stalls)} admissions stalled on free pages{who}",
+            0.8, ev)]
+
+
+class KvThrashDetector(Detector):
+    """Prefix-cache thrash (ISSUE 19): kv/thrash instants — a prefix
+    page evicted under pressure and re-referenced within the index's
+    horizon — reaching kv_thrash_n in the fast window. Each of those
+    misses recomputes a page that WAS resident: the pool/cache is
+    sized below the prefix working set (raise --prefix-cache-cap or
+    --pool-pages, or offload the cold tail to the host tier)."""
+
+    cls = "kv_thrash"
+
+    def check(self, sig):
+        hits = sig.named("kv/thrash", "i", sig.fast_since)
+        if len(hits) < sig.config.kv_thrash_n:
+            return []
+        ages = sorted(e.get("args", {}).get("age_s", 0.0)
+                      for e in hits)
+        ev = {"count": len(hits),
+              "threshold_n": sig.config.kv_thrash_n,
+              "window_s": sig.config.fast_window_s,
+              "reref_age_p50_s": ages[len(ages) // 2],
+              "reref_age_max_s": ages[-1],
+              "events": [_evidence_event(e) for e in hits[-5:]]}
+        return [Finding(
+            self.cls, "serve",
+            f"{len(hits)} prefix pages evicted then re-referenced "
+            f"within {ages[-1]:.1f}s in the last "
+            f"{sig.config.fast_window_s:.0f}s — the prefix cache is "
+            f"cycling pages it still needs", 0.85, ev)]
+
+
 def default_detectors() -> list[Detector]:
     # Lazy import: fleet.py imports Detector/Finding from this module
     # at its top, so the fleet registry slice must load inside the
@@ -733,7 +836,8 @@ def default_detectors() -> list[Detector]:
             OomPrecursorDetector(), QueueCollapseDetector(),
             StragglerDetector(), HealthStormDetector(),
             SloBurnDetector(), QueueStormDetector(),
-            PageStallDetector(), *fleet.fleet_detectors()]
+            PageStallDetector(), KvColdWasteDetector(),
+            KvThrashDetector(), *fleet.fleet_detectors()]
 
 
 # ---------- detector helpers ----------

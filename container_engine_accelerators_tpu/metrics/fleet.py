@@ -182,11 +182,23 @@ class ReplicaState:
         bad = self._snap("slo_windows", kind, "bad", default=0)
         return int(n), int(bad)
 
+    def kv_cold_pages(self) -> float | None:
+        """Cold-bucket page count from the replica's thermal census
+        (ISSUE 19). None when the replica predates the kv_thermal
+        snapshot block or runs a non-paged engine — a mixed-version
+        fleet must stay green, so absence is not an error."""
+        v = self._snap("kv_thermal", "buckets", "cold")
+        return None if v is None else float(v)
+
+    def kv_working_set(self) -> float | None:
+        v = self._snap("kv_thermal", "working_set_pages")
+        return None if v is None else float(v)
+
     def series_values(self) -> dict:
         """The fleet/replica/<rid> counter sample: the routing inputs
         plus liveness, all numeric (Chrome counter tracks)."""
         used, total = self.kv_pages()
-        return {
+        out = {
             "state": STATE_LEVEL[self.state],
             "queued": self.queue_depth(),
             "active": self.active_slots(),
@@ -196,6 +208,10 @@ class ReplicaState:
             "restarts": float(self._snap("worker_restarts", default=0)),
             "worker_alive": 1.0 if self._snap("worker_alive") else 0.0,
         }
+        cold = self.kv_cold_pages()
+        if cold is not None:  # absent on pre-thermal replicas
+            out["cold_pages"] = cold
+        return out
 
     def row(self, now: float) -> dict:
         """Debug row for fleetmon's own /debugz?state=1."""
@@ -297,6 +313,9 @@ class FleetState:
             lookups = hits = 0.0
             slo = {"ttft": {"n": 0, "bad": 0},
                    "tpot": {"n": 0, "bad": 0}}
+            cold_total: float | None = None
+            coldest_rid: str | None = None
+            coldest_pages = -1.0
             for r in self._replicas.values():
                 counts[r.state] += 1
                 if r.state != STATE_UP:
@@ -306,6 +325,12 @@ class FleetState:
                 lk, h = r.prefix_cache()
                 lookups += lk
                 hits += h
+                cold = r.kv_cold_pages()
+                if cold is not None:
+                    cold_total = (cold_total or 0.0) + cold
+                    if cold > coldest_pages:
+                        coldest_pages = cold
+                        coldest_rid = r.rid
                 for kind in ("ttft", "tpot"):
                     n, bad = r.slo_window(kind)
                     slo[kind]["n"] += n
@@ -319,6 +344,11 @@ class FleetState:
                 "prefix_lookups": lookups,
                 "prefix_hit_rate": (hits / lookups) if lookups else None,
                 "slo": slo,
+                # Thermal rollup (ISSUE 19): None when NO up replica
+                # publishes kv_thermal yet (mixed-version fleet) —
+                # distinct from a genuine 0 cold pages.
+                "kv_cold_pages": cold_total,
+                "coldest_replica": coldest_rid,
             }
 
     def debugz(self, now: float | None = None) -> dict:
@@ -491,6 +521,24 @@ class FleetExporter(ExporterBase):
             "fleet_replica_staleness_seconds",
             "Seconds since the replica's last successful scrape",
             ["replica"], registry=reg)
+        # Thermal rollup (ISSUE 19): the router/offload signal — how
+        # much HBM fleet-wide sits on cold pages, and which replica
+        # holds the most (fleet_kv_coldest_replica carries the rid as
+        # a label with value 1).
+        self.cold_g = Gauge(
+            "fleet_kv_cold_pages",
+            "Cold-bucket KV pages summed over UP replicas publishing "
+            "a thermal census (0 until any replica does)", registry=reg)
+        self.r_cold = Gauge(
+            "fleet_replica_kv_cold_pages",
+            "Per-replica cold-bucket KV pages (last good snapshot; "
+            "absent for replicas without a thermal census)",
+            ["replica"], registry=reg)
+        self.coldest_g = Gauge(
+            "fleet_kv_coldest_replica",
+            "1 on the UP replica holding the most cold KV pages, 0 "
+            "elsewhere — the offload/routing attribution target",
+            ["replica"], registry=reg)
         self.scrapes = Counter(
             "fleet_scrapes", "Scrape attempts by replica and outcome",
             ["replica", "outcome"], registry=reg)
@@ -509,6 +557,8 @@ class FleetExporter(ExporterBase):
         if agg["prefix_hit_rate"] is not None:
             self.prefix_g.set(agg["prefix_hit_rate"])
         self.version_g.set(agg["version"])
+        self.cold_g.set(agg.get("kv_cold_pages") or 0.0)
+        coldest = agg.get("coldest_replica")
         now = time.monotonic()
         for r in self.scraper.state.replicas():
             lab = r.rid
@@ -521,6 +571,11 @@ class FleetExporter(ExporterBase):
             gap = r.host_gap()
             if gap is not None:
                 self.r_hostgap.labels(lab).set(gap)
+            cold = r.kv_cold_pages()
+            if cold is not None:
+                self.r_cold.labels(lab).set(cold)
+            self.coldest_g.labels(lab).set(
+                1.0 if lab == coldest else 0.0)
             self.r_restarts.labels(lab).set(
                 r.series_values()["restarts"])
             if r.last_ok_ts is not None:

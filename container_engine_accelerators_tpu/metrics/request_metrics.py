@@ -180,6 +180,29 @@ class RequestRecorder:
             "serve_prefix_hit_rate",
             "prefix_hits / prefix_lookups over this process's "
             "lifetime (paged engine)", registry=reg)
+        # KV thermal families (ISSUE 19): fed by the engine's periodic
+        # PageAllocator.thermal_census() via set_kv_thermal().
+        self.kv_pages_by_temperature = Gauge(
+            "serve_kv_pages_by_temperature",
+            "KV pool pages by idle-time bucket (hot/warm/cold under "
+            "the engine's thermal thresholds; active-slot pages are "
+            "pinned hot)", ["bucket"], registry=reg)
+        self.kv_working_set_pages = Gauge(
+            "serve_kv_working_set_pages",
+            "Working-set-size estimate in pages (p90 sampled reuse "
+            "distance + 1; falls back to the recently-touched set "
+            "before any reuse is observed)", registry=reg)
+        self.kv_tenant_pages = Gauge(
+            "serve_kv_tenant_pages",
+            "KV pool pages attributed to each tenant (first-owner "
+            "attribution; 'unowned' = no tenant tag on the admitting "
+            "request)", ["tenant"], registry=reg)
+        self.kv_page_idle = Histogram(
+            "serve_kv_page_idle_seconds",
+            "Per-page idle time at census (seconds since last host "
+            "touch; active-slot pages report 0)",
+            buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+                     300.0, 600.0), registry=reg)
 
         self.requests = Counter(
             "serve_requests", "Requests closed, by outcome",
@@ -269,6 +292,7 @@ class RequestRecorder:
         self._last_kv = (0, 0)
         self._last_pools = (0, 0)
         self._last_prefix_pages = 0
+        self._last_thermal: dict | None = None
 
     # ---------- lifecycle edges ----------
 
@@ -441,6 +465,49 @@ class RequestRecorder:
         self._last_prefix_pages = pages
         self.prefix_cache_pages.set(pages)
 
+    def set_kv_thermal(self, census: dict) -> None:
+        """Publish one PageAllocator.thermal_census() snapshot: the
+        temperature/WSS/tenant gauge families, the per-page idle
+        histogram, the flight-recorder counter tracks the doctor's
+        kv_cold_waste detector reads, and the state_snapshot() shadow
+        the fleet scraper rolls up."""
+        buckets = census.get("buckets") or {}
+        tenants = census.get("tenants") or {}
+        wss = census.get("working_set_pages")
+        with self._lock:
+            self._last_thermal = {
+                "buckets": {b: int(buckets.get(b, 0))
+                            for b in ("hot", "warm", "cold")},
+                "working_set_pages": wss,
+                "cold_evictable": census.get("cold_evictable"),
+                "cold_orphan": census.get("cold_orphan"),
+                "tenants": {t: int(info.get("pages", 0))
+                            for t, info in tenants.items()},
+                "tenants_cold": {t: int(info.get("cold", 0))
+                                 for t, info in tenants.items()},
+            }
+        for b in ("hot", "warm", "cold"):
+            self.kv_pages_by_temperature.labels(bucket=b).set(
+                buckets.get(b, 0))
+        if wss is not None:
+            self.kv_working_set_pages.set(wss)
+        for t, info in tenants.items():
+            self.kv_tenant_pages.labels(tenant=str(t)).set(
+                info.get("pages", 0))
+        for v in census.get("idle_values") or ():
+            self.kv_page_idle.observe(v)
+        if events.enabled():
+            events.counter("serve/kv_thermal", {
+                "hot": buckets.get("hot", 0),
+                "warm": buckets.get("warm", 0),
+                "cold": buckets.get("cold", 0),
+                "wss": wss or 0,
+            })
+            tenant_cold = {str(t): int(info.get("cold", 0))
+                           for t, info in tenants.items()}
+            if tenant_cold:
+                events.counter("serve/kv_tenant_cold", tenant_cold)
+
     def set_pool_depths(self, prefill: int, decode: int) -> None:
         """Per-pool depth gauges (disaggregated layout); the twin
         flight-recorder counter is what the doctor's two-queue
@@ -593,12 +660,13 @@ class RequestRecorder:
             prefill_d, decode_d = self._last_pools
             prefix_pages = self._last_prefix_pages
             lookups, hits = self._prefix_lookups, self._prefix_hits
+            thermal = self._last_thermal
         since = now - STATE_SLO_WINDOW_S
         ttft_n, ttft_bad = self.window_counts("ttft", since,
                                               STATE_SLO_TTFT_S)
         tpot_n, tpot_bad = self.window_counts("tpot", since,
                                               STATE_SLO_TPOT_S)
-        return {
+        out = {
             # tpulint: allow=TPL004(epoch stamp for cross-process
             # alignment, not a duration)
             "t": round(time.time(), 3),
@@ -621,6 +689,11 @@ class RequestRecorder:
                          "threshold_s": STATE_SLO_TPOT_S},
             },
         }
+        if thermal is not None:
+            # Absent entirely on older replicas / non-paged engines;
+            # the fleet scrape parser tolerates the missing key.
+            out["kv_thermal"] = thermal
+        return out
 
 
 class ServeMetricsExporter(ExporterBase):

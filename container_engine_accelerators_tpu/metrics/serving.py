@@ -130,6 +130,21 @@ class ExporterBase:
                     d = doctor.get_active()
                     payload["doctor"] = (d.debugz() if d is not None
                                          else {"active": False})
+                if qs.get("kv", ["0"])[0] not in ("", "0"):
+                    # KV thermal census (ISSUE 19): live
+                    # PageAllocator.thermal_census() including the
+                    # top-N coldest pages with tenant + prefix
+                    # linkage. Exporters opt in by setting a
+                    # `kv_provider` callable (cli/serve.py wires the
+                    # paged engine's census).
+                    provider = getattr(self, "kv_provider", None)
+                    if provider is not None:
+                        try:
+                            payload["kv"] = provider()
+                        except Exception:
+                            log.exception("/debugz kv provider failed")
+                            payload["kv"] = {
+                                "error": "kv provider failed"}
                 if qs.get("state", ["0"])[0] not in ("", "0"):
                     # Machine-readable engine state snapshot (ISSUE
                     # 18): the fleet scraper's structured half of the

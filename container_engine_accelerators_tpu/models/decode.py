@@ -12,7 +12,9 @@ traffic stays at O(tokens_read) per step.
 
 from __future__ import annotations
 
+import collections
 import functools
+import time
 from typing import NamedTuple
 
 import jax
@@ -901,7 +903,21 @@ class PageAllocator:
     when the last holder frees it. Shared pages are safe without
     copy-on-write because only FULL pages are ever shared and decode
     writes only at positions >= the slot's live length — a full shared
-    page is never a write target."""
+    page is never a write target.
+
+    Thermal tracking (ISSUE 19): every alloc/share stamps the row's
+    last-touch time, touch count and (lazily) owning tenant — plain
+    host dicts updated inside bookkeeping that already runs between
+    device steps, zero new device work. `thermal_census()` folds them
+    into an O(pages) hot/warm/cold snapshot with a sampled
+    reuse-distance profile; the serving engine exports it (/metrics,
+    /debugz?kv=1, fleet rollup) and tools/kv_report.py replays the
+    matching touch trace through a tier simulator."""
+
+    #: one reuse-distance sample per this many touches — the census
+    #: stays O(pages) and the per-touch cost stays O(1) amortised
+    #: (stack-distance walk is O(distance), paid on sampled touches).
+    REUSE_SAMPLE_EVERY = 16
 
     def __init__(self, n_pages: int):
         if n_pages < 2:
@@ -909,6 +925,60 @@ class PageAllocator:
         self._free = list(range(n_pages - 1, 0, -1))  # pop() -> low rows
         self._refs: dict[int, int] = {}
         self.n_pages = n_pages
+        # Thermal bookkeeping, all keyed by allocated row and dropped
+        # when the refcount hits zero — so census(after drain) is
+        # structurally empty, matching the leak invariant.
+        self.clock = time.monotonic  # test hook: inject fake time
+        self._alloc_ts: dict[int, float] = {}
+        self._last_touch: dict[int, float] = {}
+        self._touch_count: dict[int, int] = {}
+        # row -> (tenant, request class); first owner wins so shared
+        # prefix pages stay attributed to the tenant that paid for them.
+        self._owner: dict[int, tuple[str, str]] = {}
+        self._touch_seq = 0
+        # LRU stack of touched rows (MRU last) for Mattson stack
+        # distances; bounded by pool size since freed rows are removed.
+        self._stack: collections.OrderedDict[int, None] = \
+            collections.OrderedDict()
+        self._reuse_samples: collections.deque[int] = \
+            collections.deque(maxlen=1024)
+
+    def _touch(self, row: int, now: float) -> None:
+        self._touch_seq += 1
+        self._last_touch[row] = now
+        self._touch_count[row] = self._touch_count.get(row, 0) + 1
+        stack = self._stack
+        if row in stack:
+            if self._touch_seq % self.REUSE_SAMPLE_EVERY == 0:
+                d = 0
+                for r in reversed(stack):
+                    if r == row:
+                        break
+                    d += 1
+                self._reuse_samples.append(d)
+            stack.move_to_end(row)
+        else:
+            stack[row] = None  # first touch: infinite distance, unsampled
+
+    def touch(self, rows: list[int], now: float | None = None) -> None:
+        """Refresh last-touch on already-allocated rows (the engine
+        calls this when a page is re-read outside alloc/share, e.g. a
+        prefix hit that was served without new allocation)."""
+        t = self.clock() if now is None else now
+        for r in rows:
+            if r in self._refs:
+                self._touch(r, t)
+
+    def set_owner(self, rows: list[int], tenant: str | None,
+                  req_class: str | None = None) -> None:
+        """Attribute rows to a tenant/request class. First owner wins:
+        a prefix page shared by later tenants keeps its original
+        attribution (that tenant's pages are what sit resident)."""
+        if tenant is None:
+            return
+        for r in rows:
+            if r in self._refs and r not in self._owner:
+                self._owner[r] = (str(tenant), str(req_class or "-"))
 
     @property
     def free_pages(self) -> int:
@@ -935,8 +1005,11 @@ class PageAllocator:
         if n > len(self._free):
             return None
         rows = [self._free.pop() for _ in range(n)]
+        now = self.clock()
         for r in rows:
             self._refs[r] = 1
+            self._alloc_ts[r] = now
+            self._touch(r, now)
         return rows
 
     def share(self, row: int) -> int:
@@ -944,6 +1017,7 @@ class PageAllocator:
         if self._refs.get(row, 0) < 1:
             raise ValueError(f"share of unallocated page row {row}")
         self._refs[row] += 1
+        self._touch(row, self.clock())
         return row
 
     def free(self, rows: list[int]) -> None:
@@ -959,6 +1033,122 @@ class PageAllocator:
             if self._refs[r] == 0:
                 del self._refs[r]
                 self._free.append(r)
+                self._alloc_ts.pop(r, None)
+                self._last_touch.pop(r, None)
+                self._touch_count.pop(r, None)
+                self._owner.pop(r, None)
+                self._stack.pop(r, None)
+
+    def thermal_census(self, *, hot_s: float = 2.0, warm_s: float = 10.0,
+                       now: float | None = None,
+                       active_rows=(), prefix_rows=(),
+                       top_n: int = 8) -> dict:
+        """O(pages) thermal snapshot of the pool. `active_rows` are
+        rows referenced by live decode slots: the device reads them
+        every tick, so they are pinned hot regardless of last host
+        touch (the refcount-vs-temperature invariant — an active page
+        can never report cold). `prefix_rows` are rows retained by the
+        PrefixIndex; a cold page in that set is evictable, a cold page
+        in neither set is an orphan (leak indicator)."""
+        t = self.clock() if now is None else now
+        active = set(active_rows)
+        prefix = set(prefix_rows)
+        buckets = {"hot": 0, "warm": 0, "cold": 0}
+        tenants: dict[str, dict[str, int]] = {}
+        idles: list[float] = []
+        ages: list[float] = []
+        cold_evictable = cold_orphan = 0
+        per_page: list[tuple[float, int]] = []
+        for row in self._refs:
+            pinned = row in active
+            idle = 0.0 if pinned else max(t - self._last_touch.get(row, t),
+                                          0.0)
+            age = max(t - self._alloc_ts.get(row, t), 0.0)
+            if idle <= hot_s:
+                b = "hot"
+            elif idle <= warm_s:
+                b = "warm"
+            else:
+                b = "cold"
+            buckets[b] += 1
+            idles.append(idle)
+            ages.append(age)
+            owner = self._owner.get(row)
+            key = owner[0] if owner else "unowned"
+            trec = tenants.setdefault(key, {"pages": 0, "cold": 0})
+            trec["pages"] += 1
+            if b == "cold":
+                trec["cold"] += 1
+                if row in prefix:
+                    cold_evictable += 1
+                else:
+                    cold_orphan += 1
+            per_page.append((idle, row))
+        per_page.sort(reverse=True)
+        coldest = []
+        for idle, row in per_page[:max(top_n, 0)]:
+            owner = self._owner.get(row)
+            coldest.append({
+                "row": row,
+                "idle_s": round(idle, 3),
+                "age_s": round(max(t - self._alloc_ts.get(row, t), 0.0), 3),
+                "touches": self._touch_count.get(row, 0),
+                "refs": self._refs.get(row, 0),
+                "tenant": owner[0] if owner else None,
+                "class": owner[1] if owner else None,
+                "prefix": row in prefix,
+                "active": row in active,
+            })
+        rd = sorted(self._reuse_samples)
+        if rd:
+            wss = _percentile(rd, 0.90) + 1  # distance d hits in a
+            # cache holding d+1 pages, so WSS = p90 stack distance + 1
+        else:
+            # No reuse observed yet: the recently-touched set is the
+            # only working-set proxy available.
+            wss = buckets["hot"] + buckets["warm"]
+        return {
+            "t": t,
+            "hot_s": hot_s,
+            "warm_s": warm_s,
+            "pages_total": self.n_pages - 1,
+            "pages_in_use": self.pages_in_use,
+            "free_pages": len(self._free),
+            "buckets": buckets,
+            "active_pages": len(active & self._refs.keys()),
+            "prefix_pages": len(prefix & self._refs.keys()),
+            "cold_evictable": cold_evictable,
+            "cold_orphan": cold_orphan,
+            "idle_s": _pct_summary(idles),
+            "age_s": _pct_summary(ages),
+            "idle_values": [round(v, 3) for v in idles],
+            "tenants": tenants,
+            "reuse_distance": {
+                "samples": len(rd),
+                "p50": _percentile(rd, 0.50) if rd else None,
+                "p90": _percentile(rd, 0.90) if rd else None,
+            },
+            "working_set_pages": int(wss),
+            "touches_total": self._touch_seq,
+            "coldest": coldest,
+        }
+
+
+def _percentile(sorted_vals, q: float):
+    """Nearest-rank percentile of an already-sorted sequence."""
+    if not sorted_vals:
+        return None
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _pct_summary(vals: list[float]) -> dict:
+    s = sorted(vals)
+    return {
+        "p50": round(_percentile(s, 0.50), 3) if s else None,
+        "p90": round(_percentile(s, 0.90), 3) if s else None,
+        "max": round(s[-1], 3) if s else None,
+    }
 
 
 class PrefixIndex:
@@ -979,13 +1169,24 @@ class PrefixIndex:
     pages to a request — wrong completions with no error (vLLM-style
     prefix caches verify the same way)."""
 
-    def __init__(self, alloc: PageAllocator, cap: int = 256):
-        import collections
+    def __init__(self, alloc: PageAllocator, cap: int = 256,
+                 reref_horizon_s: float = 30.0):
         self.alloc = alloc
         self.cap = cap
         # hash -> (pool row, page token tuple)
         self._lru: "collections.OrderedDict[int, tuple[int, tuple]]" = \
             collections.OrderedDict()
+        # Thrash tracking (ISSUE 19): hashes evicted under pressure,
+        # with eviction time. A later match() miss on one of these
+        # within `reref_horizon_s` is an evicted-then-re-referenced
+        # page — the prefix would have hit had it stayed resident.
+        self.reref_horizon_s = reref_horizon_s
+        self._evicted: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._evicted_cap = max(4 * cap, 64)
+        self.rereferences = 0  # cumulative evicted-then-rereferenced
+        self.reref_ages: collections.deque[tuple[float, float]] = \
+            collections.deque(maxlen=256)  # (ts, eviction age s)
 
     @staticmethod
     def chain_keys(tokens, page: int,
@@ -1009,6 +1210,13 @@ class PrefixIndex:
         for h, block in keys:
             hit = self._lru.get(h)
             if hit is None or hit[1] != block:
+                if hit is None and h in self._evicted:
+                    ev_ts = self._evicted.pop(h)
+                    now = self.alloc.clock()
+                    age = max(now - ev_ts, 0.0)
+                    if age <= self.reref_horizon_s:
+                        self.rereferences += 1
+                        self.reref_ages.append((now, age))
                 break
             self._lru.move_to_end(h)
             rows.append(self.alloc.share(hit[0]))
@@ -1019,23 +1227,32 @@ class PrefixIndex:
         if h in self._lru:
             self._lru.move_to_end(h)
             return
+        self._evicted.pop(h, None)
         self._lru[h] = (self.alloc.share(row), block)
         if len(self._lru) > self.cap:
             self.evict_lru()
+
+    def rows_held(self) -> set[int]:
+        """Distinct pool rows currently referenced by the cache (the
+        prefix linkage the thermal census reports per page)."""
+        return {row for row, _ in self._lru.values()}
 
     def pages_held(self) -> int:
         """Distinct pool rows the cache currently references. After a
         full request drain these are the ONLY legitimately-in-use
         pages, so `pages_in_use - pages_held() == 0` is the engine's
         leak invariant (chaos asserts it over /metrics)."""
-        return len({row for row, _ in self._lru.values()})
+        return len(self.rows_held())
 
     def evict_lru(self) -> bool:
         """Drop the least-recently-used entry (freeing its reference);
         False when empty."""
         if not self._lru:
             return False
-        _, (row, _) = self._lru.popitem(last=False)
+        h, (row, _) = self._lru.popitem(last=False)
+        self._evicted[h] = self.alloc.clock()
+        while len(self._evicted) > self._evicted_cap:
+            self._evicted.popitem(last=False)
         self.alloc.free([row])
         return True
 

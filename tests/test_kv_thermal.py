@@ -1,0 +1,711 @@
+"""KV thermal observability (ISSUE 19): page-temperature census math
+pinned on synthetic touch sequences, the refcount-vs-temperature
+invariant (active pages never report cold), drain-to-zero, per-tenant
+occupancy through the paged engine (preemption included), PrefixIndex
+evicted-then-re-referenced tracking, the recorder/exporter/fleet
+surfaces (mixed-version fleet tolerance), both doctor detectors
+(fire / quiet / dedup), the kv_report two-level LRU tier simulator
+pinned against a hand-computed trace, loadgen's idle/churn tenant
+classes, hbm_plan's host-tier pricing, and the idle-tenant e2e where
+kv_cold_waste names the idle tenant."""
+
+import json
+import time
+import types
+import urllib.request
+
+import jax
+import pytest
+
+from container_engine_accelerators_tpu.cli import loadgen
+from container_engine_accelerators_tpu.cli.serve import (
+    PagedContinuousEngine,
+)
+from container_engine_accelerators_tpu.metrics import doctor, events
+from container_engine_accelerators_tpu.metrics.doctor import (
+    Doctor,
+    DoctorConfig,
+    KvColdWasteDetector,
+    KvThrashDetector,
+    Signals,
+)
+from container_engine_accelerators_tpu.metrics.fleet import FleetState
+from container_engine_accelerators_tpu.metrics.request_metrics import (
+    RequestRecorder,
+    ServeMetricsExporter,
+)
+from container_engine_accelerators_tpu.models import init_params, llama_tiny
+from container_engine_accelerators_tpu.models.decode import (
+    PageAllocator,
+    PrefixIndex,
+)
+from tools import hbm_plan
+from tools.kv_report import (
+    build_report,
+    extract_accesses,
+    extract_observed,
+    simulate_tier,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_bus():
+    events._reset_for_tests()
+    yield
+    events._reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def timed_alloc(n_pages, t=0.0):
+    a = PageAllocator(n_pages)
+    a.clock = FakeClock(t)
+    return a
+
+
+# ---------- census math (pinned) ----------
+
+def test_census_buckets_and_idle_pinned():
+    a = timed_alloc(8)               # rows 1..7 usable
+    rows = a.alloc(3)                # all touched at t=0
+    a.clock.t = 5.0
+    a.touch(rows[:1])                # rows[0] re-touched at t=5
+    c = a.thermal_census(hot_s=2.0, warm_s=10.0, now=6.0)
+    assert c["pages_total"] == 7
+    assert c["pages_in_use"] == 3 and c["free_pages"] == 4
+    # rows[0] idle 1s -> hot; rows[1:] idle 6s -> warm.
+    assert c["buckets"] == {"hot": 1, "warm": 2, "cold": 0}
+    assert sorted(c["idle_values"]) == [1.0, 6.0, 6.0]
+    assert c["idle_s"] == {"p50": 6.0, "p90": 6.0, "max": 6.0}
+    assert c["age_s"]["max"] == 6.0  # all allocated at t=0
+    # Later, with no touches, everything goes cold.
+    c2 = a.thermal_census(hot_s=2.0, warm_s=10.0, now=20.0)
+    assert c2["buckets"] == {"hot": 0, "warm": 0, "cold": 3}
+    # Untracked rows (none in prefix/active) are orphans.
+    assert c2["cold_orphan"] == 3 and c2["cold_evictable"] == 0
+
+
+def test_census_coldest_ranking_and_linkage():
+    a = timed_alloc(8)
+    rows = a.alloc(3)
+    a.set_owner(rows[:2], "alice", "chat")
+    a.clock.t = 9.5
+    a.touch(rows[2:])                # rows[2] idle 0.5s -> hot
+    c = a.thermal_census(hot_s=1.0, warm_s=2.0, now=10.0,
+                         prefix_rows=rows[:1], top_n=2)
+    # Coldest-first, top_n bounded, with tenant + prefix linkage.
+    assert len(c["coldest"]) == 2
+    assert c["coldest"][0]["idle_s"] == 10.0
+    assert c["coldest"][0]["tenant"] == "alice"
+    assert {e["row"] for e in c["coldest"]} == set(rows[:2])
+    assert [e["prefix"] for e in c["coldest"]].count(True) == 1
+    assert c["cold_evictable"] == 1 and c["cold_orphan"] == 1
+
+
+def test_reuse_distance_and_wss_pinned():
+    a = timed_alloc(10)
+    a.REUSE_SAMPLE_EVERY = 1         # sample every re-touch
+    r = a.alloc(4)                   # stack (MRU last): r0 r1 r2 r3
+    a.touch([r[0]])                  # distance 3 -> stack r1 r2 r3 r0
+    a.touch([r[1]])                  # distance 3 -> stack r2 r3 r0 r1
+    a.touch([r[0]])                  # distance 1
+    c = a.thermal_census()
+    assert c["reuse_distance"] == {"samples": 3, "p50": 3, "p90": 3}
+    # WSS = p90 stack distance + 1 (distance d hits in a d+1 cache).
+    assert c["working_set_pages"] == 4
+
+
+def test_wss_fallback_before_any_reuse():
+    a = timed_alloc(8)
+    a.alloc(3)                       # first touches only: no samples
+    c = a.thermal_census(hot_s=2.0, warm_s=10.0, now=1.0)
+    assert c["reuse_distance"]["samples"] == 0
+    assert c["working_set_pages"] == 3  # hot+warm proxy
+
+
+def test_census_empty_after_drain():
+    """Acceptance: after a full drain the census reports zero pages in
+    every bucket — the per-row thermal dicts die with the refcount."""
+    a = timed_alloc(8)
+    rows = a.alloc(4)
+    a.set_owner(rows, "t0")
+    a.share(rows[0])
+    a.free(rows)
+    c = a.thermal_census(now=100.0)
+    # rows[0] is still shared: one (cold) page remains accounted.
+    assert c["buckets"] == {"hot": 0, "warm": 0, "cold": 1}
+    assert c["pages_in_use"] == 1
+    a.free(rows[:1])
+    c = a.thermal_census(now=100.0)
+    assert c["buckets"] == {"hot": 0, "warm": 0, "cold": 0}
+    assert c["pages_in_use"] == 0
+    assert c["tenants"] == {} and c["coldest"] == []
+    assert c["idle_values"] == []
+    assert not a._alloc_ts and not a._last_touch and not a._owner
+    assert not a._stack
+
+
+def test_refcount_vs_temperature_invariant():
+    """An active-slot page is read by the device every tick: no matter
+    how stale its host-side touch stamp, it must report hot with zero
+    idle — never cold, never evictable."""
+    a = timed_alloc(8)
+    rows = a.alloc(3)
+    c = a.thermal_census(hot_s=1.0, warm_s=2.0, now=1000.0,
+                         active_rows=rows)
+    assert c["buckets"] == {"hot": 3, "warm": 0, "cold": 0}
+    assert c["active_pages"] == 3
+    assert all(v == 0.0 for v in c["idle_values"])
+    assert c["cold_evictable"] == 0 and c["cold_orphan"] == 0
+    # Same stamps, nothing active: all cold.
+    c2 = a.thermal_census(hot_s=1.0, warm_s=2.0, now=1000.0)
+    assert c2["buckets"]["cold"] == 3
+
+
+def test_owner_first_wins_and_tenant_occupancy():
+    a = timed_alloc(8)
+    rows = a.alloc(3)
+    a.set_owner(rows[:2], "alice", "chat")
+    a.set_owner(rows, "bob", "batch")   # rows[:2] keep alice
+    a.set_owner(rows, None)             # no-op
+    c = a.thermal_census(hot_s=10.0, warm_s=20.0, now=1.0)
+    assert c["tenants"] == {"alice": {"pages": 2, "cold": 0},
+                            "bob": {"pages": 1, "cold": 0}}
+    a.free(rows[2:])
+    extra = a.alloc(1)                  # untagged
+    c2 = a.thermal_census(hot_s=10.0, warm_s=20.0, now=1.0)
+    assert c2["tenants"]["unowned"]["pages"] == 1
+    assert extra
+
+
+# ---------- PrefixIndex thrash tracking ----------
+
+def page_keys(tokens, page=4):
+    return PrefixIndex.chain_keys(tokens, page, len(tokens) // page)
+
+
+def test_prefix_index_evicted_reref_within_horizon():
+    a = timed_alloc(8)
+    idx = PrefixIndex(a, cap=1, reref_horizon_s=10.0)
+    k1 = page_keys([1, 2, 3, 4])[0]
+    k2 = page_keys([5, 6, 7, 8])[0]
+    (r1,) = a.alloc(1)
+    idx.insert(k1, r1)
+    a.free([r1])                     # index holds its own reference
+    a.clock.t = 2.0
+    (r2,) = a.alloc(1)
+    idx.insert(k2, r2)               # cap 1 -> evicts k1 at t=2
+    a.free([r2])
+    assert idx.pages_held() == 1 and idx.rows_held() == {r2}
+    a.clock.t = 7.0
+    assert idx.match([k1]) == []     # miss 5s after eviction
+    assert idx.rereferences == 1
+    assert idx.reref_ages[-1] == (7.0, 5.0)
+    # A second miss on the same hash is NOT a second rereference (the
+    # eviction record was consumed).
+    assert idx.match([k1]) == []
+    assert idx.rereferences == 1
+
+
+def test_prefix_index_reref_outside_horizon_not_counted():
+    a = timed_alloc(8)
+    idx = PrefixIndex(a, cap=1, reref_horizon_s=3.0)
+    k1 = page_keys([1, 2, 3, 4])[0]
+    k2 = page_keys([5, 6, 7, 8])[0]
+    (r1,) = a.alloc(1)
+    idx.insert(k1, r1)
+    a.free([r1])
+    (r2,) = a.alloc(1)
+    idx.insert(k2, r2)               # evicts k1 at t=0
+    a.free([r2])
+    a.clock.t = 50.0
+    idx.match([k1])                  # way past the horizon
+    assert idx.rereferences == 0
+
+
+def test_prefix_index_reinsert_clears_eviction_record():
+    a = timed_alloc(8)
+    idx = PrefixIndex(a, cap=1, reref_horizon_s=10.0)
+    k1 = page_keys([1, 2, 3, 4])[0]
+    k2 = page_keys([5, 6, 7, 8])[0]
+    (r1,) = a.alloc(1)
+    idx.insert(k1, r1)
+    a.free([r1])
+    (r2,) = a.alloc(1)
+    idx.insert(k2, r2)               # evicts k1
+    a.free([r2])
+    (r3,) = a.alloc(1)
+    idx.insert(k1, r3)               # back in: record must clear
+    a.free([r3])
+    assert k1 not in idx._evicted
+    assert len(idx.match([k1])) == 1  # a real hit, not a rereference
+    assert idx.rereferences == 0
+
+
+# ---------- recorder / exporter / fleet surfaces ----------
+
+def census_fixture():
+    a = timed_alloc(8)
+    rows = a.alloc(3)
+    a.set_owner(rows[:2], "alice", "chat")
+    a.clock.t = 9.5
+    a.touch(rows[2:])                # idle 0.5s -> hot; alice's cold
+    return a.thermal_census(hot_s=1.0, warm_s=2.0, now=10.0,
+                            prefix_rows=rows[:1])
+
+
+def sample(registry, name, **labels):
+    v = registry.get_sample_value(name, labels or None)
+    return v
+
+
+def test_recorder_kv_thermal_gauges_and_events():
+    rec = RequestRecorder()
+    events.enable(process_name="test")
+    rec.set_kv_thermal(census_fixture())
+    reg = rec.registry
+    assert sample(reg, "serve_kv_pages_by_temperature",
+                  bucket="cold") == 2.0
+    assert sample(reg, "serve_kv_pages_by_temperature",
+                  bucket="hot") == 1.0
+    assert sample(reg, "serve_kv_tenant_pages", tenant="alice") == 2.0
+    assert sample(reg, "serve_kv_working_set_pages") == 1.0
+    assert sample(reg, "serve_kv_page_idle_seconds_count") == 3.0
+    # Raw ring tuples: (ph, ts, tid, name, cat, dur, id, args).
+    evs = [e for e in events.get_bus().snapshot()
+           if e[3] == "serve/kv_thermal"]
+    assert evs and evs[-1][7]["cold"] == 2
+    tcold = [e for e in events.get_bus().snapshot()
+             if e[3] == "serve/kv_tenant_cold"]
+    assert tcold and tcold[-1][7]["alice"] == 2
+
+
+def test_state_snapshot_carries_thermal_block():
+    rec = RequestRecorder()
+    snap = rec.state_snapshot()
+    assert "kv_thermal" not in snap  # absent until a census lands
+    rec.set_kv_thermal(census_fixture())
+    snap = rec.state_snapshot()
+    th = snap["kv_thermal"]
+    assert th["buckets"] == {"hot": 1, "warm": 0, "cold": 2}
+    assert th["tenants"] == {"alice": 2, "unowned": 1}
+    assert th["tenants_cold"]["alice"] == 2
+    assert th["working_set_pages"] == 1  # hot+warm fallback, no reuse
+
+
+def test_debugz_kv_endpoint():
+    rec = RequestRecorder()
+    exp = ServeMetricsExporter(rec, port=0, interval=0.1)
+    exp.kv_provider = census_fixture
+    exp.start_background()
+    try:
+        base = f"http://127.0.0.1:{exp.bound_port}/debugz"
+        with urllib.request.urlopen(base + "?kv=1", timeout=10) as r:
+            payload = json.loads(r.read().decode())
+        assert payload["kv"]["buckets"]["cold"] == 2
+        assert payload["kv"]["coldest"][0]["idle_s"] == 10.0
+        with urllib.request.urlopen(base, timeout=10) as r:
+            payload = json.loads(r.read().decode())
+        assert "kv" not in payload   # opt-in query param
+    finally:
+        exp.stop()
+
+
+def test_fleet_tolerates_missing_thermal_block():
+    """Mixed-version fleet: replicas that predate kv_thermal (or run
+    the slot engine) must not break the rollup — absence is None, the
+    aggregate only sums publishers."""
+    st = FleetState(down_after_s=10.0)
+    st.observe_ok("old", "u0", {"queued": 0}, {}, now=1.0)
+    st.observe_ok("new", "u1", {
+        "queued": 0,
+        "kv_thermal": {"buckets": {"hot": 1, "warm": 0, "cold": 7},
+                       "working_set_pages": 4}}, {}, now=1.0)
+    reps = {r.rid: r for r in st.replicas()}
+    assert reps["old"].kv_cold_pages() is None
+    assert reps["new"].kv_cold_pages() == 7.0
+    assert reps["new"].kv_working_set() == 4.0
+    assert "cold_pages" not in reps["old"].series_values()
+    assert reps["new"].series_values()["cold_pages"] == 7.0
+    agg = st.aggregates(now=1.5)
+    assert agg["kv_cold_pages"] == 7.0
+    assert agg["coldest_replica"] == "new"
+
+
+def test_fleet_aggregate_none_when_nobody_publishes():
+    st = FleetState(down_after_s=10.0)
+    st.observe_ok("r0", "u0", {"queued": 0}, {}, now=1.0)
+    agg = st.aggregates(now=1.5)
+    assert agg["kv_cold_pages"] is None
+    assert agg["coldest_replica"] is None
+
+
+# ---------- doctor detectors ----------
+
+def C(name, ts, **vals):
+    return {"name": name, "cat": "", "ph": "C", "ts": ts,
+            "args": vals, "id": None}
+
+
+def I(name, ts, **args):
+    return {"name": name, "cat": "", "ph": "i", "ts": ts,
+            "args": args, "id": None}
+
+
+def B(name, ts, eid, **args):
+    return {"name": name, "cat": "", "ph": "b", "ts": ts,
+            "args": args, "id": eid}
+
+
+def kv_cfg(**kw):
+    defaults = dict(fast_window_s=10.0, kv_cold_share=0.5,
+                    kv_cold_min_samples=3, kv_thrash_n=3)
+    defaults.update(kw)
+    return DoctorConfig(**defaults)
+
+
+def sig(evs, now, cfg=None):
+    return Signals(now, sorted(evs, key=lambda e: e["ts"]),
+                   cfg or kv_cfg(), live=False)
+
+
+def cold_waste_events(now, share_seq=(0.6, 0.6, 0.6), stalls=1):
+    evs = []
+    for i, share in enumerate(share_seq):
+        cold = int(share * 10)
+        evs.append(C("serve/kv_thermal", now - 6 + 2 * i,
+                     hot=10 - cold, warm=0, cold=cold, wss=3))
+    evs.append(C("serve/kv_tenant_cold", now - 1, idler=5, alice=1))
+    for j in range(stalls):
+        evs.append(B("req/page_stall", now - 2, f"r{j}"))
+    return evs
+
+
+def test_kv_cold_waste_fires_with_tenant_attribution():
+    now = 100.0
+    f = KvColdWasteDetector().check(sig(cold_waste_events(now), now))
+    assert len(f) == 1 and f[0].cls == "kv_cold_waste"
+    ev = f[0].evidence
+    assert ev["cold_share_min"] == 0.6
+    assert ev["coldest_tenant"] == "idler"
+    assert ev["tenant_cold_pages"]["idler"] == 5
+    assert ev["page_stalls"] == 1
+    assert "idler" in f[0].summary
+
+
+def test_kv_cold_waste_quiet_cases():
+    now = 100.0
+    det = KvColdWasteDetector()
+    # No admission pressure: cold pages nobody waits on are fine.
+    assert det.check(sig(cold_waste_events(now, stalls=0), now)) == []
+    # One sample dipped below the share threshold: not sustained.
+    assert det.check(sig(
+        cold_waste_events(now, share_seq=(0.6, 0.3, 0.6)), now)) == []
+    # Too few samples in the window.
+    assert det.check(sig(
+        cold_waste_events(now, share_seq=(0.6, 0.6)), now)) == []
+    # Empty pool.
+    evs = [C("serve/kv_thermal", now - 6 + 2 * i,
+             hot=0, warm=0, cold=0) for i in range(3)]
+    evs.append(B("req/page_stall", now - 2, "r0"))
+    assert det.check(sig(evs, now)) == []
+
+
+def test_kv_thrash_fires_and_quiet():
+    now = 50.0
+    det = KvThrashDetector()
+    evs = [I("kv/thrash", now - 5 + i, age_s=float(i + 1))
+           for i in range(3)]
+    f = det.check(sig(evs, now))
+    assert len(f) == 1 and f[0].cls == "kv_thrash"
+    assert f[0].evidence["count"] == 3
+    assert f[0].evidence["reref_age_p50_s"] == 2.0
+    assert f[0].evidence["reref_age_max_s"] == 3.0
+    assert det.check(sig(evs[:2], now)) == []  # below threshold
+    # Old hits outside the fast window don't count.
+    old = [I("kv/thrash", now - 500 + i, age_s=1.0) for i in range(3)]
+    assert det.check(sig(old, now)) == []
+
+
+def test_kv_detectors_dedup_one_incident_per_episode(tmp_path):
+    cfg = kv_cfg(clear_after_s=5.0)
+    doc = Doctor(config=cfg, out_dir=str(tmp_path), bus=None,
+                 live=False)
+    doc.ingest(cold_waste_events(100.0))
+    doc.ingest([I("kv/thrash", 99.0 + 0.1 * i, age_s=1.0)
+                for i in range(3)])
+    first = doc.evaluate(doc._signals(101.0, 0))
+    assert sorted(i["class"] for i in first) == ["kv_cold_waste",
+                                                 "kv_thrash"]
+    # Still firing -> same episodes, no new bundles.
+    assert doc.evaluate(doc._signals(102.0, 0)) == []
+    assert len(list(tmp_path.glob("incident-kv_*.json"))) == 2
+
+
+# ---------- kv_report: tier simulator pinned ----------
+
+def hand_trace():
+    mk = lambda ts, tenant, keys: {  # noqa: E731
+        "ts": ts, "rid": 0, "tenant": tenant, "class": "-",
+        "keys": keys, "hit_pages": 0}
+    return [
+        mk(0.0, "a", ["A", "B"]),
+        mk(1.0, "a", ["C"]),
+        mk(2.0, "b", ["A"]),
+        mk(3.0, "b", ["D"]),
+        mk(5.0, "b", ["B"]),
+        mk(6.0, "b", ["D"]),
+        mk(20.0, "b", ["C"]),
+    ]
+
+
+def test_simulate_tier_pinned_against_hand_computed_lru():
+    """L0=2 pages, L1=1 page, horizon 10s, worked by hand:
+    A,B,C,D recompute; A comes back from the host tier (1 page-in);
+    B's recompute at t=5 re-references a page dropped at t=3 (counts);
+    D hits L0; C's recompute at t=20 is 15s past its drop (doesn't)."""
+    sim = simulate_tier(hand_trace(), hbm_pages=2, tier_pages=1,
+                        horizon_s=10.0)
+    assert sim["page_accesses"] == 8
+    assert sim["hbm_hits"] == 1
+    assert sim["host_hits"] == 1
+    assert sim["recomputes"] == 6
+    assert sim["evicted_reref_recomputes"] == 1
+    assert sim["by_tenant"]["a"] == {
+        "requests": 2, "page_accesses": 3, "hbm_hits": 0,
+        "host_hits": 0, "recomputes": 3}
+    assert sim["by_tenant"]["b"]["hbm_hits"] == 1
+    assert sim["by_tenant"]["b"]["host_hits"] == 1
+
+
+def test_simulate_tier_no_host_tier_drops_directly():
+    sim = simulate_tier(hand_trace(), hbm_pages=2, tier_pages=0,
+                        horizon_s=10.0)
+    assert sim["host_hits"] == 0
+    assert sim["recomputes"] == 7
+    # A (dropped t=1, missed t=2) and B (dropped t=3, missed t=5)
+    # both re-reference within the horizon.
+    assert sim["evicted_reref_recomputes"] >= 2
+
+
+def test_simulate_tier_everything_fits():
+    sim = simulate_tier(hand_trace(), hbm_pages=64, tier_pages=0)
+    assert sim["recomputes"] == 4          # one per distinct page
+    assert sim["hbm_hits"] == 4
+    assert sim["evicted_reref_recomputes"] == 0
+
+
+def test_build_report_tier_curve_and_multiplier():
+    page_bytes = 10 ** 8                   # 0.1 GB/page: 1 GB = 10
+    rep = build_report(hand_trace(), {"thrash_rereferences": 1},
+                       hbm_pages=2, tier_gbs=[0.0, 1.0],
+                       page_bytes=page_bytes, horizon_s=10.0,
+                       inputs=["x"])
+    assert rep["kind"] == "kv_thermal_report"
+    assert rep["distinct_pages"] == 4
+    assert [t["host_tier_gb"] for t in rep["tiers"]] == [0.0, 1.0]
+    t0, t1 = rep["tiers"]
+    assert t0["tier_pages"] == 0 and t1["tier_pages"] == 10
+    assert t1["resident_session_multiplier"] == 6.0  # (2+10)/2
+    # A bigger tier can only help the recompute rate.
+    assert t1["recompute_rate"] <= t0["recompute_rate"]
+    assert t1["page_in_gb"] == round(
+        t1["page_ins"] * page_bytes / 1e9, 4)
+    assert rep["tenants"]["a"]["requests"] == 2
+
+
+def test_extract_accesses_and_observed_from_merged_trace():
+    merged = {"traceEvents": [
+        {"name": "kv/prefix_access", "ph": "i", "ts": 2e6,
+         "args": {"rid": 7, "tenant": "idler", "class": "idle",
+                  "keys": [11, 12], "hit_pages": 1}},
+        {"name": "kv/prefix_access", "ph": "i", "ts": 1e6,
+         "args": {"rid": 6, "keys": []}},
+        {"name": "serve/kv_thermal", "ph": "C", "ts": 2e6,
+         "args": {"hot": 1, "warm": 1, "cold": 2, "wss": 2}},
+        {"name": "serve/kv_tenant_cold", "ph": "C", "ts": 2e6,
+         "args": {"idler": 2}},
+        {"name": "kv/thrash", "ph": "i", "ts": 2e6,
+         "args": {"age_s": 1.0}},
+        {"name": "other", "ph": "i", "ts": 3e6, "args": {}},
+    ]}
+    acc = extract_accesses(merged)
+    assert [a["ts"] for a in acc] == [1.0, 2.0]  # sorted, seconds
+    assert acc[0]["tenant"] == "unowned"
+    assert acc[1] == {"ts": 2.0, "rid": 7, "tenant": "idler",
+                      "class": "idle", "keys": [11, 12],
+                      "hit_pages": 1}
+    obs = extract_observed(merged)
+    assert obs["thrash_rereferences"] == 1
+    assert obs["cold_share_last"] == 0.5
+    assert obs["coldest_tenant"] == "idler"
+
+
+# ---------- loadgen tenant classes / hbm_plan host tier ----------
+
+def mix_args(**kw):
+    defaults = dict(tenants=6, idle_tenants=2, churn_tenants=2,
+                    churn_cycle=3, tenant_prefix_len=4, prompt_len=2,
+                    long_prompt_len=8)
+    defaults.update(kw)
+    return types.SimpleNamespace(**defaults)
+
+
+def test_loadgen_tenant_classes_carved_from_top():
+    args = mix_args()
+    assert [loadgen.tenant_class(t, args) for t in range(6)] == \
+        ["chat", "batch", "churn", "churn", "idle", "idle"]
+    # Legacy single-arg callers keep the two-class layout.
+    assert loadgen.tenant_class(4) == "chat"
+    assert loadgen.tenant_class(5) == "batch"
+
+
+def test_loadgen_churn_prefix_cycles_idle_prefix_stable():
+    args = mix_args()
+    # Idle tenant 5: same prefix on every round.
+    _, p0 = loadgen.tenant_tokens(args, 5)
+    _, p1 = loadgen.tenant_tokens(args, 5 + args.tenants)
+    assert p0[:4] == p1[:4]
+    # Churn tenant 2: the prefix cycles through churn_cycle variants
+    # and returns to the first one.
+    prefixes = [loadgen.tenant_tokens(args, 2 + r * args.tenants)[1][:4]
+                for r in range(4)]
+    assert len({tuple(p) for p in prefixes[:3]}) == 3
+    assert prefixes[3] == prefixes[0]
+
+
+def test_hbm_plan_host_tier_multiplier():
+    plans = hbm_plan.shipped_plans(host_tier_gb=64.0)
+    serving = [p for p in plans if p["kind"] == "serve"]
+    assert serving, "shipped_plans lost its serving rows"
+    for p in serving:
+        assert p["host_tier_gb"] == 64.0
+        assert p["resident_slots_with_tier"] >= p["resident_slots"]
+        assert p["tier_slot_multiplier"] >= 1.0
+    # Without a tier the with-tier fields stay absent (old consumers
+    # see the exact old schema).
+    for p in hbm_plan.shipped_plans():
+        assert "resident_slots_with_tier" not in p
+
+
+# ---------- engine e2e ----------
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(n_layers=1, d_model=64, n_heads=2, n_kv_heads=1,
+                     d_ff=128, vocab_size=128)
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+def tags(tenant, cls):
+    return {"tags": {"tenant": tenant, "class": cls}}
+
+
+def drain_census(eng, timeout=30.0):
+    """Census once every page has returned to the free list (page
+    frees race the future resolution by a worker-loop iteration)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        c = eng.thermal_census()
+        if c["pages_in_use"] == 0:
+            return c
+        time.sleep(0.01)
+    return eng.thermal_census()
+
+
+def test_engine_census_tenants_and_drain(model):
+    """Per-tenant occupancy through the real engine: the retained
+    prefix page keeps its tenant attribution after the request ends,
+    and clearing the prefix cache drains the census to zero in every
+    bucket."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=64,
+                                page=16, max_prompt_len=32)
+    try:
+        # 17 tokens: one FULL page (the page with the last live token
+        # stays private), so exactly one page is retained.
+        eng.submit(list(range(1, 18)), 4, 0.0,
+                   trace_ctx=tags("alice", "chat")).result(timeout=300)
+        c = eng.thermal_census()
+        assert c["tenants"]["alice"]["pages"] >= 1
+        assert c["prefix_pages"] >= 1
+        with eng._mu:
+            eng._index.clear()
+        c = drain_census(eng)
+        assert c["buckets"] == {"hot": 0, "warm": 0, "cold": 0}
+        assert c["pages_in_use"] == 0 and c["tenants"] == {}
+    finally:
+        eng.stop()
+
+
+def test_engine_tenant_attribution_survives_preemption(model):
+    """Preemption frees and re-admits pages; attribution must follow
+    the re-admitted request, and the allocator must account every
+    page to SOME tenant key (no refcounted row escapes the census)."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=3, max_len=64,
+                                page=16, pool_pages=6,
+                                max_prompt_len=32, prefix_cap=0)
+    try:
+        reqs = [("a", [1, 2, 3], 40), ("b", [7, 8], 40),
+                ("c", [11] * 5, 40)]
+        futs = [eng.submit(list(t), n, 0.0,
+                           trace_ctx=tags(who, "chat"))
+                for who, t, n in reqs]
+        for f in futs:
+            f.result(timeout=600)
+        assert eng.preemptions > 0
+        c = drain_census(eng)
+        assert c["pages_in_use"] == 0  # clean drain even after churn
+        assert sum(t["pages"] for t in c["tenants"].values()) == 0
+    finally:
+        eng.stop()
+
+
+def test_e2e_idle_tenant_cold_pages_named_by_doctor(model):
+    """The acceptance scenario end to end: an idle tenant's retained
+    prefix pages go cold while an active tenant stays hot; the real
+    census shows the split, and kv_cold_waste (fed the census-derived
+    counter track plus admission pressure) names the idle tenant."""
+    params, cfg = model
+    eng = PagedContinuousEngine(params, cfg, max_slots=2, max_len=64,
+                                page=16, max_prompt_len=32,
+                                thermal_warm_s=10.0)
+    try:
+        idle_prompt = list(range(1, 18))      # one retained full page
+        alice_prompt = list(range(31, 48))
+        eng.submit(idle_prompt, 2, 0.0,
+                   trace_ctx=tags("idler", "idle")).result(timeout=300)
+        # Jump the allocator's clock 100s forward (same epoch, so
+        # earlier touch stamps stay comparable): everything touched
+        # before this point has now been idle for 100s.
+        eng._alloc.clock = lambda: time.monotonic() + 100.0
+        eng.submit(alice_prompt, 2, 0.0,
+                   trace_ctx=tags("alice", "chat")).result(timeout=300)
+        c = eng.thermal_census()
+        assert c["tenants"]["idler"]["cold"] >= 1
+        assert c["tenants"]["alice"]["cold"] == 0
+        assert c["cold_evictable"] >= 1       # prefix-linked, reclaimable
+        assert c["coldest"][0]["tenant"] == "idler"
+    finally:
+        eng.stop()
+    # The census the engine just produced, as the doctor sees it.
+    now = 100.0
+    b = c["buckets"]
+    evs = [C("serve/kv_thermal", now - 6 + 2 * i, **b, wss=2)
+           for i in range(3)]
+    evs.append(C("serve/kv_tenant_cold", now - 1,
+                 **{t: v["cold"] for t, v in c["tenants"].items()}))
+    evs.append(B("req/page_stall", now - 2, "r9"))
+    share = b["cold"] / sum(b.values())
+    f = KvColdWasteDetector().check(
+        sig(evs, now, kv_cfg(kv_cold_share=min(share, 0.5))))
+    assert len(f) == 1
+    assert f[0].evidence["coldest_tenant"] == "idler"
+    assert "idler" in f[0].summary

@@ -114,7 +114,8 @@ def plan_training(cfg, *, dp=1, fsdp=1, pp=1, tp=1, sp=1, ep=1,
 
 def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
                  pool_fraction=0.5, weight_bytes=2, kv_dtype="bf16",
-                 weight_dtype="bf16", chip="v5p") -> dict:
+                 weight_dtype="bf16", chip="v5p",
+                 host_tier_gb=0.0) -> dict:
     """Per-chip HBM for the paged serving deployment (cli/serve.py
     defaults: pool = half the full slots x max_len reservation).
 
@@ -134,7 +135,17 @@ def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
 
     `resident_slots` answers the capacity question directly: how many
     FULLY-BACKED max_len slots fit in the HBM left after weights —
-    the number --kv-dtype/--weight-dtype exist to raise."""
+    the number --kv-dtype/--weight-dtype exist to raise.
+
+    host_tier_gb > 0 prices the ROADMAP item 2 host-DRAM KV tier
+    (ISSUE 19 planning row): resident sessions whose pages may live
+    in EITHER tier — `resident_slots_with_tier` counts max_len slots
+    backed by HBM + host bytes together, and `tier_slot_multiplier`
+    is the resident-session gain, the same (C0 + X) / C0 curve
+    tools/kv_report.py predicts from a recorded touch trace (its
+    per-tier `resident_session_multiplier` column; the two must
+    agree for equal page budgets, tests/test_kv_thermal.py pins
+    it)."""
     attn, mlp, moe = _layer_param_elems(cfg)
     L = cfg.n_layers
     embed = cfg.vocab_size * cfg.d_model          # replicated (decode)
@@ -161,7 +172,8 @@ def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
     kv = kv_full * pool_fraction
     total = weights + kv
     cap = CHIP_HBM[chip]
-    return {
+    resident = int(max(cap - weights, 0) // slot_kv)
+    out = {
         "kind": "serve", "chip": chip, "hbm_gb": round(cap / GB, 1),
         "tp": tp, "slots": max_slots, "max_len": max_len,
         "kv_dtype": kv_dtype, "weight_dtype": weight_dtype,
@@ -169,13 +181,25 @@ def plan_serving(cfg, *, tp=1, max_slots=8, max_len=4096,
         "kv_pool_gb": round(kv / GB, 2),
         "total_gb": round(total / GB, 2),
         "headroom_gb": round((cap - total) / GB, 2),
-        "resident_slots": int(max(cap - weights, 0) // slot_kv),
+        "resident_slots": resident,
         "fits": bool(total < cap),
     }
+    if host_tier_gb > 0:
+        kv_bytes_hbm = max(cap - weights, 0)
+        with_tier = int(
+            (kv_bytes_hbm + host_tier_gb * GB) // slot_kv)
+        out["host_tier_gb"] = host_tier_gb
+        out["resident_slots_with_tier"] = with_tier
+        out["tier_slot_multiplier"] = round(
+            (kv_bytes_hbm + host_tier_gb * GB)
+            / max(kv_bytes_hbm, 1.0), 2)
+    return out
 
 
-def shipped_plans() -> list[dict]:
-    """The plans this repo ships and CI guards (tests/test_hbm_plan.py)."""
+def shipped_plans(host_tier_gb=0.0) -> list[dict]:
+    """The plans this repo ships and CI guards (tests/test_hbm_plan.py).
+    host_tier_gb > 0 adds the with-tier resident-session column to
+    every serving plan (--host-tier-gb)."""
     from container_engine_accelerators_tpu.models import llama
 
     cfg8b = llama.LlamaConfig()  # defaults ARE Llama-3-8B
@@ -189,18 +213,20 @@ def shipped_plans() -> list[dict]:
         # The serving demo's claim: 8B at tp=4 (demo/serving/*.yaml) —
         # on the v5p host and on a 4-chip v5e node.
         plan_serving(cfg8b, tp=4, max_slots=16, max_len=8192,
-                     chip="v5p"),
+                     chip="v5p", host_tier_gb=host_tier_gb),
         plan_serving(cfg8b, tp=4, max_slots=8, max_len=4096,
-                     chip="v5e"),
+                     chip="v5e", host_tier_gb=host_tier_gb),
         # The int8-KV claim (--kv-dtype int8): DOUBLE the v5e node's
         # slots in ~the same cache bytes (README serving section).
         plan_serving(cfg8b, tp=4, max_slots=16, max_len=4096,
-                     chip="v5e", kv_dtype="int8"),
+                     chip="v5e", kv_dtype="int8",
+                     host_tier_gb=host_tier_gb),
         # The full quantized stack (--kv-dtype int4 --weight-dtype
         # int8): QUADRUPLE the v5e node's slots — int4 KV is ~0.28x
         # bf16 per token and int8 weights free ~2 GB more for cache.
         plan_serving(cfg8b, tp=4, max_slots=32, max_len=4096,
-                     chip="v5e", kv_dtype="int4", weight_dtype="int8"),
+                     chip="v5e", kv_dtype="int4", weight_dtype="int8",
+                     host_tier_gb=host_tier_gb),
         # Calibration pair: the bench config on the one real v5e chip —
         # batch 5 fits (measured), batch 8 does not (measured compile
         # failure). If a model change flips either, re-fit the model.
@@ -212,8 +238,14 @@ def shipped_plans() -> list[dict]:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--host-tier-gb", type=float, default=0.0,
+                    help="price a host-DRAM KV tier of this size: "
+                         "serving plans gain resident_slots_with_tier "
+                         "and tier_slot_multiplier (cross-check "
+                         "against tools/kv_report.py's per-tier "
+                         "resident_session_multiplier)")
     args = ap.parse_args()
-    for plan in shipped_plans():
+    for plan in shipped_plans(host_tier_gb=args.host_tier_gb):
         if args.json:
             print(json.dumps(plan))
         else:
@@ -228,6 +260,12 @@ def main():
                       ("hbm_gb", "total_gb", "headroom_gb")}
             print("      " + "  ".join(f"{k}={v}" for k, v in
                                        detail.items()))
+            if "resident_slots_with_tier" in plan:
+                print(f"      host tier {plan['host_tier_gb']:g} GB: "
+                      f"{plan['resident_slots']} -> "
+                      f"{plan['resident_slots_with_tier']} resident "
+                      f"max_len sessions "
+                      f"(x{plan['tier_slot_multiplier']})")
 
 
 if __name__ == "__main__":

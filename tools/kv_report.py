@@ -1,0 +1,288 @@
+"""kv_report — replay a recorded KV touch trace through a two-level
+LRU tier simulator and price a host-DRAM tier (ISSUE 19).
+
+Input: the same source mix as tools/trace_report.py (flight-recorder
+dumps, streamed EventBus JSONL sidecars, directories of either). The
+touch trace is the `kv/prefix_access` instant stream the paged engine
+emits at every admission: the prompt's full-page chain hashes, the
+owning tenant/class, and how many pages the live prefix cache served.
+
+Simulation: each page access walks an L0 (HBM prefix cache, capacity
+`--hbm-pages`) backed by an L1 (host tier, sized from
+`--host-tier-gb`). L0 hits are free; L1 hits are page-ins (they cost
+host<->HBM bandwidth, counted); misses are recomputes. L0 evictions
+demote to L1; L1 evictions drop, and a later access to a dropped page
+within `--horizon-s` is the evicted-then-re-referenced hit class the
+kv_thrash detector measures live. Per host-tier size the report gives
+predicted hit classes, page-in bandwidth demand, and the
+resident-session multiplier (how many more prefix working sets stay
+resident) — the planning row tools/hbm_plan.py --host-tier-gb
+cross-checks.
+
+Output: KV_THERMAL_REPORT.json (committed as the tier-sizing
+evidence) plus a stdout table; `--json` prints the report instead.
+
+Usage:
+    python -m tools.kv_report /tmp/tr/*.jsonl --hbm-pages 64 \\
+        --host-tier-gb 0,1,4,16 --out KV_THERMAL_REPORT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from container_engine_accelerators_tpu.metrics import events  # noqa: E402
+from tools.trace_report import collect_inputs  # noqa: E402
+
+# Default page cost: a 128-token page of Llama-3-8B-class KV in bf16
+# (2 tensors x 32 layers x 8 kv heads x 128 head dim x 2 bytes x 128
+# tokens = 16 MiB). Override for other models/dtypes.
+DEFAULT_PAGE_BYTES = 2 * 32 * 8 * 128 * 2 * 128
+GB = 1e9
+
+
+def extract_accesses(merged: dict) -> list[dict]:
+    """kv/prefix_access instants, ts-sorted, ts in seconds:
+    [{ts, rid, tenant, class, keys, hit_pages}]."""
+    out = []
+    for ev in merged.get("traceEvents", []):
+        if ev.get("name") != "kv/prefix_access" or ev.get("ph") != "i":
+            continue
+        args = ev.get("args") or {}
+        keys = args.get("keys") or []
+        out.append({
+            "ts": float(ev.get("ts", 0.0)) / 1e6,
+            "rid": args.get("rid"),
+            "tenant": args.get("tenant") or "unowned",
+            "class": args.get("class") or "-",
+            "keys": list(keys),
+            "hit_pages": int(args.get("hit_pages", 0)),
+        })
+    out.sort(key=lambda a: a["ts"])
+    return out
+
+
+def extract_observed(merged: dict) -> dict:
+    """Live thermal observations recorded alongside the touch trace:
+    the last serve/kv_thermal + serve/kv_tenant_cold samples and the
+    kv/thrash instant count — the report's ground-truth column."""
+    thermal = tenant_cold = None
+    thrash = 0
+    for ev in merged.get("traceEvents", []):
+        name, ph = ev.get("name"), ev.get("ph")
+        if name == "serve/kv_thermal" and ph == "C":
+            thermal = ev.get("args") or thermal
+        elif name == "serve/kv_tenant_cold" and ph == "C":
+            tenant_cold = ev.get("args") or tenant_cold
+        elif name == "kv/thrash" and ph == "i":
+            thrash += 1
+    out: dict = {"thrash_rereferences": thrash}
+    if thermal is not None:
+        total = sum(float(thermal.get(b, 0))
+                    for b in ("hot", "warm", "cold"))
+        out["thermal_last"] = thermal
+        out["cold_share_last"] = (
+            round(float(thermal.get("cold", 0)) / total, 4)
+            if total else None)
+    if tenant_cold is not None:
+        out["tenant_cold_pages"] = tenant_cold
+        if tenant_cold:
+            out["coldest_tenant"] = max(
+                tenant_cold, key=lambda t: tenant_cold[t])
+    return out
+
+
+def simulate_tier(accesses: list[dict], hbm_pages: int, tier_pages: int,
+                  horizon_s: float = 30.0) -> dict:
+    """Two-level LRU over the access stream. Returns hit-class counts
+    plus the evicted-then-re-referenced recompute subclass."""
+    l0: collections.OrderedDict = collections.OrderedDict()  # HBM
+    l1: collections.OrderedDict = collections.OrderedDict()  # host
+    dropped_ts: dict = {}
+    n = hbm_hits = host_hits = recompute = reref = 0
+    by_tenant: dict[str, dict] = {}
+
+    def insert_l0(key):
+        l0[key] = None
+        if len(l0) > hbm_pages:
+            demoted, _ = l0.popitem(last=False)
+            if tier_pages > 0:
+                l1[demoted] = None
+                if len(l1) > tier_pages:
+                    gone, _ = l1.popitem(last=False)
+                    dropped_ts[gone] = ts
+            else:
+                dropped_ts[demoted] = ts
+
+    for acc in accesses:
+        ts = acc["ts"]
+        trec = by_tenant.setdefault(acc["tenant"], {
+            "requests": 0, "page_accesses": 0, "hbm_hits": 0,
+            "host_hits": 0, "recomputes": 0})
+        trec["requests"] += 1
+        for key in acc["keys"]:
+            n += 1
+            trec["page_accesses"] += 1
+            if key in l0:
+                hbm_hits += 1
+                trec["hbm_hits"] += 1
+                l0.move_to_end(key)
+            elif key in l1:
+                host_hits += 1
+                trec["host_hits"] += 1
+                del l1[key]
+                insert_l0(key)
+            else:
+                recompute += 1
+                trec["recomputes"] += 1
+                t_drop = dropped_ts.pop(key, None)
+                if t_drop is not None and ts - t_drop <= horizon_s:
+                    reref += 1
+                insert_l0(key)
+    return {
+        "page_accesses": n,
+        "hbm_hits": hbm_hits,
+        "host_hits": host_hits,
+        "recomputes": recompute,
+        "evicted_reref_recomputes": reref,
+        "by_tenant": by_tenant,
+    }
+
+
+def build_report(accesses: list[dict], observed: dict, *,
+                 hbm_pages: int, tier_gbs: list[float],
+                 page_bytes: int, horizon_s: float,
+                 inputs: list[str]) -> dict:
+    distinct = {k for a in accesses for k in a["keys"]}
+    ts0 = accesses[0]["ts"] if accesses else 0.0
+    ts1 = accesses[-1]["ts"] if accesses else 0.0
+    duration = max(ts1 - ts0, 1e-9)
+    paged = [a for a in accesses if a["keys"]]
+    avg_pages = (sum(len(a["keys"]) for a in paged) / len(paged)
+                 if paged else 1.0)
+    tiers = []
+    baseline_tenants: dict = {}
+    for g in tier_gbs:
+        tier_pages = int(g * GB // page_bytes)
+        sim = simulate_tier(accesses, hbm_pages, tier_pages,
+                            horizon_s=horizon_s)
+        n = max(sim["page_accesses"], 1)
+        if not tiers:  # per-tenant detail once, at the smallest tier
+            baseline_tenants = sim["by_tenant"]
+        tiers.append({
+            "host_tier_gb": g,
+            "tier_pages": tier_pages,
+            "hbm_hit_rate": round(sim["hbm_hits"] / n, 4),
+            "host_hit_rate": round(sim["host_hits"] / n, 4),
+            "recompute_rate": round(sim["recomputes"] / n, 4),
+            "evicted_reref_recomputes":
+                sim["evicted_reref_recomputes"],
+            "page_ins": sim["host_hits"],
+            "page_in_gb": round(sim["host_hits"] * page_bytes / GB, 4),
+            "page_in_gbps": round(
+                sim["host_hits"] * page_bytes / GB / duration, 4),
+            "resident_session_multiplier": round(
+                (hbm_pages + tier_pages) / max(hbm_pages, 1), 2),
+            "resident_sessions": round(
+                (hbm_pages + tier_pages) / max(avg_pages, 1e-9), 1),
+        })
+    return {
+        "kind": "kv_thermal_report",
+        "inputs": inputs,
+        "requests": len(accesses),
+        "page_accesses": sum(len(a["keys"]) for a in accesses),
+        "distinct_pages": len(distinct),
+        "duration_s": round(duration, 3),
+        "hbm_pages": hbm_pages,
+        "page_bytes": page_bytes,
+        "horizon_s": horizon_s,
+        "avg_full_pages_per_request": round(avg_pages, 2),
+        "observed": observed,
+        "tenants": baseline_tenants,
+        "tiers": tiers,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay a recorded KV touch trace through a "
+                    "two-level LRU tier simulator")
+    ap.add_argument("paths", nargs="+",
+                    help="trace dumps / EventBus JSONL files / dirs")
+    ap.add_argument("--out", default="KV_THERMAL_REPORT.json",
+                    help="report path ('' skips writing)")
+    ap.add_argument("--hbm-pages", type=int, default=64,
+                    help="L0 capacity: HBM pages available to the "
+                         "prefix cache (match --prefix-cache-cap)")
+    ap.add_argument("--host-tier-gb", default="0,1,4,16",
+                    help="comma list of host-tier sizes to price")
+    ap.add_argument("--page-bytes", type=int,
+                    default=DEFAULT_PAGE_BYTES,
+                    help="bytes per KV page (default: 128-token "
+                         "Llama-3-8B bf16 page)")
+    ap.add_argument("--horizon-s", type=float, default=30.0,
+                    help="evicted-then-re-referenced horizon")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    inputs = collect_inputs(args.paths)
+    merged = events.merge_traces(dump_paths=inputs["dump"],
+                                 sse_log_paths=inputs["sse"],
+                                 event_jsonl_paths=inputs["jsonl"])
+    accesses = extract_accesses(merged)
+    if not accesses:
+        print("no kv/prefix_access events found — record the serve "
+              "side with --trace-jsonl while driving load",
+              file=sys.stderr)
+        return 1
+    tier_gbs = [float(x) for x in args.host_tier_gb.split(",") if x]
+    report = build_report(
+        accesses, extract_observed(merged), hbm_pages=args.hbm_pages,
+        tier_gbs=tier_gbs, page_bytes=args.page_bytes,
+        horizon_s=args.horizon_s,
+        inputs=inputs["dump"] + inputs["jsonl"] + inputs["sse"])
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.out)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0
+    print(f"{report['requests']} requests, "
+          f"{report['page_accesses']} page accesses over "
+          f"{report['distinct_pages']} distinct pages "
+          f"({report['duration_s']}s); HBM L0 = "
+          f"{report['hbm_pages']} pages")
+    print(f"{'tier_gb':>8} {'hbm_hit':>8} {'host_hit':>9} "
+          f"{'recompute':>10} {'reref':>6} {'pagein_gbps':>12} "
+          f"{'sessions_x':>11}")
+    for t in report["tiers"]:
+        print(f"{t['host_tier_gb']:>8g} {t['hbm_hit_rate']:>8.3f} "
+              f"{t['host_hit_rate']:>9.3f} "
+              f"{t['recompute_rate']:>10.3f} "
+              f"{t['evicted_reref_recomputes']:>6d} "
+              f"{t['page_in_gbps']:>12.3f} "
+              f"{t['resident_session_multiplier']:>11.2f}")
+    obs = report["observed"]
+    if obs.get("cold_share_last") is not None:
+        print(f"observed: cold share {obs['cold_share_last']}, "
+              f"thrash rereferences {obs['thrash_rereferences']}, "
+              f"coldest tenant {obs.get('coldest_tenant')}")
+    if args.out:
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -164,6 +164,14 @@ HOST_GAP_METRIC = "host_gap_fraction"
 TRACED_METRIC = "decode_step_traced_ms"
 UNTRACED_METRIC = "decode_step_slots_ms"
 TRACING_OVERHEAD_ALLOWED = 0.05
+# KV-thermal pin (ISSUE 19): decode_tick_thermal_ms — the paged tick
+# with page-touch tracking and a periodic thermal census on — may
+# exceed the untracked decode_step_paged_ms baseline by that metric's
+# noise band plus this allowance before the gate calls it
+# regression:thermal_overhead.
+THERMAL_METRIC = "decode_tick_thermal_ms"
+UNTHERMAL_METRIC = "decode_step_paged_ms"
+THERMAL_OVERHEAD_ALLOWED = 0.05
 
 EXIT_OK = 0
 EXIT_REGRESSION = 2
@@ -513,6 +521,84 @@ def _decode_traced_bench():
         return times, rec.pct_ms("decode_step")
 
     return "decode_step_traced_ms", measure, None
+
+
+def _decode_thermal_bench():
+    """('decode_tick_thermal_ms'): the paged decode step with the
+    host-side thermal bookkeeping ON — the per-tick cost the paged
+    engine adds for ISSUE 19: a PageAllocator touch of every slot's
+    tail page per step plus a full thermal_census() every 16 steps.
+    Production throttles the census to 1 Hz (--thermal-interval-s), so
+    censusing every 16th ~ms-scale step here is a deliberately
+    conservative bound. Scored against the untracked
+    decode_step_paged_ms baseline with a 5% allowance (gate_check:
+    regression:thermal_overhead). Reuses the exact executable
+    _decode_bench(paged=True) warmed (jit cache keyed on cfg), so the
+    recompile hard gate stays 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.metrics.request_metrics import (
+        RequestRecorder,
+    )
+    from container_engine_accelerators_tpu.models import llama
+    from container_engine_accelerators_tpu.models.decode import (
+        PageAllocator,
+        _jitted_decode_step_paged,
+        init_paged_cache,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    n_slots, max_len, page = 4, 128, 32
+    max_pages = max_len // page
+    tables, n_pages = harness.build_page_tables(n_slots, max_pages)
+    cache = init_paged_cache(cfg, n_slots, n_pages, page, max_pages)
+    cache = cache._replace(tables=jnp.asarray(tables))
+    step = _jitted_decode_step_paged(cfg)
+
+    def fresh_len():
+        return jnp.full((n_slots,), max_len // 4, jnp.int32)
+
+    cache = cache._replace(length=fresh_len())
+    toks = jnp.ones((n_slots,), jnp.int32)
+    active = jnp.ones((n_slots,), bool)
+    for _ in range(harness.DEFAULT_WARMUP_STEPS):
+        logits, cache = step(params, cache, toks, active)
+        float(jnp.sum(logits))
+    box = [cache, toks]
+
+    # Host-side mirror of the engine's page bookkeeping: every slot
+    # owns its max_pages rows under a distinct tenant — a warm
+    # multi-tenant layout, so the census walks real owner/touch state.
+    alloc = PageAllocator(n_pages)
+    slot_rows = []
+    for s in range(n_slots):
+        rows = alloc.alloc(max_pages)
+        alloc.set_owner(rows, f"tenant{s}", "bench")
+        slot_rows.append(rows)
+    active_rows = [r for rows in slot_rows for r in rows]
+    tails = [rows[-1] for rows in slot_rows]
+
+    def measure(n_steps: int):
+        box[0] = box[0]._replace(length=fresh_len())
+        rec = RequestRecorder()
+        times = []
+        for i in range(n_steps):
+            t0 = time.monotonic()
+            last, box[0] = step(params, box[0], box[1], active)
+            box[1] = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            alloc.touch(tails)
+            if i % 16 == 0:
+                alloc.thermal_census(active_rows=active_rows,
+                                     prefix_rows=(), top_n=8)
+            float(jnp.sum(last))
+            dt = time.monotonic() - t0
+            times.append(dt)
+            rec.observe_decode_step(dt)
+        return times, rec.pct_ms("decode_step")
+
+    return "decode_tick_thermal_ms", measure, None
 
 
 def _decode_spec_bench():
@@ -1168,7 +1254,7 @@ def run_hermetic_tier(k: int | None = None, steps: int | None = None,
     # as a dimension diff (4 -> 7), not a pytree-structure diff.
     benches = [_decode_w8_bench(), _train_bench(),
                _decode_bench(paged=False), _decode_traced_bench(),
-               _decode_bench(paged=True),
+               _decode_bench(paged=True), _decode_thermal_bench(),
                _matmul_bench(), _prefill_cached_bench(),
                _decode_under_prefill_bench(), _ckpt_async_bench(),
                _decode_spec_bench(), _host_gap_bench(),
@@ -1290,6 +1376,33 @@ def _tracing_overhead_check(baseline_metrics: dict, current: dict,
     return verdict
 
 
+def _thermal_overhead_check(baseline_metrics: dict, current: dict,
+                            band_scale: float, verdict: str,
+                            rows: list) -> str:
+    """ISSUE-19 cross-metric pin, the paged twin of
+    _tracing_overhead_check: the thermal-tracked paged tick (current
+    run) against the UNTRACKED paged tick's committed baseline.
+    Allowed drift = the untracked metric's learned noise band (scaled)
+    plus the 5% thermal allowance; above that the page-touch
+    bookkeeping itself became a serving regression. Appends its row
+    either way; only escalates an otherwise-ok verdict."""
+    base = baseline_metrics.get(UNTHERMAL_METRIC)
+    tracked = current.get(THERMAL_METRIC)
+    if base is None or tracked is None:
+        return verdict
+    band = base["band"] * band_scale + THERMAL_OVERHEAD_ALLOWED
+    rel = tracked / base["value"] - 1.0
+    regressed = rel > band
+    rows.append({"metric": "thermal_overhead",
+                 "baseline": base["value"],
+                 "current": round(float(tracked), 4),
+                 "rel_change": round(rel, 4), "band": round(band, 4),
+                 "verdict": "regression" if regressed else "ok"})
+    if regressed and verdict == "ok":
+        return "regression:thermal_overhead"
+    return verdict
+
+
 def gate_check(tier: dict, baseline_path: str,
                band_scale: float | None = None,
                report_path: str = DEFAULT_REPORT) -> tuple[int, dict]:
@@ -1330,6 +1443,8 @@ def gate_check(tier: dict, baseline_path: str,
                                 if k not in MULTISLICE_METRICS}
         verdict, rows = compare(baseline_metrics, current, band_scale)
         verdict = _tracing_overhead_check(
+            baseline_metrics, current, band_scale, verdict, rows)
+        verdict = _thermal_overhead_check(
             baseline_metrics, current, band_scale, verdict, rows)
 
     report = {
