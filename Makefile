@@ -257,12 +257,25 @@ kv-thermal-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_kv_thermal.py -q \
 	    -m "slow or not slow"
 
+# Fabric health plane smoke (ISSUE 20): baseline-store
+# freeze/recovery semantics, monitor sweeps through the fake-probe
+# hooks (inject-slow -> degraded verdict -> bisection naming the
+# rank, transition-only localization, history-row stamping), the
+# per-process fabric_degraded / fabric_flap doctor detectors, probe
+# hook hardening on the fabric exporter, and the fabric_report
+# trend/episode folding. The live chaos e2e (fabric-degrade,
+# fabric-degrade-dcn) rides in `make chaos`; the sweep-overhead
+# cross-pin rides in `make perf-gate`.
+fabric-health-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fabric_health.py \
+	    tests/test_fabric_metrics.py -q
+
 # The whole observability smoke family in one target.
 smoke: lint lint-smoke obs-smoke train-obs-smoke trace-smoke \
     introspect-smoke doctor-smoke perf-gate-smoke perf-gate \
     serve-pools-smoke multislice-smoke dcn-overlap-smoke \
     preemption-smoke spec-smoke async-core-smoke fleet-smoke \
-    kv-thermal-smoke chaos-smoke
+    kv-thermal-smoke fabric-health-smoke chaos-smoke
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -278,4 +291,5 @@ clean:
     perf-gate perf-baseline perf-gate-smoke serve-pools-smoke \
     pools-report chaos chaos-smoke chaos-tests multislice-smoke \
     dcn-overlap-smoke preemption-smoke spec-smoke async-core-smoke \
-    fleet-smoke kv-thermal-smoke smoke dryrun clean
+    fleet-smoke kv-thermal-smoke fabric-health-smoke smoke dryrun \
+    clean

@@ -44,6 +44,12 @@ ROADMAP item 4 chaos-harness primitive:
                          health --error-log <path>` records flow
                          through the production health pipeline in
                          the target process (chaos health-storm)
+  --kind fabric-slow     throttles the fabric probe path for
+                         --seconds: probes over --axis whose subgroup
+                         contains --rank read --factor x slower, so
+                         the FabricHealthMonitor degrades, fires
+                         fabric/degraded, and its localization pass
+                         names the rank (chaos fabric-degrade)
 
   python -m container_engine_accelerators_tpu.cli.inject_fault \
       --kind hang --seconds 5 --fault-log /tmp/faults.jsonl
@@ -66,7 +72,8 @@ from container_engine_accelerators_tpu.healthcheck.health_checker import (
 
 FAULT_KINDS = ("health", "hang", "worker-kill", "prefill-kill",
                "recompile-storm", "hbm-climb", "queue-collapse",
-               "data-stall", "straggler", "health-tail")
+               "data-stall", "straggler", "health-tail",
+               "fabric-slow")
 
 
 def _append_jsonl(path: str, record: dict) -> None:
@@ -94,6 +101,9 @@ def _doctor_record(args) -> dict:
         rec.update(delay_s=args.delay, seconds=args.seconds)
     elif kind == "health_tail":
         rec.update(path=args.path, seconds=args.seconds)
+    elif kind == "fabric_slow":
+        rec.update(axis=args.axis, rank=args.rank,
+                   factor=args.factor, seconds=args.seconds)
     return rec
 
 
@@ -137,6 +147,15 @@ def main(argv=None) -> int:
                         "should tail with a real TPUHealthChecker "
                         "(append records to it with --kind health "
                         "--error-log <path>)")
+    p.add_argument("--axis", default="dp",
+                   help="fabric-slow: mesh axis whose probe path to "
+                        "throttle")
+    p.add_argument("--rank", type=int, default=0,
+                   help="fabric-slow: the rank along --axis that "
+                        "reads slow (what localization should name)")
+    p.add_argument("--factor", type=float, default=8.0,
+                   help="fabric-slow: slowdown factor on measured "
+                        "probe time")
     args = p.parse_args(argv)
 
     if args.kind != "health":

@@ -2672,6 +2672,21 @@ def main(argv=None) -> int:
                         "faults into this process — engine hangs, "
                         "recompile storms, fabricated HBM/queue "
                         "telemetry")
+    p.add_argument("--fabric-health", action="store_true",
+                   help="run a FabricHealthMonitor in-process "
+                        "(metrics/fabric_health.py): scheduled low-"
+                        "rate collective probe sweeps over every mesh "
+                        "axis, learned busBW baselines, fabric_"
+                        "degraded verdicts and slow-rank localization "
+                        "— gauges co-served on --metrics-port")
+    p.add_argument("--fabric-health-interval", type=float, default=30.0,
+                   help="seconds between probe sweeps")
+    p.add_argument("--fabric-health-baseline", default=None,
+                   help="FABRIC_BASELINE.json to seed the busBW "
+                        "baselines from (and re-save on shutdown)")
+    p.add_argument("--fabric-health-history", default=None,
+                   help="append probe-history JSONL rows here "
+                        "(tools/fabric_report.py input)")
     p.add_argument("--moe-decode-ep", action="store_true",
                    help="with --tp > 1 on an MoE model: shard experts "
                         "over the tp axis (n_experts/tp per chip + one "
@@ -2828,12 +2843,32 @@ def main(argv=None) -> int:
             FaultListener,
         )
         FaultListener(args.fault_listen, engine=engine).start()
+    fabric_mon = None
+    if args.fabric_health:
+        from container_engine_accelerators_tpu.metrics import (
+            fabric_health,
+        )
+        # mesh is None under --tp 1; the monitor then builds its own
+        # pure-dp mesh over all local devices so localization can name
+        # individual ranks. Gauges co-serve on the request-metrics
+        # registry/port; only the sweep thread is started here.
+        fabric_mon = fabric_health.FabricHealthMonitor(
+            mesh=mesh, interval=args.fabric_health_interval,
+            size_bytes=1 << 14, warmup=1, iters=2,
+            baseline_path=args.fabric_health_baseline,
+            history_path=args.fabric_health_history,
+            registry=recorder.registry)
+        fabric_mon.start_poll_only()
+        fabric_health.set_active(fabric_mon)
+        log.info("fabric health monitor on (sweep every %.1fs)",
+                 args.fabric_health_interval)
     if args.metrics_port is not None:
         exporter = ServeMetricsExporter(recorder, port=args.metrics_port,
                                         host=args.metrics_host)
 
         def _state_snapshot(engine=engine, recorder=recorder,
-                            rid=replica_id, engine_kind=args.engine):
+                            rid=replica_id, engine_kind=args.engine,
+                            fabric_mon=fabric_mon):
             """/debugz?state=1: the fleet scraper's machine-readable
             snapshot — recorder state plus engine liveness."""
             snap = recorder.state_snapshot()
@@ -2850,6 +2885,11 @@ def main(argv=None) -> int:
                 "prefill_workers_alive": (alive_fn() if alive_fn
                                           else 0),
             })
+            if fabric_mon is not None:
+                # Fabric block (ISSUE 20): the fleet scraper's
+                # mixed-version contract — absent entirely on
+                # replicas predating the fabric plane.
+                snap["fabric"] = fabric_mon.snapshot()
             return snap
 
         exporter.state_provider = _state_snapshot

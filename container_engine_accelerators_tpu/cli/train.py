@@ -154,6 +154,20 @@ def main(argv=None) -> int:
                         "this process — data-loader stalls, "
                         "slow-straggler delays, health-pipeline "
                         "storms")
+    p.add_argument("--fabric-health", action="store_true",
+                   help="run a FabricHealthMonitor over the training "
+                        "mesh (metrics/fabric_health.py): probe "
+                        "sweeps every --fabric-health-every steps, "
+                        "driven from the step loop so every rank "
+                        "probes in lockstep (multi-process safe)")
+    p.add_argument("--fabric-health-every", type=int, default=20,
+                   help="steps between fabric probe sweeps")
+    p.add_argument("--fabric-health-baseline", default=None,
+                   help="FABRIC_BASELINE.json to seed busBW "
+                        "baselines from")
+    p.add_argument("--fabric-health-history", default=None,
+                   help="append probe-history JSONL rows here "
+                        "(tools/fabric_report.py input)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -343,6 +357,22 @@ def main(argv=None) -> int:
             FaultListener,
         )
         FaultListener(args.fault_listen).start()
+    if args.fabric_health:
+        from container_engine_accelerators_tpu.metrics import (
+            fabric_health,
+        )
+        # No poll thread here: multi-process probe collectives are
+        # matched SPMD programs, so sweeps MUST run in step lockstep —
+        # fit's loop drives maybe_sweep_step via the active registry.
+        fmon = fabric_health.FabricHealthMonitor(
+            mesh=mesh, size_bytes=1 << 14, warmup=1, iters=2,
+            baseline_path=args.fabric_health_baseline,
+            history_path=args.fabric_health_history,
+            registry=recorder.registry)
+        fmon.train_every = max(args.fabric_health_every, 1)
+        fabric_health.set_active(fmon)
+        log.info("fabric health monitor on (sweep every %d steps)",
+                 fmon.train_every)
     opt = make_optimizer()
     state, _ = fit(cfg, mesh, opt, batches,
                    ckpt_dir=args.ckpt_dir, save_every=args.save_every,

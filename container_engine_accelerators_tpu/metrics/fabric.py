@@ -111,6 +111,11 @@ class FabricMetricServer(ExporterBase):
         self.scrapes = Counter(
             "tpu_fabric_poll_total", "Fabric poll iterations",
             [], registry=self.registry)
+        self.probe_errors = Counter(
+            "tpu_fabric_probe_errors_total",
+            "Collective busBW probe invocations that raised (polling "
+            "survives; the round is skipped)",
+            [], registry=self.registry)
         self.collective_busbw = Gauge(
             "fabric_collective_busbw_bytes_per_second",
             "Measured collective bus bandwidth over a mesh axis "
@@ -175,7 +180,19 @@ class FabricMetricServer(ExporterBase):
                     self.collective_busbw.labels(
                         collective=coll, axis=axis,
                         fabric=fabric).set(busbw)
-            except Exception:
+            except Exception as e:
+                # A raising hook must not kill the poll thread: count
+                # it, leave a timeline marker, and keep polling — the
+                # NIC/ICI counters above are still good even when the
+                # active probe path is broken.
+                self.probe_errors.inc()
+                from container_engine_accelerators_tpu.metrics import (
+                    events,
+                )
+                if events.enabled():
+                    events.instant("fabric/probe_error", "fabric",
+                                   {"error": type(e).__name__,
+                                    "detail": str(e)[:200]})
                 log.exception("collective busBW probe failed")
         self.scrapes.inc()
 
@@ -204,6 +221,20 @@ def main(argv=None) -> int:
                    help="comma list; empty = all non-loopback")
     p.add_argument("--probe", default="",
                    help="host:port of a dcn-prober echo to RTT-probe")
+    p.add_argument("--health", action="store_true",
+                   help="also run a FabricHealthMonitor "
+                        "(metrics/fabric_health.py) co-registered on "
+                        "this server's registry: baseline-tracked "
+                        "probe sweeps, degradation verdicts, "
+                        "slow-rank localization")
+    p.add_argument("--health-interval", type=float, default=30.0,
+                   help="seconds between fabric health probe sweeps")
+    p.add_argument("--health-baseline", default=None,
+                   help="FABRIC_BASELINE.json to seed/persist busBW "
+                        "baselines")
+    p.add_argument("--health-history", default=None,
+                   help="append probe-history JSONL rows here "
+                        "(tools/fabric_report.py input)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     probe = None
@@ -213,11 +244,25 @@ def main(argv=None) -> int:
     srv = FabricMetricServer(
         interfaces=[i for i in args.interfaces.split(",") if i] or None,
         probe_addr=probe, port=args.port, interval=args.interval)
+    mon = None
+    if args.health:
+        from container_engine_accelerators_tpu.metrics import (
+            fabric_health,
+        )
+        mon = fabric_health.FabricHealthMonitor(
+            interval=args.health_interval,
+            baseline_path=args.health_baseline,
+            history_path=args.health_history,
+            registry=srv.registry)
+        mon.start_poll_only()
+        fabric_health.set_active(mon)
     srv.start_background()
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if mon is not None:
+            mon.stop()
         srv.stop()
     return 0
 

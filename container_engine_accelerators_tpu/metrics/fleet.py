@@ -194,6 +194,25 @@ class ReplicaState:
         v = self._snap("kv_thermal", "working_set_pages")
         return None if v is None else float(v)
 
+    def fabric_score(self) -> float | None:
+        """Worst-axis fabric health score from the replica's
+        FabricHealthMonitor snapshot (ISSUE 20). None when the
+        replica predates the fabric block or runs without the
+        monitor — mixed-version fleets must stay green."""
+        v = self._snap("fabric", "score")
+        return None if v is None else float(v)
+
+    def fabric_degraded(self) -> float | None:
+        v = self._snap("fabric", "degraded")
+        return None if v is None else float(v)
+
+    def fabric_worst_axis(self) -> str | None:
+        v = self._snap("fabric", "worst_axis")
+        return None if v is None else str(v)
+
+    def fabric_slow_rank(self):
+        return self._snap("fabric", "slow_rank")
+
     def series_values(self) -> dict:
         """The fleet/replica/<rid> counter sample: the routing inputs
         plus liveness, all numeric (Chrome counter tracks)."""
@@ -211,6 +230,10 @@ class ReplicaState:
         cold = self.kv_cold_pages()
         if cold is not None:  # absent on pre-thermal replicas
             out["cold_pages"] = cold
+        fscore = self.fabric_score()
+        if fscore is not None:  # absent on pre-fabric-plane replicas
+            out["fabric_score"] = fscore
+            out["fabric_degraded"] = self.fabric_degraded() or 0.0
         return out
 
     def row(self, now: float) -> dict:
@@ -316,6 +339,10 @@ class FleetState:
             cold_total: float | None = None
             coldest_rid: str | None = None
             coldest_pages = -1.0
+            fabric_degraded_total: float | None = None
+            fabric_worst_rid: str | None = None
+            fabric_worst_axis: str | None = None
+            fabric_worst_score = 2.0
             for r in self._replicas.values():
                 counts[r.state] += 1
                 if r.state != STATE_UP:
@@ -331,6 +358,15 @@ class FleetState:
                     if cold > coldest_pages:
                         coldest_pages = cold
                         coldest_rid = r.rid
+                fscore = r.fabric_score()
+                if fscore is not None:
+                    fabric_degraded_total = (
+                        (fabric_degraded_total or 0.0)
+                        + (r.fabric_degraded() or 0.0))
+                    if fscore < fabric_worst_score:
+                        fabric_worst_score = fscore
+                        fabric_worst_rid = r.rid
+                        fabric_worst_axis = r.fabric_worst_axis()
                 for kind in ("ttft", "tpot"):
                     n, bad = r.slo_window(kind)
                     slo[kind]["n"] += n
@@ -349,6 +385,15 @@ class FleetState:
                 # distinct from a genuine 0 cold pages.
                 "kv_cold_pages": cold_total,
                 "coldest_replica": coldest_rid,
+                # Fabric rollup (ISSUE 20): None when NO up replica
+                # publishes a fabric block yet (mixed-version fleet) —
+                # distinct from a genuine 0 degraded axes.
+                "fabric_degraded": fabric_degraded_total,
+                "fabric_worst_replica": fabric_worst_rid,
+                "fabric_worst_axis": fabric_worst_axis,
+                "fabric_worst_score": (
+                    None if fabric_worst_rid is None
+                    else fabric_worst_score),
             }
 
     def debugz(self, now: float | None = None) -> dict:
@@ -539,6 +584,26 @@ class FleetExporter(ExporterBase):
             "1 on the UP replica holding the most cold KV pages, 0 "
             "elsewhere — the offload/routing attribution target",
             ["replica"], registry=reg)
+        # Fabric rollup (ISSUE 20): how many degraded axes fleet-wide,
+        # each replica's worst-axis health score, and which replica
+        # holds the worst fabric (the drain/route-around target —
+        # fleet_fabric_worst_replica carries the rid as a label with
+        # value 1).
+        self.fabric_degraded_g = Gauge(
+            "fleet_fabric_degraded",
+            "Degraded fabric axes summed over UP replicas publishing "
+            "a fabric-health block (0 until any replica does)",
+            registry=reg)
+        self.r_fabric = Gauge(
+            "fleet_replica_fabric_health",
+            "Per-replica worst-axis fabric health score (last good "
+            "snapshot; absent for replicas without the fabric plane)",
+            ["replica"], registry=reg)
+        self.fabric_worst_g = Gauge(
+            "fleet_fabric_worst_replica",
+            "1 on the UP replica with the worst fabric health score, "
+            "0 elsewhere — the drain/route-around attribution target",
+            ["replica"], registry=reg)
         self.scrapes = Counter(
             "fleet_scrapes", "Scrape attempts by replica and outcome",
             ["replica", "outcome"], registry=reg)
@@ -559,6 +624,8 @@ class FleetExporter(ExporterBase):
         self.version_g.set(agg["version"])
         self.cold_g.set(agg.get("kv_cold_pages") or 0.0)
         coldest = agg.get("coldest_replica")
+        self.fabric_degraded_g.set(agg.get("fabric_degraded") or 0.0)
+        fabric_worst = agg.get("fabric_worst_replica")
         now = time.monotonic()
         for r in self.scraper.state.replicas():
             lab = r.rid
@@ -576,6 +643,11 @@ class FleetExporter(ExporterBase):
                 self.r_cold.labels(lab).set(cold)
             self.coldest_g.labels(lab).set(
                 1.0 if lab == coldest else 0.0)
+            fscore = r.fabric_score()
+            if fscore is not None:
+                self.r_fabric.labels(lab).set(fscore)
+            self.fabric_worst_g.labels(lab).set(
+                1.0 if lab == fabric_worst else 0.0)
             self.r_restarts.labels(lab).set(
                 r.series_values()["restarts"])
             if r.last_ok_ts is not None:

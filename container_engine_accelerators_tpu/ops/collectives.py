@@ -116,13 +116,21 @@ def build_probe(mesh: Mesh, axis: str, collective: str):
 
 def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
                      warmup: int = 5, iters: int = 20,
-                     dtype=jnp.float32) -> CollectiveResult:
+                     dtype=jnp.float32, prebuilt=None,
+                     pre_delay_s: float = 0.0) -> CollectiveResult:
     """Time one collective at one per-device size over `axis` of `mesh`.
 
     Discipline mirrors nccl-tests `-w 5 --iters N`: warmup runs excluded,
     block_until_ready around the timed loop (XLA dispatch is async).
+
+    `prebuilt` takes a cached `build_probe(...)` result so repeated
+    probes (FabricHealthMonitor sweeps) never re-trace; `pre_delay_s`
+    inserts a sleep INSIDE the timed window — the fabric-slow chaos
+    hook, which in multi-process runs drags every matched participant
+    exactly like a genuinely slow peer would.
     """
-    mapped, n = build_probe(mesh, axis, collective)
+    mapped, n = prebuilt if prebuilt is not None else build_probe(
+        mesh, axis, collective)
     itemsize = np.dtype(dtype).itemsize
     elems = max(size_bytes // itemsize, n)
     elems -= elems % n  # keep shard evenly divisible for a2a/scatter tiling
@@ -137,6 +145,8 @@ def probe_collective(mesh: Mesh, axis: str, collective: str, size_bytes: int,
 
     m0 = time.monotonic()
     t0 = time.perf_counter()
+    if pre_delay_s > 0:
+        time.sleep(pre_delay_s)
     for _ in range(iters):
         out = mapped(x)
     jax.block_until_ready(out)
@@ -192,10 +202,14 @@ def make_probe_hook(mesh: Mesh, axis: str,
     [(collective, axis, fabric, busbw_bytes_per_second), ...] for the
     `fabric_collective_busbw_bytes_per_second` gauge family, where
     `fabric` is 'ici' or 'dcn' (axis_fabric) so the recorder can
-    attribute exposed time to the right interconnect."""
-    fabric = axis_fabric(axis)
+    attribute exposed time to the right interconnect.
+
+    axis_fabric is evaluated per invocation, not at construction: a
+    hook built before jax.distributed initializes would otherwise see
+    process_count()==1 and permanently label the dp axis 'ici'."""
 
     def hook():
+        fabric = axis_fabric(axis)
         out = []
         for c in collectives:
             r = probe_collective(mesh, axis, c, size_bytes,

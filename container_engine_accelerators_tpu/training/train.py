@@ -708,11 +708,36 @@ def fit(cfg, mesh: Mesh, optimizer, batches: Iterator, *,
                                 " GB/s")
                             if rec is not None:
                                 rec.record_dcn_attribution(attr)
+                            # Passive corroboration (ISSUE 20): the
+                            # calibrated DCN busBW feeds the fabric
+                            # baseline store, so active probes and
+                            # real training traffic cross-check.
+                            from container_engine_accelerators_tpu.metrics import (  # noqa: E501
+                                fabric_health,
+                            )
+                            fmon = fabric_health.get_active()
+                            if fmon is not None:
+                                fmon.observe_passive(
+                                    dcn_overlap.axis,
+                                    attr["busbw_bytes_per_second"])
                         except Exception as e:
                             # Advisory: a failed calibration must not
                             # kill the run it is measuring.
                             log_fn("dcn attribution calibration "
                                    f"failed: {e}")
+                from container_engine_accelerators_tpu.metrics import (
+                    fabric_health as _fabric_health,
+                )
+                _fmon = _fabric_health.get_active()
+                if _fmon is not None and _fmon.train_every > 0:
+                    # Step-synchronized probe sweep: every rank
+                    # reaches the same step and probes in lockstep,
+                    # keeping the collectives matched (SPMD).
+                    with annotate("train/fabric_sweep"):
+                        try:
+                            _fmon.maybe_sweep_step(cur)
+                        except Exception as e:
+                            log_fn(f"fabric sweep failed: {e}")
                 i += 1
         if mngr is not None:
             # An in-flight async save must land before latest_step can

@@ -1,0 +1,481 @@
+"""Fabric health plane (ISSUE 20): rolling busBW baselines,
+degradation verdicts, slow-rank localization, the per-process doctor
+detectors, and the offline fabric_report trend/episode folding.
+
+The monitor is exercised through its `probe_fn`/`subgroup_probe_fn`
+test hooks — no real collectives — so every behavior here (baseline
+freeze during a fault, transition-only localization, history-row
+stamping) is deterministic."""
+
+import json
+import types
+
+import pytest
+
+from container_engine_accelerators_tpu.metrics import doctor, events
+from container_engine_accelerators_tpu.metrics import fabric_health
+from container_engine_accelerators_tpu.metrics.doctor import (
+    DoctorConfig,
+    Signals,
+)
+from container_engine_accelerators_tpu.metrics.fabric_health import (
+    FabricBaselineStore,
+    FabricHealthMonitor,
+)
+from tools import fabric_report
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    def reset():
+        events._reset_for_tests()
+        doctor.set_active(None)
+        fabric_health.set_active(None)
+        fabric_health.clear_injection()
+    reset()
+    yield
+    reset()
+
+
+# ---------- synthetic event helpers (test_doctor.py idiom) ----------
+
+def C(name, ts, pid=0, **vals):
+    return {"name": name, "cat": "", "ph": "C", "ts": ts,
+            "args": vals, "id": None, "pid": pid}
+
+
+def I(name, ts, **args):
+    return {"name": name, "cat": "", "ph": "i", "ts": ts,
+            "args": args, "id": None}
+
+
+def fab_cfg(**kw):
+    defaults = dict(poll_interval_s=1.0, fast_window_s=10.0,
+                    slow_window_s=50.0, clear_after_s=5.0, slos=[],
+                    fabric_degraded_n=3, fabric_flap_n=4)
+    defaults.update(kw)
+    return DoctorConfig(**defaults)
+
+
+def sig(evs, now, cfg=None):
+    return Signals(now, sorted(evs, key=lambda e: e["ts"]),
+                   cfg or fab_cfg(), live=False)
+
+
+# ---------- FabricBaselineStore ----------
+
+def test_baseline_seeds_and_needs_maturity():
+    st = FabricBaselineStore(min_samples=3)
+    ent = st.observe("all_reduce.dp.ici", 100.0)
+    assert ent["n"] == 1 and not ent["degraded"]
+    # An immature baseline never votes degraded, even on a crash.
+    ent = st.observe("all_reduce.dp.ici", 5.0)
+    assert not ent["degraded"]
+
+
+def test_baseline_freezes_during_degradation_and_recovers():
+    st = FabricBaselineStore(min_samples=3, spread_mult=3.0)
+    for _ in range(6):
+        st.observe("k", 100.0)
+    center = st.get("k")["center"]
+    assert center == pytest.approx(100.0)
+    ent = st.observe("k", 10.0)
+    assert ent["degraded"] and ent["ratio"] == pytest.approx(0.1)
+    # The fault was NOT folded in: center and sample count unchanged.
+    after = st.get("k")
+    assert after["center"] == pytest.approx(center)
+    assert after["n"] == 6
+    # A healthy sample resumes the EWMA.
+    ent = st.observe("k", 100.0)
+    assert not ent["degraded"]
+    assert st.get("k")["n"] == 7
+
+
+def test_baseline_rel_floor_tolerates_small_dips():
+    # Identical samples learn spread ~0; the relative floor keeps the
+    # band from becoming a hair trigger.
+    st = FabricBaselineStore(min_samples=2, rel_floor=0.10)
+    for _ in range(5):
+        st.observe("k", 100.0)
+    assert not st.observe("k", 92.0)["degraded"]   # inside the floor
+    assert st.observe("k", 80.0)["degraded"]        # well below it
+
+
+def test_baseline_save_load_roundtrip(tmp_path):
+    st = FabricBaselineStore()
+    for _ in range(4):
+        st.observe("all_reduce.dp.dcn", 1e9)
+    path = str(tmp_path / "FABRIC_BASELINE.json")
+    st.save(path)
+    st2 = FabricBaselineStore()
+    assert st2.load(path)
+    ent = st2.get("all_reduce.dp.dcn")
+    assert ent["center"] == pytest.approx(1e9)
+    assert ent["n"] == 4
+    # A seeded store is already mature: first low sample is degraded.
+    assert st2.observe("all_reduce.dp.dcn", 1e8)["degraded"]
+
+
+def test_baseline_load_tolerates_garbage(tmp_path):
+    st = FabricBaselineStore()
+    assert not st.load(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert not st.load(str(bad))
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"kind": "perf_baseline"}))
+    assert not st.load(str(wrong))
+    assert st.entries == {}
+
+
+# ---------- FabricHealthMonitor (fake probe hooks) ----------
+
+def make_monitor(bw=None, sub_calls=None, axis_n=4, **kw):
+    """Monitor wired to fake probes. `bw` maps axis -> busBW (mutable
+    by the test); `sub_calls` collects localization subgroup probes."""
+    bw = bw if bw is not None else {"dp": 1e9}
+
+    def probe_fn(axis, coll):
+        return bw[axis]
+
+    def subgroup_probe_fn(axis, ranks):
+        if sub_calls is not None:
+            sub_calls.append((axis, ranks))
+        return 0.001
+
+    mesh = types.SimpleNamespace(shape={a: axis_n for a in bw})
+    kw.setdefault("axes", tuple(bw))
+    return FabricHealthMonitor(mesh=mesh, probe_fn=probe_fn,
+                               subgroup_probe_fn=subgroup_probe_fn,
+                               min_samples=3, **kw), bw
+
+
+def gauge(mon, name, **labels):
+    for metric in mon.registry.collect():
+        for s in metric.samples:
+            if s.name == name and all(
+                    s.labels.get(k) == v for k, v in labels.items()):
+                return s.value
+    return None
+
+
+def test_sweep_updates_gauges_and_history():
+    mon, _ = make_monitor()
+    for _ in range(4):
+        rows = mon.sweep_once()
+    assert len(rows) == len(mon.collectives)
+    assert {r["collective"] for r in rows} == set(mon.collectives)
+    assert all(r["fabric"] == "ici" for r in rows)  # 1-process dp
+    assert gauge(mon, "fabric_health_score", axis="dp") == 1.0
+    assert gauge(mon, "fabric_degraded", axis="dp") == 0.0
+    assert gauge(mon, "fabric_probe_busbw_bytes_per_second",
+                 collective="all_reduce", axis="dp",
+                 fabric="ici") == pytest.approx(1e9)
+    assert mon.sweeps == 4
+    assert len(mon.history) == 4 * len(mon.collectives)
+
+
+def test_inject_slow_degrades_and_localizes():
+    sub_calls = []
+    mon, _ = make_monitor(sub_calls=sub_calls)
+    for _ in range(4):
+        mon.sweep_once()
+    fabric_health.inject_slow(axis="dp", rank=1, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    rows = mon.sweep_once()
+    assert all(r["degraded"] for r in rows)
+    assert gauge(mon, "fabric_degraded", axis="dp") == 1.0
+    score = gauge(mon, "fabric_health_score", axis="dp")
+    assert score == pytest.approx(0.125, rel=0.05)
+    # Bisection over 4 ranks with the injection on rank 1: the halves
+    # containing it always measure slower, so it is named.
+    assert gauge(mon, "fabric_slow_rank", axis="dp") == 1.0
+    assert mon.snapshot()["slow_rank"] == 1
+    assert sub_calls and all(a == "dp" for a, _ in sub_calls)
+    # The worst row of the degraded sweep carries the verdict.
+    stamped = [r for r in rows if "slow_rank" in r]
+    assert stamped and stamped[0]["slow_rank"] == 1
+    assert stamped[0]["score"] == pytest.approx(score, rel=0.05)
+
+
+def test_localization_runs_only_on_transition():
+    sub_calls = []
+    mon, _ = make_monitor(sub_calls=sub_calls)
+    for _ in range(4):
+        mon.sweep_once()
+    fabric_health.inject_slow(axis="dp", rank=2, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    mon.sweep_once()
+    n_first = len(sub_calls)
+    assert n_first > 0
+    mon.sweep_once()  # still degraded: no new localization pass
+    assert len(sub_calls) == n_first
+    assert mon.snapshot()["slow_rank"] == 2
+
+
+def test_recovery_clears_slow_rank_and_degraded():
+    mon, _ = make_monitor()
+    for _ in range(4):
+        mon.sweep_once()
+    fabric_health.inject_slow(axis="dp", rank=1, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    mon.sweep_once()
+    assert mon.snapshot()["degraded"] == 1
+    fabric_health.clear_injection()
+    mon.sweep_once()
+    snap = mon.snapshot()
+    assert snap["degraded"] == 0
+    assert snap["slow_rank"] is None
+    assert gauge(mon, "fabric_degraded", axis="dp") == 0.0
+
+
+def test_poll_once_rate_limited_and_due_first_poll():
+    mon, _ = make_monitor(interval=30.0)
+    mon.poll_once(now=100.0)
+    assert mon.sweeps == 1            # due on the first poll
+    mon.poll_once(now=115.0)
+    assert mon.sweeps == 1            # inside the interval
+    mon.poll_once(now=130.0)
+    assert mon.sweeps == 2
+    # Interval change takes effect at the NEXT scheduling decision.
+    mon.interval = 5.0
+    mon.poll_once(now=134.0)
+    assert mon.sweeps == 2            # old schedule still pending
+    mon.poll_once(now=160.0)
+    assert mon.sweeps == 3
+    mon.poll_once(now=164.0)
+    assert mon.sweeps == 3
+    mon.poll_once(now=165.0)
+    assert mon.sweeps == 4            # new 5s cadence in force
+
+
+def test_maybe_sweep_step_cadence():
+    mon, _ = make_monitor()
+    assert not mon.maybe_sweep_step(3)   # train_every=0: disabled
+    mon.train_every = 5
+    swept = [s for s in range(1, 21) if mon.maybe_sweep_step(s)]
+    assert swept == [5, 10, 15, 20]
+    assert mon.sweeps == 4
+
+
+def test_observe_passive_shares_the_baseline_store():
+    mon, _ = make_monitor()
+    for _ in range(4):
+        mon.observe_passive("dp", 2e9, collective="all_reduce",
+                            fabric="dcn")
+    ent = mon.baseline.get("all_reduce.dp.dcn")
+    assert ent is not None and ent["n"] == 4
+    row = mon.history[-1]
+    assert row["source"] == "passive" and row["fabric"] == "dcn"
+    # Passive traffic corroborates: a probe against the passively
+    # learned center is judged by the same entry.
+    out = mon.baseline.observe("all_reduce.dp.dcn", 1e8)
+    assert out["degraded"]
+
+
+def test_history_jsonl_rows_and_stamping(tmp_path):
+    hist = tmp_path / "fabric-history.jsonl"
+    mon, _ = make_monitor(history_path=str(hist))
+    for _ in range(4):
+        mon.sweep_once()
+    fabric_health.inject_slow(axis="dp", rank=3, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    mon.sweep_once()
+    rows = [json.loads(line) for line in
+            hist.read_text().splitlines()]
+    assert len(rows) == 5 * len(mon.collectives)
+    assert all(r["kind"] == "fabric_probe" for r in rows)
+    degraded = [r for r in rows if r["degraded"]]
+    assert len(degraded) == len(mon.collectives)
+    # The persisted file (not just the in-memory deque) carries the
+    # episode verdict on the worst row.
+    stamped = [r for r in degraded if "slow_rank" in r]
+    assert stamped and stamped[0]["slow_rank"] == 3
+    assert "score" in stamped[0]
+
+
+def test_snapshot_names_worst_axis():
+    mon, bw = make_monitor(bw={"dp": 1e9, "fsdp": 1e9})
+    for _ in range(4):
+        mon.sweep_once()
+    bw["fsdp"] = 1e8
+    mon.sweep_once()
+    snap = mon.snapshot()
+    assert snap["worst_axis"] == "fsdp"
+    assert snap["degraded"] == 1
+    assert snap["score"] == pytest.approx(0.1, rel=0.05)
+    assert set(snap["axes"]) == {"dp", "fsdp"}
+
+
+def test_monitor_seeds_from_committed_baseline(tmp_path):
+    path = str(tmp_path / "FABRIC_BASELINE.json")
+    mon, _ = make_monitor(baseline_path=path)
+    for _ in range(4):
+        mon.sweep_once()
+    mon.save_baseline()
+    # A fresh monitor (restart) is mature immediately: the very first
+    # sweep under injection votes degraded instead of learning the
+    # fault as normal.
+    mon2, _ = make_monitor(baseline_path=path)
+    fabric_health.inject_slow(axis="dp", rank=0, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    rows = mon2.sweep_once()
+    assert all(r["degraded"] for r in rows)
+
+
+def test_degraded_emits_event_instants():
+    bus = events.enable(capacity=256, process_name="fabric-test")
+    mon, _ = make_monitor()
+    for _ in range(4):
+        mon.sweep_once()
+    fabric_health.inject_slow(axis="dp", rank=1, factor=8.0,
+                              seconds=60.0, delay_s=0.0)
+    mon.sweep_once()
+    # Raw ring tuples: (ph, ts, tid, name, cat, dur, id, args).
+    evs = bus.snapshot()
+    health = [e for e in evs if e[3] == "fabric/health"]
+    assert len(health) == 5
+    deg = [e for e in evs if e[3] == "fabric/degraded"]
+    assert len(deg) == 1
+    args = deg[0][7]
+    assert args["axis"] == "dp" and args["slow_rank"] == 1
+    assert args["busbw_bytes_per_second"] < \
+        args["baseline_bytes_per_second"]
+
+
+# ---------- doctor detectors ----------
+
+def mk_health(ts, score, pid=0, axis="dp"):
+    return C("fabric/health", ts, pid=pid, **{axis: score})
+
+
+def test_fabric_degraded_fires_with_localization_evidence():
+    evs = [mk_health(t, 1.0) for t in (1.0, 2.0)]
+    evs += [mk_health(t, 0.12) for t in (3.0, 4.0, 5.0)]
+    evs.append(I("fabric/degraded", 5.0, axis="dp", fabric="dcn",
+                 score=0.12, collective="all_reduce",
+                 busbw_bytes_per_second=1.2e8,
+                 baseline_bytes_per_second=1e9, slow_rank=1))
+    founds = doctor.FabricDegradedDetector().check(sig(evs, 6.0))
+    assert len(founds) == 1
+    f = founds[0]
+    assert f.cls == "fabric_degraded" and f.subject == "dp"
+    assert f.evidence["slow_rank"] == 1
+    assert f.evidence["localization"] == "axis dp: slow rank 1"
+    assert f.evidence["fabric"] == "dcn"
+    assert "rank 1" in f.summary
+
+
+def test_fabric_degraded_quiet_below_n_samples():
+    evs = [mk_health(t, 0.12) for t in (4.0, 5.0)]  # only 2 trailing
+    assert doctor.FabricDegradedDetector().check(sig(evs, 6.0)) == []
+
+
+def test_fabric_degraded_quiet_when_recovered():
+    evs = [mk_health(t, 0.12) for t in (1.0, 2.0, 3.0)]
+    evs.append(mk_health(4.0, 1.0))  # trailing sample healthy
+    assert doctor.FabricDegradedDetector().check(sig(evs, 5.0)) == []
+
+
+def test_interleaved_rank_streams_do_not_flap():
+    """A merged 2-process timeline interleaves per-rank scores that
+    legitimately disagree mid-episode (the throttled rank reads
+    lower). Judged per process this is one sustained degradation on
+    rank 1 — NOT oscillation."""
+    evs = []
+    for i, t in enumerate((1.0, 2.0, 3.0, 4.0, 5.0, 6.0)):
+        evs.append(mk_health(t, 0.95, pid=0))      # dragged peer: ok
+        evs.append(mk_health(t + 0.1, 0.12, pid=1))  # throttled rank
+    assert doctor.FabricFlapDetector().check(sig(evs, 7.0)) == []
+    founds = doctor.FabricDegradedDetector().check(sig(evs, 7.0))
+    assert [f.subject for f in founds] == ["dp"]
+    assert founds[0].evidence["score_last"] == pytest.approx(0.12)
+
+
+def test_fabric_flap_fires_on_single_stream_oscillation():
+    evs = []
+    for i in range(10):
+        evs.append(mk_health(1.0 + i, 1.0 if i % 2 == 0 else 0.1))
+    founds = doctor.FabricFlapDetector().check(sig(evs, 12.0))
+    assert len(founds) == 1
+    f = founds[0]
+    assert f.cls == "fabric_flap" and f.subject == "dp"
+    assert f.evidence["crossings"] >= 4
+
+
+def test_fabric_detectors_registered_by_default():
+    classes = {d.cls for d in doctor.default_detectors()}
+    assert {"fabric_degraded", "fabric_flap"} <= classes
+
+
+# ---------- tools/fabric_report.py ----------
+
+def probe_row(t, axis="dp", coll="all_reduce", bw=1e9, base=1e9,
+              degraded=False, **extra):
+    row = {"kind": "fabric_probe", "t": t, "axis": axis,
+           "collective": coll, "fabric": "dcn", "source": "probe",
+           "busbw_bytes_per_second": bw,
+           "baseline_bytes_per_second": base, "spread": 1e6, "n": 9,
+           "ratio": round(bw / base, 4), "degraded": degraded}
+    row.update(extra)
+    return row
+
+
+def test_load_rows_skips_torn_and_foreign_lines(tmp_path):
+    p = tmp_path / "h.jsonl"
+    lines = [json.dumps(probe_row(2.0)),
+             json.dumps({"kind": "decode_tick", "t": 1.5}),
+             json.dumps(probe_row(1.0)),
+             '{"kind": "fabric_probe", "t": 3.0, "axi']  # torn tail
+    p.write_text("\n".join(lines) + "\n")
+    rows = fabric_report.load_rows([str(p)])
+    assert [r["t"] for r in rows] == [1.0, 2.0]  # sorted, filtered
+
+
+def test_trend_table_and_episodes():
+    rows = [probe_row(t) for t in (1.0, 2.0, 3.0)]
+    rows += [probe_row(t, bw=1e8, degraded=True,
+                       score=0.1, slow_rank=1)
+             for t in (4.0, 5.0)]
+    rows += [probe_row(t) for t in (6.0, 7.0)]
+    rows += [probe_row(t, coll="ppermute", bw=5e8, base=5e8)
+             for t in (1.5, 6.5)]
+    report = fabric_report.build_report(rows)
+    trends = {(t["axis"], t["collective"]): t
+              for t in report["trends"]}
+    ar = trends[("dp", "all_reduce")]
+    assert ar["samples"] == 7 and ar["degraded_samples"] == 2
+    assert ar["busbw_min"] == pytest.approx(1e8)
+    assert ar["ratio_worst"] == pytest.approx(0.1)
+    assert trends[("dp", "ppermute")]["degraded_samples"] == 0
+    eps = report["episodes"]
+    assert len(eps) == 1
+    ep = eps[0]
+    assert (ep["t0"], ep["t1"]) == (4.0, 5.0)
+    assert ep["probes"] == 2 and ep["slow_rank"] == 1
+    assert ep["score_worst"] == pytest.approx(0.1)
+    assert ep["collectives"] == ["all_reduce"]
+    assert report["degraded_axes"] == ["dp"]
+
+
+def test_episode_splits_on_recording_gap():
+    rows = [probe_row(t, bw=1e8, degraded=True) for t in (1.0, 2.0)]
+    rows += [probe_row(t, bw=1e8, degraded=True)
+             for t in (500.0, 501.0)]  # >> gap_s later
+    eps = fabric_report.episodes(rows, gap_s=120.0)
+    assert len(eps) == 2
+    assert eps[0]["t1"] == 2.0 and eps[1]["t0"] == 500.0
+
+
+def test_report_json_written(tmp_path, capsys):
+    p = tmp_path / "h.jsonl"
+    with open(p, "w") as f:
+        for t in (1.0, 2.0, 3.0, 4.0):
+            f.write(json.dumps(probe_row(t)) + "\n")
+    out = tmp_path / "FABRIC_REPORT.json"
+    assert fabric_report.main([str(p), "--json", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["kind"] == "fabric_report"
+    assert doc["samples"] == 4 and doc["episodes"] == []
+    text = capsys.readouterr().out
+    assert "degradation episodes: 0" in text

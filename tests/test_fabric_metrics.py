@@ -184,3 +184,85 @@ def test_collective_probe_disabled_by_default(tmp_path):
     # Registered but never set: the family exports no samples.
     assert ("fabric_collective_busbw_bytes_per_second{"
             not in scrape(srv))
+
+
+def test_probe_interval_change_takes_effect_next_cycle(tmp_path):
+    """ISSUE 20 satellite: `collective_probe_interval` is read when
+    the NEXT round is scheduled, so a config change mid-interval
+    neither bursts immediately nor is lost."""
+    calls = []
+    srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                             sysfs_accel=str(tmp_path / "accel"),
+                             collective_probe=lambda: calls.append(1)
+                             or [],
+                             collective_probe_interval=100.0)
+    srv.poll_once(now=0.0)      # due on the first poll
+    assert calls == [1]
+    srv.collective_probe_interval = 10.0
+    srv.poll_once(now=50.0)     # old 100s schedule still pending
+    assert calls == [1]
+    srv.poll_once(now=100.0)    # old schedule fires...
+    assert calls == [1, 1]
+    srv.poll_once(now=105.0)
+    assert calls == [1, 1]
+    srv.poll_once(now=110.0)    # ...and the 10s cadence is in force
+    assert calls == [1, 1, 1]
+
+
+def test_probe_error_counts_and_marks_timeline(tmp_path):
+    """ISSUE 20 satellite: a raising probe hook bumps
+    tpu_fabric_probe_errors_total, drops a fabric/probe_error instant
+    on the flight recorder, and the poll loop keeps going."""
+    from container_engine_accelerators_tpu.metrics import events
+
+    def boom():
+        raise RuntimeError("link down")
+
+    events._reset_for_tests()
+    bus = events.enable(capacity=64, process_name="fabric-err-test")
+    try:
+        srv = FabricMetricServer(sysfs_net=str(tmp_path / "net"),
+                                 sysfs_accel=str(tmp_path / "accel"),
+                                 collective_probe=boom,
+                                 collective_probe_interval=10.0)
+        srv.poll_once(now=0.0)
+        srv.poll_once(now=5.0)    # rate-limited: no second attempt
+        srv.poll_once(now=10.0)
+        text = scrape(srv)
+        assert "tpu_fabric_probe_errors_total 2.0" in text
+        assert "tpu_fabric_poll_total 3.0" in text  # loop survived
+        # Raw ring tuples: (ph, ts, tid, name, cat, dur, id, args).
+        errs = [e for e in bus.snapshot()
+                if e[3] == "fabric/probe_error"]
+        assert len(errs) == 2
+        assert errs[0][7]["error"] == "RuntimeError"
+        assert "link down" in errs[0][7]["detail"]
+    finally:
+        events._reset_for_tests()
+
+
+def test_probe_hook_fabric_resolved_per_invocation(monkeypatch):
+    """ISSUE 20 satellite regression: make_probe_hook must evaluate
+    axis_fabric when the hook RUNS, not when it is built — a hook
+    constructed before jax.distributed initializes would otherwise
+    label the dp axis 'ici' forever."""
+    import jax
+
+    from container_engine_accelerators_tpu.ops import collectives
+    from container_engine_accelerators_tpu.parallel import (
+        MeshAxes,
+        make_mesh,
+    )
+    devs = jax.devices()
+    mesh = make_mesh(MeshAxes(dp=len(devs)), devices=devs)
+    hook = collectives.make_probe_hook(
+        mesh, "dp", collectives=("all_reduce",),
+        size_bytes=1 << 10, warmup=1, iters=1)
+    rows = hook()
+    assert [r[2] for r in rows] == ["ici"]  # single-process dp
+    # The world grew after construction (distributed init): the SAME
+    # hook object must now label dp rows 'dcn'.
+    monkeypatch.setattr(collectives.jax, "process_count", lambda: 2)
+    rows = hook()
+    assert [r[2] for r in rows] == ["dcn"]
+    assert rows[0][0] == "all_reduce" and rows[0][3] > 0

@@ -197,6 +197,47 @@ def test_aggregates_hit_rate_none_without_lookups():
     assert st.aggregates(now=0.0)["prefix_hit_rate"] is None
 
 
+def test_fabric_rollup_names_worst_replica():
+    """ISSUE 20: the fleet rollup sums degraded axes across up
+    replicas and names the worst-scoring one (and its axis + slow
+    rank survive into that replica's snapshot)."""
+    st = FleetState(down_after_s=1.0)
+    st.observe_ok("r0", "u0", {
+        "fabric": {"score": 0.92, "degraded": 0, "worst_axis": "tp",
+                   "slow_rank": None}}, {}, now=0.0)
+    st.observe_ok("r1", "u1", {
+        "fabric": {"score": 0.11, "degraded": 1, "worst_axis": "dp",
+                   "slow_rank": 3}}, {}, now=0.0)
+    agg = st.aggregates(now=0.0)
+    assert agg["fabric_degraded"] == 1.0
+    assert agg["fabric_worst_replica"] == "r1"
+    assert agg["fabric_worst_axis"] == "dp"
+    assert agg["fabric_worst_score"] == pytest.approx(0.11)
+
+
+def test_fabric_rollup_mixed_version_fleet():
+    """Replicas predating the fabric plane publish no fabric block:
+    the rollup must distinguish 'nobody reports' (None) from 'zero
+    degraded axes' (0.0), and old replicas must not crash the sums."""
+    st = FleetState(down_after_s=1.0)
+    st.observe_ok("r0", "u0", {"queued": 1}, {}, now=0.0)  # old build
+    agg = st.aggregates(now=0.0)
+    assert agg["fabric_degraded"] is None
+    assert agg["fabric_worst_replica"] is None
+    assert agg["fabric_worst_score"] is None
+    # One upgraded replica joins, healthy: genuine zero, not None.
+    st.observe_ok("r1", "u1", {
+        "fabric": {"score": 1.0, "degraded": 0, "worst_axis": None,
+                   "slow_rank": None}}, {}, now=0.0)
+    agg = st.aggregates(now=0.0)
+    assert agg["fabric_degraded"] == 0.0
+    assert agg["fabric_worst_replica"] == "r1"
+    # The old replica's counter sample omits fabric fields entirely.
+    r0 = st._replicas["r0"]
+    assert "fabric_score" not in r0.series_values()
+    assert "fabric_score" in st._replicas["r1"].series_values()
+
+
 # ---------- detectors ----------
 
 def test_replica_down_fires_once_and_names_victim():

@@ -300,7 +300,8 @@ def test_tier_is_hermetic_schema_complete_and_clean(tier):
         "decode_tick_under_prefill_ms", "ckpt_async_stall_ms",
         "decode_spec_tpot_ms", "decode_w8_step_ms",
         "decode_step_traced_ms", "host_gap_fraction",
-        "fleet_scrape_ms", "decode_tick_thermal_ms"}
+        "fleet_scrape_ms", "decode_tick_thermal_ms",
+        "fabric_probe_sweep_ms", "decode_tick_fabric_ms"}
     # The pipelined host-gap bench reports a fraction, not a latency,
     # and its device-dominated loop must keep the gap near zero.
     gap = tier["metrics"]["host_gap_fraction"]

@@ -934,6 +934,10 @@ def _doctor_config(spec: dict) -> doctor.DoctorConfig:
         queue_storm_n=int(spec.get("queue_storm_n", 4)),
         page_stall_s=float(spec.get("page_stall_s", 0.25)),
         page_stall_n=int(spec.get("page_stall_n", 2)),
+        fabric_unhealthy_score=float(
+            spec.get("fabric_unhealthy_score", 0.75)),
+        fabric_degraded_n=int(spec.get("fabric_degraded_n", 3)),
+        fabric_flap_n=int(spec.get("fabric_flap_n", 4)),
         clear_after_s=1e9,  # one episode per (class, subject) per run
         slos=[],
     )

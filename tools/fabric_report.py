@@ -1,0 +1,212 @@
+"""Offline fabric trend report (ISSUE 20) — busBW trends and
+degradation episodes from recorded probe history.
+
+Input: one or more JSONL files of `fabric_probe` rows, as appended by
+FabricHealthMonitor (`--fabric-health-history` on serve/train, the
+`history_path` ctor arg, or `tools/multislice_probe.py --sweep`).
+Each row is one probe: (axis, collective, fabric) busBW against the
+rolling baseline, plus the degraded verdict and — on the worst row of
+a degraded sweep — the health score and localized slow rank.
+
+Output: a per-(fabric, axis, collective) trend table (sample count,
+busBW min/mean/last, baseline center, worst ratio, degraded count)
+and the degradation episodes (consecutive degraded probes per axis
+folded into [t0, t1] spans with the worst ratio, the collectives
+involved, and the localized slow rank). `--json` writes the same
+content as a FABRIC_REPORT.json document.
+
+    python tools/fabric_report.py out/fabric-history.jsonl \
+        --json FABRIC_REPORT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+REPORT_KIND = "fabric_report"
+REPORT_VERSION = 1
+
+
+def load_rows(paths: list[str]) -> list[dict]:
+    """fabric_probe rows from JSONL files, time-ordered; rows of any
+    other kind (or torn trailing lines) are skipped."""
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a live file
+                if row.get("kind") != "fabric_probe":
+                    continue
+                rows.append(row)
+    rows.sort(key=lambda r: r.get("t", 0.0))
+    return rows
+
+
+def trend_table(rows: list[dict]) -> list[dict]:
+    """One entry per (fabric, axis, collective), stable order."""
+    groups: dict[tuple, list[dict]] = {}
+    for r in rows:
+        key = (r.get("fabric", "ici"), r.get("axis", "?"),
+               r.get("collective", "?"))
+        groups.setdefault(key, []).append(r)
+    out = []
+    for (fabric, axis, coll), grp in sorted(groups.items()):
+        bws = [r["busbw_bytes_per_second"] for r in grp
+               if "busbw_bytes_per_second" in r]
+        ratios = [r["ratio"] for r in grp if "ratio" in r]
+        out.append({
+            "fabric": fabric, "axis": axis, "collective": coll,
+            "samples": len(grp),
+            "sources": sorted({r.get("source", "probe")
+                               for r in grp}),
+            "busbw_min": round(min(bws), 3) if bws else None,
+            "busbw_mean": round(sum(bws) / len(bws), 3)
+            if bws else None,
+            "busbw_last": round(bws[-1], 3) if bws else None,
+            "baseline_last": grp[-1].get("baseline_bytes_per_second"),
+            "ratio_worst": round(min(ratios), 4) if ratios else None,
+            "degraded_samples": sum(1 for r in grp
+                                    if r.get("degraded")),
+        })
+    return out
+
+
+def episodes(rows: list[dict], gap_s: float = 120.0) -> list[dict]:
+    """Fold per-axis degraded probes into [t0, t1] episodes.
+
+    An episode closes when a healthy probe for the axis arrives or
+    the next degraded probe is more than `gap_s` away (a recording
+    gap, e.g. the process restarted)."""
+    per_axis: dict[str, list[dict]] = {}
+    for r in rows:
+        per_axis.setdefault(r.get("axis", "?"), []).append(r)
+    eps = []
+    for axis, grp in sorted(per_axis.items()):
+        cur = None
+        for r in grp:
+            t = r.get("t", 0.0)
+            if not r.get("degraded"):
+                if cur is not None:
+                    eps.append(cur)
+                    cur = None
+                continue
+            if cur is not None and t - cur["t1"] > gap_s:
+                eps.append(cur)
+                cur = None
+            if cur is None:
+                cur = {"axis": axis,
+                       "fabric": r.get("fabric", "ici"),
+                       "t0": t, "t1": t, "probes": 0,
+                       "ratio_worst": 1.0, "collectives": [],
+                       "slow_rank": None, "score_worst": None}
+            cur["t1"] = t
+            cur["probes"] += 1
+            ratio = r.get("ratio")
+            if ratio is not None and ratio < cur["ratio_worst"]:
+                cur["ratio_worst"] = round(ratio, 4)
+            coll = r.get("collective")
+            if coll and coll not in cur["collectives"]:
+                cur["collectives"].append(coll)
+            if r.get("slow_rank") is not None:
+                cur["slow_rank"] = r["slow_rank"]
+            score = r.get("score")
+            if score is not None and (cur["score_worst"] is None
+                                      or score < cur["score_worst"]):
+                cur["score_worst"] = score
+        if cur is not None:
+            eps.append(cur)
+    for ep in eps:
+        ep["duration_s"] = round(ep["t1"] - ep["t0"], 3)
+    eps.sort(key=lambda e: e["t0"])
+    return eps
+
+
+def build_report(rows: list[dict], gap_s: float = 120.0) -> dict:
+    eps = episodes(rows, gap_s=gap_s)
+    return {
+        "kind": REPORT_KIND,
+        "version": REPORT_VERSION,
+        "unit": "bytes_per_second",
+        "samples": len(rows),
+        "window": {"t0": rows[0]["t"], "t1": rows[-1]["t"]}
+        if rows else None,
+        "trends": trend_table(rows),
+        "episodes": eps,
+        "degraded_axes": sorted({e["axis"] for e in eps}),
+    }
+
+
+def _fmt_bw(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1e9:.3f}"
+
+
+def print_report(report: dict, out=None) -> None:
+    # sys.stdout resolved at call time, not def time, so stream
+    # redirection (pytest capsys, StringIO capture) sees the table.
+    w = (out or sys.stdout).write
+    w(f"fabric probe history: {report['samples']} samples\n\n")
+    w(f"{'fabric':<6} {'axis':<5} {'collective':<11} {'n':>5} "
+      f"{'min GB/s':>9} {'mean GB/s':>10} {'last GB/s':>10} "
+      f"{'base GB/s':>10} {'worst r':>8} {'deg':>4}\n")
+    for t in report["trends"]:
+        w(f"{t['fabric']:<6} {t['axis']:<5} {t['collective']:<11} "
+          f"{t['samples']:>5} {_fmt_bw(t['busbw_min']):>9} "
+          f"{_fmt_bw(t['busbw_mean']):>10} "
+          f"{_fmt_bw(t['busbw_last']):>10} "
+          f"{_fmt_bw(t['baseline_last']):>10} "
+          f"{t['ratio_worst'] if t['ratio_worst'] is not None else '-':>8} "
+          f"{t['degraded_samples']:>4}\n")
+    eps = report["episodes"]
+    w(f"\ndegradation episodes: {len(eps)}\n")
+    for i, e in enumerate(eps):
+        rank = (f"slow rank {e['slow_rank']}"
+                if e["slow_rank"] is not None else "not localized")
+        w(f"  [{i}] axis {e['axis']} ({e['fabric']}): "
+          f"{e['probes']} degraded probes over {e['duration_s']}s, "
+          f"worst ratio {e['ratio_worst']}, "
+          f"collectives {','.join(e['collectives'])}, {rank}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("history", nargs="+",
+                    help="probe-history JSONL file(s)")
+    ap.add_argument("--json", default=None,
+                    help="also write the report as JSON here")
+    ap.add_argument("--episode-gap-s", type=float, default=120.0,
+                    help="recording gap that splits an episode")
+    args = ap.parse_args(argv)
+
+    rows = load_rows(args.history)
+    if not rows:
+        print("no fabric_probe rows found", file=sys.stderr)
+        return 1
+    report = build_report(rows, gap_s=args.episode_gap_s)
+    print_report(report)
+    if args.json:
+        tmp = args.json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.json)
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,13 @@ milliseconds, wire bytes, busBW — from a one-shot calibration run
 on BOTH ranks (the probes contain dp collectives; a rank that
 skipped them would deadlock its peer). The gate scores this mode as
 `multislice_overlap_step_ms`.
+
+With --sweep N (ISSUE 20) both ranks additionally run N fabric
+health sweeps over the dp-over-gloo axis (metrics/fabric_health.py;
+matched collectives, so every rank sweeps) and rank 0 appends the
+probe-history rows to --sweep-history — the input format
+tools/fabric_report.py consumes — plus a "fabric" block in the JSON
+line with the final health snapshot.
 """
 
 from __future__ import annotations
@@ -54,6 +61,13 @@ def main(argv=None) -> int:
                     help="gradient bucket target in MiB; the default "
                          "keeps llama_tiny at several buckets so "
                          "overlap is actually exercised")
+    ap.add_argument("--sweep", type=int, default=0,
+                    help="also run N fabric health probe sweeps over "
+                         "the dp axis (both ranks; matched "
+                         "collectives)")
+    ap.add_argument("--sweep-history", default=None,
+                    help="append rank 0's probe-history JSONL rows "
+                         "here (tools/fabric_report.py input)")
     args = ap.parse_args(argv)
     if args.compress != "none" and not args.overlap:
         ap.error("--compress requires --overlap")
@@ -153,12 +167,31 @@ def main(argv=None) -> int:
             rec.record_steps(1, dt, tokens)
         samples_ms.append(round(harness.median(times) * 1e3, 4))
         pcts = rec.pct_ms("step")
+    fabric_snap = None
+    if args.sweep > 0:
+        from container_engine_accelerators_tpu.metrics import (
+            fabric_health,
+        )
+        # warmup=2/iters=4: localhost-TCP gloo timings swing several
+        # x sweep-to-sweep at minimal iteration counts; average a few
+        # more rounds so the recorded trend is about the fabric, not
+        # the scheduler.
+        fmon = fabric_health.FabricHealthMonitor(
+            mesh=mesh, size_bytes=1 << 14, warmup=2, iters=4,
+            history_path=(args.sweep_history
+                          if jax.process_index() == 0 else None))
+        for _ in range(args.sweep):
+            fmon.sweep_once()
+        fabric_snap = fmon.snapshot()
+
     if jax.process_index() == 0:
         out = {"kind": "multislice_probe",
                "samples_ms": samples_ms,
                "percentiles": pcts}
         if overlap_attr is not None:
             out["overlap"] = overlap_attr
+        if fabric_snap is not None:
+            out["fabric"] = fabric_snap
         print(json.dumps(out), flush=True)
     return 0
 
